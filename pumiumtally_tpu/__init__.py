@@ -1,0 +1,36 @@
+"""pumiumtally_tpu — TPU-native Monte Carlo track-length tallies on
+unstructured tetrahedral meshes (JAX/XLA/Pallas).
+
+From-scratch framework with the capabilities of OpenMCNP/PumiUMTally
+(see SURVEY.md): takes particle origin→destination batches from a Monte
+Carlo transport driver, ray-walks each particle through a tet mesh, scores
+segment_length × weight per element and energy group, handles domain- and
+material-boundary stops, normalizes by element volume, and writes VTK.
+"""
+
+from .api import PumiTally
+from .core.state import ParticleState, make_particle_state
+from .core.tally import make_flux, normalize_flux
+from .mesh.box import build_box, build_box_arrays
+from .mesh.core import TetMesh
+from .ops.walk import trace, TraceResult
+from .utils.config import TallyConfig
+from .utils.timing import TallyTimes
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "PumiTally",
+    "ParticleState",
+    "make_particle_state",
+    "make_flux",
+    "normalize_flux",
+    "build_box",
+    "build_box_arrays",
+    "TetMesh",
+    "trace",
+    "TraceResult",
+    "TallyConfig",
+    "TallyTimes",
+    "__version__",
+]

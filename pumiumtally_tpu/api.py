@@ -1,0 +1,335 @@
+"""Public facade: the PumiTally class.
+
+Drop-in TPU-native equivalent of the reference's pimpl facade
+(pumipic_particle_data_structure.h:20-47) with the same four entry points and
+array contracts, NumPy in/out:
+
+  * ``PumiTally(mesh, num_particles)``            — ctor (openmc_init site)
+  * ``initialize_particle_location(pos, size)``   — initial parent-element
+    search, never tallied (cpp:209-219; called from initialize_batch)
+  * ``move_to_next_location(dest, flying, weights, groups, material_ids,
+    size)`` — the per-event workhorse (cpp:221-264): walks every in-flight
+    particle to its destination, scores track-length flux, clips at
+    domain/material boundaries, and writes the clipped positions and new
+    material ids back into the caller's arrays (the library doubles as the
+    host code's surface-crossing oracle). The caller's ``flying`` array is
+    reset to 0, matching copy_and_reset_flying_flag (cpp:316-319).
+  * ``write_pumi_tally_mesh()``                   — normalize + VTK output
+    (cpp:296-302) and TallyTimes report.
+
+Because positions/flying/material_ids are *out-params* (raw pointers in the
+reference), they must be writable C-contiguous numpy arrays of the right
+dtype; anything else raises instead of silently dropping the write-back.
+
+Unlike the reference there is no staging-buffer dance: host arrays are
+device_put once per call, state lives on device between calls, and the single
+fused trace kernel replaces the copy→search→callback→copy-back pipeline.
+The reference's element-bucketed rebuild/migrate-every-100-moves
+(cpp:256-258) becomes an optional periodic sort of the particle axis by
+parent element (config.sort_by_element / migration_period) for gather/scatter
+locality; the host-side pid order of every array contract is preserved via
+the particle-id permutation.
+"""
+from __future__ import annotations
+
+import warnings
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .core.state import ParticleState, make_particle_state, seed_at_element_centroid
+from .core.tally import make_flux, normalize_flux
+from .io.vtk import write_flux_vtk
+from .mesh.core import TetMesh
+from .ops.walk import trace
+from .utils.config import TallyConfig
+from .utils.timing import TallyTimes, phase_timer
+
+
+def _out_param(arr, name: str, expected_dtypes, min_size: int) -> np.ndarray:
+    """Validate an out-param array the way the reference's raw-pointer ABI
+    implies: writable, C-contiguous, correctly typed and sized. Returns a
+    flat view that shares memory with the caller's array."""
+    if not isinstance(arr, np.ndarray):
+        raise TypeError(
+            f"{name} must be a numpy.ndarray (it is written back in place); "
+            f"got {type(arr).__name__}"
+        )
+    if arr.dtype not in [np.dtype(d) for d in expected_dtypes]:
+        raise TypeError(
+            f"{name} must have dtype in {expected_dtypes}, got {arr.dtype}"
+        )
+    if not arr.flags.writeable:
+        raise ValueError(f"{name} must be writable (it is an out-param)")
+    flat = arr.reshape(-1)
+    if flat.size < min_size:
+        raise ValueError(f"{name} must hold {min_size} entries, got {flat.size}")
+    if not np.shares_memory(flat, arr):
+        raise ValueError(
+            f"{name} must be C-contiguous so in-place write-back reaches the "
+            "caller's buffer"
+        )
+    return flat
+
+
+class PumiTally:
+    """Track-length flux tally on an unstructured tet mesh."""
+
+    def __init__(
+        self,
+        mesh: TetMesh | str,
+        num_particles: int,
+        config: TallyConfig | None = None,
+    ):
+        self.config = config or TallyConfig()
+        cfg = self.config
+        self.tally_times = TallyTimes()
+        with phase_timer(
+            self.tally_times, "initialization_time", True
+        ) as timer:
+            if isinstance(mesh, str):
+                from .mesh.io import load_mesh
+
+                mesh = load_mesh(mesh, dtype=cfg.dtype)
+            if mesh.dtype != jnp.dtype(cfg.dtype):
+                raise ValueError(
+                    f"mesh dtype {mesh.dtype} != config dtype {cfg.dtype}"
+                )
+            self.mesh = mesh
+            self.num_particles = int(num_particles)
+            self._max_crossings = cfg.resolve_max_crossings(mesh.ntet)
+            self.state: ParticleState = seed_at_element_centroid(
+                make_particle_state(self.num_particles, dtype=cfg.dtype), mesh
+            )
+            self.flux = make_flux(mesh.ntet, cfg.n_groups, dtype=cfg.dtype)
+            self.iter_count = 0
+            self.total_segments = 0
+            self._initialized = False
+            # Host-order permutation: device slot i holds particle
+            # _perm[i]; None while the layout is still identity.
+            self._perm: np.ndarray | None = None
+            timer.sync((self.state, self.flux))
+
+    # ------------------------------------------------------------------ #
+    def _gather_in(self, host: np.ndarray) -> np.ndarray:
+        """Reorder per-particle host input into device slot order."""
+        return host if self._perm is None else host[self._perm]
+
+    def _check_groups(self, group: np.ndarray) -> None:
+        # The reference hard-asserts group bounds on device (cpp:634-638).
+        if group.size and (
+            group.min() < 0 or group.max() >= self.config.n_groups
+        ):
+            bad = group[(group < 0) | (group >= self.config.n_groups)]
+            raise ValueError(
+                f"energy group indices out of range [0, {self.config.n_groups}): "
+                f"{np.unique(bad)!r}"
+            )
+
+    def _check_finite(self, name: str, arr: np.ndarray) -> None:
+        if self.config.checkify_invariants and not np.isfinite(arr).all():
+            raise ValueError(f"{name} contains non-finite values")
+
+    def _warn_if_truncated(self, done) -> None:
+        n_lost = int(np.sum(~np.asarray(done)))
+        if n_lost:
+            warnings.warn(
+                f"{n_lost} particle walk(s) truncated at max_crossings="
+                f"{self._max_crossings}; tallies for them are incomplete. "
+                "Raise TallyConfig.max_crossings.",
+                RuntimeWarning,
+                stacklevel=3,
+            )
+
+    # ------------------------------------------------------------------ #
+    def initialize_particle_location(
+        self, init_particle_positions: np.ndarray, size: int | None = None
+    ) -> None:
+        """Fly all particles from their current positions (the element-0
+        centroid after construction) to their true source positions to
+        discover parent elements; nothing is tallied
+        (search_initial_elements + search_and_rebuild(initial=True),
+        cpp:360-385, 741-746)."""
+        pos = np.ascontiguousarray(
+            init_particle_positions, dtype=np.float64
+        ).reshape(-1)
+        if size is None:
+            size = pos.size
+        assert size == self.num_particles * 3, (
+            f"expected {self.num_particles * 3} coordinates, got {size}"
+        )
+        self._check_finite("init_particle_positions", pos)
+        with phase_timer(
+            self.tally_times, "initialization_time", True
+        ) as timer:
+            dest_h = self._gather_in(pos[:size].reshape(-1, 3))
+            dest = jnp.asarray(dest_h, dtype=self.config.dtype)
+            s = self.state
+            result = trace(
+                self.mesh,
+                s.origin,
+                dest,
+                s.elem,
+                jnp.ones_like(s.in_flight),
+                s.weight,
+                s.group,
+                s.material_id,
+                self.flux,
+                initial=True,
+                max_crossings=self._max_crossings,
+                score_squares=self.config.score_squares,
+                tolerance=self.config.tolerance,
+            )
+            self.flux = result.flux
+            self.state = s._replace(
+                origin=result.position, dest=dest, elem=result.elem
+            )
+            self._initialized = True
+            self._warn_if_truncated(result.done)
+            if self.config.measure_time:
+                timer.sync(self.state)
+
+    # ------------------------------------------------------------------ #
+    def move_to_next_location(
+        self,
+        particle_destinations: np.ndarray,
+        flying: np.ndarray,
+        weights: np.ndarray,
+        groups: np.ndarray,
+        material_ids: np.ndarray,
+        size: int | None = None,
+    ) -> None:
+        """Advance every in-flight particle to its destination, tally flux,
+        and write the (possibly boundary-clipped) final positions and
+        material ids back into the caller's arrays (cpp:221-264)."""
+        assert self._initialized, (
+            "initialize_particle_location must run before moves"
+        )
+        n = self.num_particles
+        cfg = self.config
+        dest_flat = _out_param(
+            particle_destinations, "particle_destinations", [np.float64], n * 3
+        )
+        if size is None:
+            size = dest_flat.size
+        assert size == n * 3
+        flying_flat = _out_param(flying, "flying", [np.int8], n)
+        mats_flat = _out_param(material_ids, "material_ids", [np.int32], n)
+        weights_h = np.asarray(weights, dtype=np.float64).reshape(-1)[:n]
+        groups_h = np.asarray(groups, dtype=np.int32).reshape(-1)[:n]
+        self._check_groups(groups_h)
+        self._check_finite("particle_destinations", dest_flat)
+        self._check_finite("weights", weights_h)
+
+        with phase_timer(
+            self.tally_times, "total_time_to_tally", True
+        ) as timer:
+            s = self.state
+            dest = jnp.asarray(
+                self._gather_in(dest_flat[: n * 3].reshape(-1, 3)),
+                dtype=cfg.dtype,
+            )
+            in_flight = jnp.asarray(
+                self._gather_in(flying_flat[:n]) != 0
+            )
+            weight = jnp.asarray(self._gather_in(weights_h), dtype=cfg.dtype)
+            group = jnp.asarray(self._gather_in(groups_h), dtype=jnp.int32)
+
+            result = trace(
+                self.mesh,
+                s.origin,
+                dest,
+                s.elem,
+                in_flight,
+                weight,
+                group,
+                s.material_id,
+                self.flux,
+                initial=False,
+                max_crossings=self._max_crossings,
+                score_squares=cfg.score_squares,
+                tolerance=cfg.tolerance,
+            )
+            self.flux = result.flux
+            self.state = s._replace(
+                origin=result.position,
+                dest=dest,
+                in_flight=in_flight,
+                weight=weight,
+                group=group,
+                elem=result.elem,
+                material_id=result.material_id,
+            )
+            self.iter_count += 1
+
+            # Copy-back contract: clipped final positions and material ids
+            # into the caller's arrays (copy_last_location cpp:266-280,
+            # copy_material_ids cpp:282-294); host flying flags reset to 0
+            # (copy_and_reset_flying_flag cpp:316-319).
+            final_pos = np.asarray(result.position, dtype=np.float64)
+            final_mats = np.asarray(result.material_id, dtype=np.int32)
+            if self._perm is None:
+                dest_flat[: n * 3] = final_pos.reshape(-1)
+                mats_flat[:n] = final_mats
+            else:
+                dest_flat[: n * 3].reshape(n, 3)[self._perm] = final_pos
+                mats_flat[:n][self._perm] = final_mats
+            flying_flat[:n] = 0
+            self.total_segments += int(result.n_segments)
+            self._warn_if_truncated(result.done)
+
+            # Periodic locality sort (the migrate-every-100 analog,
+            # cpp:256-258).
+            if (
+                cfg.sort_by_element
+                and self.iter_count % cfg.migration_period == 0
+            ):
+                order = jnp.argsort(self.state.elem)
+                self.state = jax.tree_util.tree_map(
+                    lambda x: x[order], self.state
+                )
+                self._perm = np.asarray(self.state.particle_id)
+            if cfg.measure_time:
+                timer.sync(self.state)
+
+    # ------------------------------------------------------------------ #
+    def normalized_flux(self) -> np.ndarray:
+        """[ntet, n_groups, 3] (mean, second moment, sd) — normalizeFlux
+        parity (cpp:648-683), with the sd NaN guard fix."""
+        return np.asarray(
+            normalize_flux(
+                self.flux,
+                self.mesh.volumes,
+                self.num_particles,
+                max(self.iter_count, 1),
+            )
+        )
+
+    def write_pumi_tally_mesh(self, filename: str | None = None) -> str:
+        """Normalize flux, attach per-group cell fields + volume, write VTK
+        (finalizeAndWritePumiFlux, cpp:685-705), print phase times."""
+        with phase_timer(
+            self.tally_times, "vtk_file_write_time", True
+        ):
+            out = filename or self.config.output_filename
+            write_flux_vtk(out, self.mesh, self.normalized_flux())
+        self.tally_times.print_times()
+        return out
+
+    # ------------------------------------------------------------------ #
+    @property
+    def raw_flux(self) -> np.ndarray:
+        """Unnormalized [ntet, n_groups, 2] (Σ w·len, Σ (w·len)²)."""
+        return np.asarray(self.flux)
+
+    @property
+    def element_ids(self) -> np.ndarray:
+        """Current parent element per particle, in host pid order (tracer
+        getElementIds parity, test_pumi_tally_impl_methods.cpp:153-159)."""
+        elems = np.asarray(self.state.elem)
+        if self._perm is None:
+            return elems
+        out = np.empty_like(elems)
+        out[self._perm] = elems
+        return out

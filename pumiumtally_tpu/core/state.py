@@ -1,0 +1,59 @@
+"""Particle state as flat SoA device arrays.
+
+TPU-native replacement for the reference's 6-member Pumi-PIC/Cabana AoSoA
+particle structure (PPParticle typedef, pumipic_particle_data_structure
+.cpp:41-45: 0-origin, 1-destination, 2-id, 3-in-flight flag, 4-weight,
+5-energy-group) plus the handler-side per-particle arrays (prev_xpoint_,
+material_ids_, cpp:104-106). Element-bucketing and rebuild/migrate are
+replaced by flat arrays with an optional periodic sort-by-element
+(SURVEY.md §7 idiom table).
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class ParticleState(NamedTuple):
+    origin: jax.Array       # [n, 3]
+    dest: jax.Array         # [n, 3]
+    particle_id: jax.Array  # [n] int32
+    in_flight: jax.Array    # [n] bool
+    weight: jax.Array       # [n]
+    group: jax.Array        # [n] int32
+    elem: jax.Array         # [n] int32 parent element
+    material_id: jax.Array  # [n] int32
+
+    @property
+    def capacity(self) -> int:
+        return self.origin.shape[0]
+
+
+def make_particle_state(n: int, dtype=jnp.float32) -> ParticleState:
+    return ParticleState(
+        origin=jnp.zeros((n, 3), dtype=dtype),
+        dest=jnp.zeros((n, 3), dtype=dtype),
+        particle_id=jnp.arange(n, dtype=jnp.int32),
+        in_flight=jnp.ones((n,), dtype=bool),
+        weight=jnp.zeros((n,), dtype=dtype),
+        group=jnp.zeros((n,), dtype=jnp.int32),
+        elem=jnp.zeros((n,), dtype=jnp.int32),
+        material_id=jnp.full((n,), -1, dtype=jnp.int32),
+    )
+
+
+def seed_at_element_centroid(
+    state: ParticleState, mesh, elem_id: int = 0
+) -> ParticleState:
+    """Seed every particle at the centroid of one element (the reference
+    starts all particles at element 0's centroid so the initial search can
+    walk them to their true source positions, cpp:827-863)."""
+    centroid = jnp.mean(mesh.coords[mesh.tet2vert[elem_id]], axis=0)
+    n = state.capacity
+    return state._replace(
+        origin=jnp.broadcast_to(centroid, (n, 3)).astype(state.origin.dtype),
+        elem=jnp.full((n,), elem_id, dtype=jnp.int32),
+        in_flight=jnp.ones((n,), dtype=bool),
+    )

@@ -1,0 +1,39 @@
+"""Flux tally accumulator: allocation, normalization, finalization.
+
+Replaces PumiParticleAtElemBoundary's flux bookkeeping
+(pumipic_particle_data_structure.cpp:517-524 allocation,
+cpp:648-683 normalizeFlux). The accumulator is [ntet, n_groups, 2]
+holding (Σ w·len, Σ (w·len)^2); the standard-deviation slot the reference
+stores at index 2 is derived at finalization time instead of carried.
+
+The reference's sd formula is flagged incorrect in its own source
+("FIXME this is not correct, needs number of iterations", cpp:673-677) and
+can produce sqrt of a negative value; here it is guarded and divided by the
+move/batch count when provided (the fix the in-code FIXME asks for).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def make_flux(ntet: int, n_groups: int, dtype=jnp.float32) -> jax.Array:
+    return jnp.zeros((ntet, n_groups, 2), dtype=dtype)
+
+
+@jax.jit
+def normalize_flux(flux, volumes, n_particles, n_iterations=1):
+    """Normalize raw tallies by element volume and particle count.
+
+    Mirrors normalizeFlux (cpp:660-677): slot 0 /= vol·N, slot 1 /= vol²·N,
+    then sd = sqrt(max(m2 − m1², 0) / max(iters, 1)).
+
+    Returns [ntet, n_groups, 3]: (mean flux, second moment, sd).
+    """
+    vol = volumes[:, None]
+    n = jnp.asarray(n_particles, flux.dtype)
+    m1 = flux[..., 0] / (vol * n)
+    m2 = flux[..., 1] / (vol * vol * n)
+    iters = jnp.maximum(jnp.asarray(n_iterations, flux.dtype), 1.0)
+    sd = jnp.sqrt(jnp.maximum(m2 - m1 * m1, 0.0) / iters)
+    return jnp.stack([m1, m2, sd], axis=-1)

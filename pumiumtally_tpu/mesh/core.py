@@ -1,0 +1,202 @@
+"""Tetrahedral mesh as a pytree of device arrays.
+
+TPU-native replacement for the Omega_h mesh core consumed by the reference
+(SURVEY.md §2b): coordinates, region→vertex downward adjacency
+(`ask_down(REGION, VERT)`), face→region upward adjacency (`ask_up(dim-1, dim)`,
+pumipic_particle_data_structure.cpp:415), the `class_id` region tag
+(cpp:463), and simplex volumes (`simplex_basis`/`simplex_size_from_basis`,
+cpp:665-666).
+
+Instead of computing face geometry per crossing from gathered vertices (the
+reference gathers `gather_verts<4>`/`gather_vectors<4,3>` inside kernels),
+we precompute per-tet face *planes* — outward unit-scaled normals and offsets —
+so the hot walk is four fused multiply-adds per face with no vertex
+indirection. Face ``f`` of a tet is the face opposite local vertex ``f``.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Local vertex triples of the face opposite each local vertex.
+FACE_LOCAL_VERTS = np.array(
+    [[1, 2, 3], [0, 2, 3], [0, 1, 3], [0, 1, 2]], dtype=np.int64
+)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass(frozen=True)
+class TetMesh:
+    """Device-resident unstructured tetrahedral mesh.
+
+    Attributes:
+      coords: [nverts, 3] vertex coordinates.
+      tet2vert: [ntet, 4] element→vertex connectivity (positively oriented).
+      tet2tet: [ntet, 4] neighbor element across face f (-1 = domain boundary).
+        Replaces Omega_h's ask_up(dim-1, dim) face→elem traversal.
+      class_id: [ntet] geometric region id per element (material region tag;
+        reference requires this tag at mesh load, cpp:904-906).
+      face_normals: [ntet, 4, 3] outward (non-unit) face normals.
+      face_d: [ntet, 4] plane offsets; a point x is outside face f when
+        dot(n_f, x) > d_f.
+      volumes: [ntet] positive tet volumes.
+    """
+
+    coords: jax.Array
+    tet2vert: jax.Array
+    tet2tet: jax.Array
+    class_id: jax.Array
+    face_normals: jax.Array
+    face_d: jax.Array
+    volumes: jax.Array
+
+    # -- pytree protocol ----------------------------------------------------
+    def tree_flatten(self):
+        children = (
+            self.coords,
+            self.tet2vert,
+            self.tet2tet,
+            self.class_id,
+            self.face_normals,
+            self.face_d,
+            self.volumes,
+        )
+        return children, None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    # -- properties ---------------------------------------------------------
+    @property
+    def ntet(self) -> int:
+        return int(self.tet2vert.shape[0])
+
+    @property
+    def nverts(self) -> int:
+        return int(self.coords.shape[0])
+
+    @property
+    def dtype(self):
+        return self.coords.dtype
+
+    def centroids(self) -> jax.Array:
+        """Element centroids (average of the 4 vertices; the reference seeds
+        all particles at the centroid of element 0, cpp:835-844)."""
+        return jnp.mean(self.coords[self.tet2vert], axis=1)
+
+    # -- construction -------------------------------------------------------
+    @classmethod
+    def from_numpy(
+        cls,
+        coords: np.ndarray,
+        tet2vert: np.ndarray,
+        class_id: np.ndarray | None = None,
+        dtype: Any = jnp.float32,
+    ) -> "TetMesh":
+        """Build all derived tables on host (float64 numpy for precision),
+        then place them on device in the requested dtype."""
+        coords = np.asarray(coords, dtype=np.float64)
+        tet2vert = np.asarray(tet2vert, dtype=np.int64)
+        ntet = tet2vert.shape[0]
+        if class_id is None:
+            class_id = np.zeros(ntet, dtype=np.int32)
+        class_id = np.asarray(class_id, dtype=np.int32)
+
+        tet2vert = _canonicalize_orientation(coords, tet2vert)
+        volumes = _tet_volumes(coords, tet2vert)
+        normals, d = _face_planes(coords, tet2vert)
+        tet2tet = build_tet2tet(tet2vert)
+
+        put = lambda a, dt: jnp.asarray(a, dtype=dt)
+        return cls(
+            coords=put(coords, dtype),
+            tet2vert=put(tet2vert, jnp.int32),
+            tet2tet=put(tet2tet, jnp.int32),
+            class_id=put(class_id, jnp.int32),
+            face_normals=put(normals, dtype),
+            face_d=put(d, dtype),
+            volumes=put(volumes, dtype),
+        )
+
+
+def _canonicalize_orientation(coords: np.ndarray, tet2vert: np.ndarray) -> np.ndarray:
+    """Ensure det(v1-v0, v2-v0, v3-v0) > 0 for every tet by swapping the last
+    two vertices of negatively oriented tets."""
+    v = coords[tet2vert]  # [nt, 4, 3]
+    det = np.einsum(
+        "ij,ij->i",
+        v[:, 1] - v[:, 0],
+        np.cross(v[:, 2] - v[:, 0], v[:, 3] - v[:, 0]),
+    )
+    flipped = tet2vert.copy()
+    neg = det < 0
+    flipped[neg, 2], flipped[neg, 3] = tet2vert[neg, 3], tet2vert[neg, 2]
+    return flipped
+
+
+def _tet_volumes(coords: np.ndarray, tet2vert: np.ndarray) -> np.ndarray:
+    v = coords[tet2vert]
+    det = np.einsum(
+        "ij,ij->i",
+        v[:, 1] - v[:, 0],
+        np.cross(v[:, 2] - v[:, 0], v[:, 3] - v[:, 0]),
+    )
+    return det / 6.0
+
+
+def _face_planes(coords: np.ndarray, tet2vert: np.ndarray):
+    """Outward face normals and plane offsets for each of the 4 faces.
+
+    Normal orientation is fixed by requiring the opposite vertex to lie on the
+    negative side (inside), so no assumption about input ordering is needed.
+    """
+    v = coords[tet2vert]  # [nt, 4, 3]
+    nt = tet2vert.shape[0]
+    normals = np.empty((nt, 4, 3), dtype=np.float64)
+    d = np.empty((nt, 4), dtype=np.float64)
+    for f in range(4):
+        a, b, c = (v[:, i] for i in FACE_LOCAL_VERTS[f])
+        n = np.cross(b - a, c - a)
+        opp = v[:, f]
+        flip = np.einsum("ij,ij->i", n, opp - a) > 0
+        n[flip] = -n[flip]
+        # Scale-normalize so the tolerance is a geometric distance regardless
+        # of element size.
+        norm = np.linalg.norm(n, axis=1, keepdims=True)
+        norm = np.where(norm == 0.0, 1.0, norm)
+        n = n / norm
+        normals[:, f] = n
+        d[:, f] = np.einsum("ij,ij->i", n, a)
+    return normals, d
+
+
+def build_tet2tet(tet2vert: np.ndarray) -> np.ndarray:
+    """Face-adjacency table: neighbor across the face opposite local vertex f,
+    -1 on domain boundary.
+
+    Vectorized face matching via lexicographic sort of sorted vertex triples
+    (the equivalent of Omega_h's ask_up(dim-1, dim) two-sided face list,
+    cpp:415-433, built once on host instead of traversed per crossing).
+    """
+    nt = tet2vert.shape[0]
+    faces = tet2vert[:, FACE_LOCAL_VERTS]  # [nt, 4, 3]
+    faces = np.sort(faces.reshape(nt * 4, 3), axis=1)
+    owner = np.repeat(np.arange(nt, dtype=np.int64), 4)
+    local = np.tile(np.arange(4, dtype=np.int64), nt)
+
+    order = np.lexsort((faces[:, 2], faces[:, 1], faces[:, 0]))
+    fs = faces[order]
+    os_, ls = owner[order], local[order]
+
+    tet2tet = np.full((nt, 4), -1, dtype=np.int64)
+    same = np.all(fs[1:] == fs[:-1], axis=1)
+    i = np.nonzero(same)[0]
+    # Interior faces appear exactly twice; pair i with i+1.
+    tet2tet[os_[i], ls[i]] = os_[i + 1]
+    tet2tet[os_[i + 1], ls[i + 1]] = os_[i]
+    return tet2tet

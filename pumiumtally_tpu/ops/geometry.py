@@ -1,0 +1,71 @@
+"""Vectorized tet geometry primitives (jnp, vmap/jit friendly).
+
+TPU-native replacement for the pumipic adjacency geometry the reference
+consumes (SURVEY.md §2b: ray–tet-face intersection with tolerance 1e-8,
+exit-face determination; pumipic_adjacency.hpp via
+pumipic_particle_data_structure.cpp:10-11, 467-468).
+
+All predicates are expressed against precomputed face planes
+(TetMesh.face_normals / face_d) rather than per-crossing vertex gathers:
+a point x is outside face f of tet e iff dot(n[e,f], x) > d[e,f].
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def face_signed_distance(mesh, elem, x):
+    """Signed distance of points x [n,3] to the 4 face planes of their tets
+    elem [n] → [n,4]; positive = outside."""
+    n = mesh.face_normals[elem]  # [n,4,3]
+    d = mesh.face_d[elem]  # [n,4]
+    return jnp.einsum("pfc,pc->pf", n, x) - d
+
+
+def point_in_tet(mesh, elem, x, tol):
+    """True where x lies inside (or within tol of) tet elem."""
+    return jnp.all(face_signed_distance(mesh, elem, x) <= tol, axis=-1)
+
+
+def locate_points(mesh, x, tol):
+    """Brute-force point location: element containing each point (argmin of
+    worst face violation), or -1 if outside every element.
+
+    O(ntet · npoints); intended for tests and host-side seeding, not the hot
+    path (the hot path locates by walking, like the reference's initial
+    search, cpp:360-385).
+    """
+    # [ntet, n, 4]: signed distance of every point to every tet's faces.
+    sd = (
+        jnp.einsum("tfc,pc->tpf", mesh.face_normals, x)
+        - mesh.face_d[:, None, :]
+    )
+    worst = jnp.max(sd, axis=-1)  # [ntet, n]
+    best_elem = jnp.argmin(worst, axis=0)  # [n]
+    best_val = jnp.min(worst, axis=0)
+    return jnp.where(best_val <= tol, best_elem, -1)
+
+
+def exit_face(normals, d, cur, dirv):
+    """Exit crossing of rays r(t) = cur + t*dirv, t ∈ [0, 1], out of tets
+    described by face planes (normals [n,4,3], d [n,4]).
+
+    Haines' ray/convex-polyhedron clipping specialized to tets: among faces
+    with dot(n_f, dirv) > 0 (the ray is heading out through them), the exit is
+    the one with minimal plane parameter t_f. Entry faces (negative
+    denominator) and grazing-parallel faces never qualify, which makes the
+    walk immune to re-crossing the face it just entered through.
+
+    Returns (t_exit [n], face [n], has_exit [n] bool). t_exit is clamped to
+    [0, inf); has_exit is False when no face is exited (destination inside,
+    or zero-length ray).
+    """
+    denom = jnp.einsum("pfc,pc->pf", normals, dirv)  # [n,4]
+    num = d - jnp.einsum("pfc,pc->pf", normals, cur)  # [n,4]
+    inf = jnp.asarray(jnp.inf, dtype=cur.dtype)
+    t = jnp.where(denom > 0, num / jnp.where(denom > 0, denom, 1), inf)
+    t = jnp.maximum(t, 0.0)
+    t_exit = jnp.min(t, axis=-1)
+    face = jnp.argmin(t, axis=-1).astype(jnp.int32)
+    has_exit = jnp.isfinite(t_exit)
+    return t_exit, face, has_exit

@@ -1,0 +1,65 @@
+"""Per-phase wall-clock accumulators (TallyTimes parity).
+
+Mirrors the reference's TallyTimes struct and its facade-level chrono
+wrappers (pumipic_particle_data_structure.cpp:19-35, 923-957). Device work
+is asynchronous under JAX exactly as under CUDA, so — like the reference's
+PUMI_MEASURE_TIME-guarded Kokkos::fence() (cpp:216-218, 259-261) — timed
+sections call jax.block_until_ready only when measurement is enabled.
+"""
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import time
+
+import jax
+
+
+@dataclasses.dataclass
+class TallyTimes:
+    initialization_time: float = 0.0
+    total_time_to_tally: float = 0.0
+    vtk_file_write_time: float = 0.0
+
+    def print_times(self) -> None:
+        total = (
+            self.initialization_time
+            + self.total_time_to_tally
+            + self.vtk_file_write_time
+        )
+        print()
+        print(f"[TIME] Initialization time     : {self.initialization_time:f} seconds")
+        print(f"[TIME] Total time to tally     : {self.total_time_to_tally:f} seconds")
+        print(f"[TIME] VTK file write time     : {self.vtk_file_write_time:f} seconds")
+        print(f"[TIME] Total PumiPic time      : {total:f} seconds")
+
+
+class phase_timer(contextlib.AbstractContextManager):
+    """Accumulate elapsed wall-clock into ``times.<field>``; when enabled,
+    call .sync(x) inside the block to register device output to block on
+    before the clock is read (the PUMI_MEASURE_TIME Kokkos::fence analog)."""
+
+    def __init__(self, times: TallyTimes, field: str, enabled: bool):
+        self._times, self._field, self._enabled = times, field, enabled
+        self._sync = None
+
+    def sync(self, x):
+        self._sync = x
+        return x
+
+    def __enter__(self):
+        if self._enabled:
+            self._start = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        if self._enabled:
+            if self._sync is not None:
+                jax.block_until_ready(self._sync)
+            setattr(
+                self._times,
+                self._field,
+                getattr(self._times, self._field)
+                + (time.perf_counter() - self._start),
+            )
+        return False

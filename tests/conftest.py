@@ -1,0 +1,16 @@
+"""Test harness setup: force the CPU platform with 8 virtual devices (the
+multi-chip sharding tests run on a fake mesh, SURVEY.md §5 distributed notes)
+and enable float64 so the reference's 1e-8 analytic oracles port literally
+(test_pumi_tally_impl_methods.cpp:22)."""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    )
+
+import jax
+
+jax.config.update("jax_enable_x64", True)

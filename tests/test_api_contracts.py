@@ -1,0 +1,134 @@
+"""Facade contract tests: out-param validation, group-bounds rejection,
+walk-truncation reporting, element-sort layout transparency, legacy VTK."""
+import warnings
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pumiumtally_tpu import PumiTally, TallyConfig, build_box
+
+
+def _mk(n=3, **cfg_kw):
+    cfg = TallyConfig(dtype=jnp.float64, **cfg_kw)
+    t = PumiTally(build_box(dtype=jnp.float64), n, cfg)
+    pos = np.tile([0.5, 0.6, 0.4], n)
+    t.initialize_particle_location(pos, pos.size)
+    return t
+
+
+def _move_args(n, dest_xyz=(0.6, 0.6, 0.4)):
+    return (
+        np.tile(np.asarray(dest_xyz, dtype=np.float64), n),
+        np.ones(n, dtype=np.int8),
+        np.ones(n),
+        np.zeros(n, dtype=np.int32),
+        np.zeros(n, dtype=np.int32),
+    )
+
+
+def test_out_params_must_be_ndarrays():
+    t = _mk()
+    dest, flying, w, g, m = _move_args(3)
+    with pytest.raises(TypeError, match="flying"):
+        t.move_to_next_location(dest, [1, 1, 1], w, g, m, dest.size)
+    with pytest.raises(TypeError, match="particle_destinations"):
+        t.move_to_next_location(dest.tolist(), flying, w, g, m, dest.size)
+    with pytest.raises(TypeError, match="material_ids"):
+        t.move_to_next_location(
+            dest, flying, w, g, m.astype(np.int64), dest.size
+        )
+
+
+def test_non_contiguous_out_param_rejected():
+    t = _mk()
+    dest, flying, w, g, m = _move_args(3)
+    big = np.zeros((6, 4))
+    strided = big[::2, :3]  # 3x3 view that reshape(-1) cannot flatten in place
+    with pytest.raises(ValueError, match="contiguous"):
+        t.move_to_next_location(strided, flying, w, g, m, 9)
+
+
+def test_group_out_of_range_rejected():
+    # The reference hard-asserts group bounds on device (cpp:634-638).
+    t = _mk()
+    dest, flying, w, _, m = _move_args(3)
+    bad = np.array([0, 5, 0], dtype=np.int32)
+    with pytest.raises(ValueError, match="energy group"):
+        t.move_to_next_location(dest, flying, w, bad, m, dest.size)
+    bad = np.array([0, -1, 0], dtype=np.int32)
+    with pytest.raises(ValueError, match="energy group"):
+        t.move_to_next_location(dest, flying, w, bad, m, dest.size)
+
+
+def test_truncated_walk_warns():
+    # An anisotropic 40x1x1 box with a max_crossings too small for the long
+    # axis: the walk must report truncation, not silently stop mid-domain.
+    cfg = TallyConfig(dtype=jnp.float64, max_crossings=8)
+    mesh = build_box(40.0, 1.0, 1.0, 40, 1, 1, dtype=jnp.float64)
+    t = PumiTally(mesh, 1, cfg)
+    t.initialize_particle_location(np.array([0.05, 0.4, 0.5]), 3)
+    dest, flying, w, g, m = _move_args(1, dest_xyz=(39.95, 0.4, 0.5))
+    with pytest.warns(RuntimeWarning, match="truncated"):
+        t.move_to_next_location(dest, flying, w, g, m, dest.size)
+
+
+def test_default_max_crossings_handles_long_anisotropic_mesh():
+    mesh = build_box(40.0, 1.0, 1.0, 40, 1, 1, dtype=jnp.float64)
+    t = PumiTally(mesh, 1, TallyConfig(dtype=jnp.float64))
+    t.initialize_particle_location(np.array([0.05, 0.4, 0.5]), 3)
+    dest, flying, w, g, m = _move_args(1, dest_xyz=(39.95, 0.4, 0.5))
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        t.move_to_next_location(dest, flying, w, g, m, dest.size)
+    # Full track length scored.
+    assert t.raw_flux[:, 0, 0].sum() == pytest.approx(39.9, abs=1e-8)
+    np.testing.assert_allclose(
+        dest.reshape(1, 3), [[39.95, 0.4, 0.5]], atol=1e-8
+    )
+
+
+def test_sort_by_element_preserves_host_order():
+    # Same random walk with and without the locality sort: identical host
+    # observables (the migrate analog must be invisible to the caller).
+    n = 16
+    rng = np.random.default_rng(3)
+    starts = rng.uniform(0.1, 0.9, (n, 3))
+
+    results = []
+    for sort in (False, True):
+        t = _mk(n=n, sort_by_element=sort, migration_period=1)
+        t.initialize_particle_location(starts.ravel().copy(), n * 3)
+        prev = starts.copy()
+        for step in range(4):
+            step_rng = np.random.default_rng(100 + step)
+            dest = prev + step_rng.normal(scale=0.3, size=(n, 3))
+            buf = np.ascontiguousarray(dest.ravel())
+            flying = np.ones(n, dtype=np.int8)
+            mats = np.zeros(n, dtype=np.int32)
+            t.move_to_next_location(
+                buf,
+                flying,
+                np.ones(n),
+                np.zeros(n, np.int32),
+                mats,
+                buf.size,
+            )
+            prev = buf.reshape(n, 3).copy()
+        results.append(
+            (prev, t.element_ids.copy(), t.raw_flux.copy())
+        )
+    np.testing.assert_allclose(results[0][0], results[1][0], atol=1e-12)
+    np.testing.assert_array_equal(results[0][1], results[1][1])
+    np.testing.assert_allclose(results[0][2], results[1][2], atol=1e-12)
+
+
+def test_legacy_vtk_extension_writes_legacy_format(tmp_path):
+    t = _mk()
+    dest, flying, w, g, m = _move_args(3)
+    t.move_to_next_location(dest, flying, w, g, m, dest.size)
+    out = t.write_pumi_tally_mesh(str(tmp_path / "fluxresult.vtk"))
+    head = open(out).readline()
+    assert head.startswith("# vtk DataFile")
+    text = open(out).read()
+    assert "flux_group_0" in text and "CELL_DATA" in text

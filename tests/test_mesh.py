@@ -1,0 +1,115 @@
+"""Mesh core tests: box generator connectivity against the reference's
+analytic 6-tet oracle (test_pumi_tally_impl_methods.cpp:31-110), adjacency
+invariants, volumes."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pumiumtally_tpu.mesh.box import build_box, build_box_arrays
+from pumiumtally_tpu.mesh.core import TetMesh, build_tet2tet
+from pumiumtally_tpu.ops.geometry import locate_points, point_in_tet
+
+
+@pytest.fixture(scope="module")
+def unit_box():
+    return build_box(dtype=jnp.float64)
+
+
+def test_unit_box_counts(unit_box):
+    # Omega_h build_box(1,1,1,1,1,1): 8 vertices, 6 tets (test:70-71).
+    assert unit_box.nverts == 8
+    assert unit_box.ntet == 6
+
+
+def test_unit_box_volumes(unit_box):
+    vols = np.asarray(unit_box.volumes)
+    np.testing.assert_allclose(vols, 1.0 / 6.0, atol=1e-12)
+    assert vols.sum() == pytest.approx(1.0, abs=1e-12)
+
+
+def test_elem0_centroid_matches_reference(unit_box):
+    # The reference seeds particles at elem 0's centroid (0.5, 0.75, 0.25)
+    # (test:84); this pins the build_box element ordering.
+    c = np.asarray(unit_box.centroids())
+    np.testing.assert_allclose(c[0], [0.5, 0.75, 0.25], atol=1e-12)
+
+
+def test_oracle_point_locations(unit_box):
+    # Parent elements asserted by the reference white-box test:
+    # (0.1,0.4,0.5) in elem 2 (test:158); the +x ray spans elems 2,3,4
+    # (test:282-284); (0.15,0.05,0.2) in 3, (0.85,0.05,0.1) in 4
+    # (test:361-365).
+    pts = jnp.asarray(
+        [
+            [0.1, 0.4, 0.5],
+            [0.45, 0.4, 0.5],
+            [0.7, 0.4, 0.5],
+            [0.15, 0.05, 0.2],
+            [0.85, 0.05, 0.1],
+        ],
+        dtype=jnp.float64,
+    )
+    elems = np.asarray(locate_points(unit_box, pts, tol=1e-12))
+    np.testing.assert_array_equal(elems, [2, 3, 4, 3, 4])
+
+
+def test_outside_point_not_located(unit_box):
+    pts = jnp.asarray([[1.5, 0.5, 0.5], [-0.1, 0.2, 0.2]], dtype=jnp.float64)
+    elems = np.asarray(locate_points(unit_box, pts, tol=1e-12))
+    np.testing.assert_array_equal(elems, [-1, -1])
+
+
+def test_point_in_tet(unit_box):
+    pts = jnp.asarray([[0.1, 0.4, 0.5]], dtype=jnp.float64)
+    assert bool(point_in_tet(unit_box, jnp.asarray([2]), pts, 1e-12)[0])
+    assert not bool(point_in_tet(unit_box, jnp.asarray([0]), pts, 1e-12)[0])
+
+
+def test_unit_box_boundary_faces(unit_box):
+    # A cube's surface triangulates into 12 boundary faces; the 6 interior
+    # face-pairs must be mutual.
+    t2t = np.asarray(unit_box.tet2tet)
+    assert (t2t == -1).sum() == 12
+    for e in range(6):
+        for f in range(4):
+            nb = t2t[e, f]
+            if nb >= 0:
+                assert e in t2t[nb]
+
+
+@pytest.mark.parametrize("dims", [(2, 2, 2), (3, 1, 2)])
+def test_multicell_box(dims):
+    nx, ny, nz = dims
+    mesh = build_box(2.0, 1.0, 1.5, nx, ny, nz, dtype=jnp.float64)
+    assert mesh.ntet == 6 * nx * ny * nz
+    np.testing.assert_allclose(
+        np.asarray(mesh.volumes).sum(), 2.0 * 1.0 * 1.5, atol=1e-10
+    )
+    t2t = np.asarray(mesh.tet2tet)
+    # Mutual adjacency everywhere.
+    for e in range(mesh.ntet):
+        for f in range(4):
+            nb = t2t[e, f]
+            if nb >= 0:
+                assert e in t2t[nb]
+    # Every point interior to the box is locatable.
+    rng = np.random.default_rng(0)
+    pts = rng.uniform(0.05, 0.95, size=(50, 3)) * np.array([2.0, 1.0, 1.5])
+    elems = np.asarray(locate_points(mesh, jnp.asarray(pts), tol=1e-12))
+    assert (elems >= 0).all()
+
+
+def test_orientation_canonicalization():
+    coords, tet2vert = build_box_arrays()
+    # Scramble vertex order of each tet; volumes must still come out positive.
+    rng = np.random.default_rng(1)
+    scrambled = np.stack(
+        [tet2vert[i, rng.permutation(4)] for i in range(len(tet2vert))]
+    )
+    mesh = TetMesh.from_numpy(coords, scrambled, dtype=jnp.float64)
+    assert (np.asarray(mesh.volumes) > 0).all()
+    # Adjacency is permutation-invariant.
+    ref = build_tet2tet(tet2vert)
+    got = np.asarray(mesh.tet2tet)
+    for e in range(6):
+        assert set(got[e]) == set(ref[e])

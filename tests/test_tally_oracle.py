@@ -1,0 +1,168 @@
+"""Port of the reference's white-box integration test to the TPU-native
+framework: same 6-tet unit box, same 5 particles, same rays, same expected
+fluxes at 1e-8 (test_pumi_tally_impl_methods.cpp:31-401). This is the
+minimum end-to-end acceptance gate (SURVEY.md §7 stage 5)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pumiumtally_tpu import PumiTally, TallyConfig, build_box
+
+NUM = 5
+TOL = 1e-8
+
+
+@pytest.fixture()
+def tally():
+    mesh = build_box(dtype=jnp.float64)
+    return PumiTally(mesh, NUM, TallyConfig(dtype=jnp.float64))
+
+
+def _init(tally):
+    pos = np.tile([0.1, 0.4, 0.5], NUM)
+    tally.initialize_particle_location(pos, pos.size)
+    return tally
+
+
+def _move1(tally):
+    dest = np.tile([1.2, 0.4, 0.5], NUM)
+    flying = np.ones(NUM, dtype=np.int8)
+    weights = np.ones(NUM)
+    groups = np.zeros(NUM, dtype=np.int32)
+    mats = np.zeros(NUM, dtype=np.int32)
+    tally.move_to_next_location(dest, flying, weights, groups, mats, dest.size)
+    return dest, flying, mats
+
+
+def test_ctor_invariants(tally):
+    # Buffer/particle-structure invariants (test:60-80).
+    assert tally.state.capacity == NUM
+    assert tally.mesh.ntet == 6
+    assert tally.raw_flux.shape == (6, 2, 2)
+    # All particles seeded at elem 0's centroid (test:82-110).
+    origins = np.asarray(tally.state.origin)
+    np.testing.assert_allclose(
+        origins, np.tile([0.5, 0.75, 0.25], (NUM, 1)), atol=TOL
+    )
+    np.testing.assert_array_equal(tally.element_ids, 0)
+
+
+def test_initial_search_lands_in_elem2_without_tallying(tally):
+    _init(tally)
+    # All particles reached element 2 (test:152-159).
+    np.testing.assert_array_equal(tally.element_ids, 2)
+    # Initial search must not tally (test:161-170).
+    np.testing.assert_allclose(tally.raw_flux, 0.0, atol=TOL)
+    # Particles now sit at their source positions.
+    np.testing.assert_allclose(
+        np.asarray(tally.state.origin),
+        np.tile([0.1, 0.4, 0.5], (NUM, 1)),
+        atol=TOL,
+    )
+
+
+def test_move_crosses_2_3_4_and_clips_at_domain_boundary(tally):
+    _init(tally)
+    dest, flying, mats = _move1(tally)
+
+    # Particles stop in element 4 (test:224-231).
+    np.testing.assert_array_equal(tally.element_ids, 4)
+    # Destination clipped to the x=1 domain face (test:233-254 and its
+    # in-source fixme: the new position must be 1.0, not 1.1/1.2).
+    np.testing.assert_allclose(
+        dest.reshape(NUM, 3), np.tile([1.0, 0.4, 0.5], (NUM, 1)), atol=TOL
+    )
+    # Host flying flags reset to 0 (test:203-212 / cpp:316-319).
+    np.testing.assert_array_equal(flying, 0)
+    # Domain exit reports material -1 (cpp:480-482).
+    np.testing.assert_array_equal(mats, -1)
+
+    # Segment lengths 0.3 / 0.1 / 0.5 in elements 2 / 3 / 4, ×5 particles
+    # (test:270-286).
+    flux = tally.raw_flux
+    expected = np.zeros(6)
+    expected[2], expected[3], expected[4] = 0.3 * NUM, 0.1 * NUM, 0.5 * NUM
+    np.testing.assert_allclose(flux[:, 0, 0], expected, atol=TOL)
+    # Untouched group stays zero.
+    np.testing.assert_allclose(flux[:, 1, :], 0.0, atol=TOL)
+    # Squared-contribution slot accumulates per-segment (w·len)^2
+    # (cpp:640-642).
+    expected_sq = np.zeros(6)
+    expected_sq[2], expected_sq[3], expected_sq[4] = (
+        0.09 * NUM,
+        0.01 * NUM,
+        0.25 * NUM,
+    )
+    np.testing.assert_allclose(flux[:, 0, 1], expected_sq, atol=TOL)
+
+
+def test_second_move_accumulates_heterogeneous_weights(tally):
+    _init(tally)
+    _move1(tally)
+
+    # Particles 0 and 2 fly from (1.0, 0.4, 0.5) with weights 2.0 and 0.5;
+    # the rest are parked (test:288-326).
+    dest = np.tile([1.0, 0.4, 0.5], (NUM, 1))
+    dest[0] = [0.15, 0.05, 0.20]
+    dest[2] = [0.85, 0.05, 0.10]
+    flying = np.zeros(NUM, dtype=np.int8)
+    flying[0] = flying[2] = 1
+    weights = np.ones(NUM)
+    weights[0], weights[2] = 2.0, 0.5
+    groups = np.zeros(NUM, dtype=np.int32)
+    mats = np.zeros(NUM, dtype=np.int32)
+    flat = dest.reshape(-1).copy()
+    tally.move_to_next_location(flat, flying, weights, groups, mats, flat.size)
+
+    # New origins equal the requested destinations (test:329-352).
+    np.testing.assert_allclose(flat.reshape(NUM, 3), dest, atol=TOL)
+    # Parent elements {3, 4, 4, 4, 4} (test:354-366).
+    np.testing.assert_array_equal(tally.element_ids, [3, 4, 4, 4, 4])
+
+    # Flux accumulation against the reference's hand-computed segments
+    # (test:368-399): particle 0 contributes 0.8790… in 4 and 0.0879… in 3;
+    # particle 2 contributes 0.5522… in 4.
+    flux = tally.raw_flux
+    expected = np.zeros(6)
+    expected[2] = 0.3 * NUM
+    expected[3] = 0.1 * NUM + 0.08790490988459178 * 2.0
+    expected[4] = (
+        0.5 * NUM + 0.879049070406094 * 2.0 + 0.552268050859363 * 0.5
+    )
+    np.testing.assert_allclose(flux[:, 0, 0], expected, atol=TOL)
+
+
+def test_normalization_and_vtk(tally, tmp_path):
+    _init(tally)
+    _move1(tally)
+    norm = tally.normalized_flux()
+    # Volume normalization: flux / (vol * N) with vol = 1/6 (cpp:660-677).
+    vol = 1.0 / 6.0
+    assert norm[2, 0, 0] == pytest.approx(0.3 * NUM / (vol * NUM), abs=TOL)
+    assert norm[4, 0, 0] == pytest.approx(0.5 * NUM / (vol * NUM), abs=TOL)
+    # sd slot is finite (the reference's formula NaNs, flagged in-code at
+    # cpp:673-677; ours is guarded).
+    assert np.isfinite(norm[..., 2]).all()
+
+    out = tally.write_pumi_tally_mesh(str(tmp_path / "fluxresult.vtu"))
+    text = open(out).read()
+    assert "flux_group_0" in text and "flux_group_1" in text
+    assert "volume" in text
+
+
+def test_parked_particles_keep_position_and_material(tally):
+    _init(tally)
+    _move1(tally)
+    # All parked: nothing moves, nothing tallies.
+    before = tally.raw_flux.copy()
+    dest = np.tile([0.5, 0.5, 0.5], NUM)  # ignored for parked particles
+    flying = np.zeros(NUM, dtype=np.int8)
+    mats = np.full(NUM, 7, dtype=np.int32)
+    tally.move_to_next_location(
+        dest, flying, np.ones(NUM), np.zeros(NUM, np.int32), mats, dest.size
+    )
+    np.testing.assert_allclose(
+        dest.reshape(NUM, 3), np.tile([1.0, 0.4, 0.5], (NUM, 1)), atol=TOL
+    )
+    np.testing.assert_array_equal(tally.element_ids, 4)
+    np.testing.assert_allclose(tally.raw_flux, before, atol=TOL)
