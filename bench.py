@@ -1,0 +1,140 @@
+"""Benchmark: particle-segments/sec on a ~1M-tet box mesh (single chip).
+
+BASELINE.md config 2 analog (1M-tet mesh, tracklength flux tally). The
+north-star ladder metric is particle-segments/sec/chip; the baseline target
+is 1e9 segments/sec on a v5p-64 pod (BASELINE.json), i.e. 1e9/64 per chip —
+``vs_baseline`` reports the ratio against that per-chip figure.
+
+Everything stays on device: destinations are generated with jax.random and
+clipped into the domain, so the timed loop measures the fused
+walk+scatter kernel (plus one scalar readback per run at the end).
+
+Knobs (env): BENCH_CELLS (default 55 → 6*55^3 = 997,500 tets),
+BENCH_PARTICLES (131072), BENCH_STEPS (10), BENCH_GROUPS (8),
+BENCH_DTYPE (float32). Prints exactly ONE JSON line on stdout.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def run(
+    cells: int = 55,
+    n_particles: int = 131072,
+    steps: int = 10,
+    n_groups: int = 8,
+    dtype_name: str = "float32",
+    mean_path: float = 0.08,
+    seed: int = 0,
+) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from pumiumtally_tpu import build_box, make_flux
+    from pumiumtally_tpu.ops.walk import trace_impl
+
+    dtype = jnp.dtype(dtype_name)
+    t0 = time.perf_counter()
+    mesh = build_box(1.0, 1.0, 1.0, cells, cells, cells, dtype=dtype)
+    build_s = time.perf_counter() - t0
+
+    rng = np.random.default_rng(seed)
+    elem = jnp.asarray(
+        rng.integers(0, mesh.ntet, n_particles).astype(np.int32)
+    )
+    origin = jnp.asarray(
+        np.asarray(mesh.centroids())[np.asarray(elem)], dtype
+    )
+    in_flight = jnp.ones(n_particles, bool)
+    weight = jnp.ones(n_particles, dtype)
+    group = jnp.asarray(
+        rng.integers(0, n_groups, n_particles).astype(np.int32)
+    )
+    material = jnp.full(n_particles, -1, jnp.int32)
+    flux = make_flux(mesh.ntet, n_groups, dtype)
+
+    import functools
+
+    @functools.partial(jax.jit, donate_argnums=(1, 2, 3))
+    def step(key, origin, elem, flux):
+        kd, kl = jax.random.split(key)
+        direction = jax.random.normal(kd, (n_particles, 3), dtype)
+        direction = direction / jnp.linalg.norm(
+            direction, axis=1, keepdims=True
+        )
+        length = jax.random.exponential(kl, (n_particles, 1), dtype) * mean_path
+        dest = jnp.clip(origin + direction * length, 0.01, 0.99)
+        r = trace_impl(
+            mesh, origin, dest, elem, in_flight, weight, group, material,
+            flux,
+            initial=False,
+            max_crossings=mesh.ntet + 64,
+            score_squares=True,
+            tolerance=1e-6,
+        )
+        return r.position, r.elem, r.flux, r.n_segments, r.n_crossings
+
+    key = jax.random.key(seed)
+    keys = jax.random.split(key, steps + 2)
+
+    # Warmup / compile.
+    t0 = time.perf_counter()
+    pos, elem_c, flux, nseg, _ = step(keys[0], origin, elem, flux)
+    jax.block_until_ready(pos)
+    compile_s = time.perf_counter() - t0
+    pos, elem_c, flux, nseg, _ = step(keys[1], pos, elem_c, flux)
+    jax.block_until_ready(pos)
+
+    total_segments = 0
+    t0 = time.perf_counter()
+    for i in range(steps):
+        pos, elem_c, flux, nseg, ncross = step(keys[2 + i], pos, elem_c, flux)
+        total_segments += nseg  # device-side accumulate; read once at end
+    jax.block_until_ready(pos)
+    elapsed = time.perf_counter() - t0
+    total_segments = int(np.asarray(total_segments))
+
+    segments_per_sec = total_segments / elapsed
+    per_chip_baseline = 1e9 / 64.0
+    return {
+        "metric": "particle_segments_per_sec_per_chip",
+        "value": round(segments_per_sec, 1),
+        "unit": "segments/s",
+        "vs_baseline": round(segments_per_sec / per_chip_baseline, 4),
+        "detail": {
+            "ntet": mesh.ntet,
+            "n_particles": n_particles,
+            "n_groups": n_groups,
+            "steps": steps,
+            "dtype": str(dtype_name),
+            "total_segments": total_segments,
+            "elapsed_s": round(elapsed, 4),
+            "mesh_build_s": round(build_s, 2),
+            "compile_s": round(compile_s, 2),
+            "device": str(jax.devices()[0]),
+            "last_step_crossing_iters": int(np.asarray(ncross)),
+        },
+    }
+
+
+def main() -> None:
+    result = run(
+        cells=int(os.environ.get("BENCH_CELLS", "55")),
+        n_particles=int(os.environ.get("BENCH_PARTICLES", "131072")),
+        steps=int(os.environ.get("BENCH_STEPS", "10")),
+        n_groups=int(os.environ.get("BENCH_GROUPS", "8")),
+        dtype_name=os.environ.get("BENCH_DTYPE", "float32"),
+    )
+    print(
+        f"[bench] {result['detail']}", file=sys.stderr
+    )
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

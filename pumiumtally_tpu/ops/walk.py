@@ -32,7 +32,6 @@ race-freedom is by construction.
 """
 from __future__ import annotations
 
-import functools
 from typing import NamedTuple
 
 import jax
@@ -66,12 +65,7 @@ class TraceResult(NamedTuple):
     done: jax.Array
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("initial", "max_crossings", "score_squares", "tolerance"),
-    donate_argnames=("flux",),
-)
-def trace(
+def trace_impl(
     mesh,
     origin,
     dest,
@@ -120,7 +114,10 @@ def trace(
     group = group.astype(jnp.int32)
 
     done0 = jnp.logical_not(in_flight)
-    nseg0 = jnp.zeros((), jnp.int64 if jax.config.jax_enable_x64 else jnp.int32)
+    # Derive the zero from a per-particle input so the counter carries the
+    # same device-varying type as its in-loop update under shard_map.
+    nseg_dtype = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+    nseg0 = jnp.sum(in_flight).astype(nseg_dtype) * 0
 
     def cond(carry):
         _, _, done, _, _, _, it = carry
@@ -205,3 +202,11 @@ def trace(
         n_crossings=it,
         done=done,
     )
+
+
+trace = jax.jit(
+    trace_impl,
+    static_argnames=("initial", "max_crossings", "score_squares", "tolerance"),
+    donate_argnames=("flux",),
+)
+trace.__doc__ = trace_impl.__doc__

@@ -4,7 +4,7 @@ and enable float64 so the reference's 1e-8 analytic oracles port literally
 (test_pumi_tally_impl_methods.cpp:22)."""
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -13,4 +13,7 @@ if "xla_force_host_platform_device_count" not in _flags:
 
 import jax
 
+# The environment may pin JAX_PLATFORMS to a TPU plugin in a way that wins
+# over the env var set above; the config update takes final precedence.
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
