@@ -31,6 +31,8 @@ def run(
     dtype_name: str = "float32",
     mean_path: float = 0.08,
     seed: int = 0,
+    compact_after: int | None = 32,
+    compact_size: int | None = None,
 ) -> dict:
     import jax
     import jax.numpy as jnp
@@ -76,6 +78,8 @@ def run(
             max_crossings=mesh.ntet + 64,
             score_squares=True,
             tolerance=1e-6,
+            compact_after=compact_after,
+            compact_size=compact_size,
         )
         return r.position, r.elem, r.flux, r.n_segments, r.n_crossings
 
@@ -129,6 +133,16 @@ def main() -> None:
         steps=int(os.environ.get("BENCH_STEPS", "10")),
         n_groups=int(os.environ.get("BENCH_GROUPS", "8")),
         dtype_name=os.environ.get("BENCH_DTYPE", "float32"),
+        compact_after=(
+            None
+            if os.environ.get("BENCH_COMPACT_AFTER", "32") in ("", "none")
+            else int(os.environ.get("BENCH_COMPACT_AFTER", "32"))
+        ),
+        compact_size=(
+            int(os.environ["BENCH_COMPACT_SIZE"])
+            if os.environ.get("BENCH_COMPACT_SIZE")
+            else None
+        ),
     )
     print(
         f"[bench] {result['detail']}", file=sys.stderr

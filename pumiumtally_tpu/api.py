@@ -99,6 +99,7 @@ class PumiTally:
             self.mesh = mesh
             self.num_particles = int(num_particles)
             self._max_crossings = cfg.resolve_max_crossings(mesh.ntet)
+            self._compact = cfg.resolve_compaction(int(num_particles))
             self.state: ParticleState = seed_at_element_centroid(
                 make_particle_state(self.num_particles, dtype=cfg.dtype), mesh
             )
@@ -180,6 +181,8 @@ class PumiTally:
                 max_crossings=self._max_crossings,
                 score_squares=self.config.score_squares,
                 tolerance=self.config.tolerance,
+                compact_after=self._compact[0],
+                compact_size=self._compact[1],
             )
             self.flux = result.flux
             self.state = s._replace(
@@ -250,6 +253,8 @@ class PumiTally:
                 max_crossings=self._max_crossings,
                 score_squares=cfg.score_squares,
                 tolerance=cfg.tolerance,
+                compact_after=self._compact[0],
+                compact_size=self._compact[1],
             )
             self.flux = result.flux
             self.state = s._replace(

@@ -29,6 +29,25 @@ Per-crossing semantics reproduced exactly:
 Atomics disappear: the per-crossing tally writes become one XLA scatter-add
 over the particle axis per iteration (duplicate indices accumulate), and
 race-freedom is by construction.
+
+Straggler compaction
+--------------------
+Crossing counts are long-tailed (a few particles cross 10x more elements
+than the mean), and a flat SPMD while_loop runs *every* lane until the very
+last particle finishes — the batch-level cost of the data-dependent walk
+lengths called out in SURVEY.md §7 (hard part 1). With
+``compact_after``/``compact_size`` set, the walk runs in two phases:
+
+  1. the full batch advances for ``compact_after`` crossings (finishing the
+     bulk of particles),
+  2. the still-active stragglers are compacted to the front (argsort of the
+     done mask) into a ``compact_size``-lane subset which loops to
+     completion; an outer while_loop repeats the compaction while any
+     particle remains active, so correctness never depends on the tail
+     fitting in one subset.
+
+Semantics (and the scored flux) are identical to the flat loop; only the
+lane scheduling changes.
 """
 from __future__ import annotations
 
@@ -80,6 +99,8 @@ def trace_impl(
     max_crossings: int,
     score_squares: bool = True,
     tolerance: float = 1e-8,
+    compact_after: int | None = None,
+    compact_size: int | None = None,
 ) -> TraceResult:
     """Advance all particles from origin to dest through the mesh.
 
@@ -100,9 +121,13 @@ def trace_impl(
       tolerance: geometric tolerance (reference walk tol 1e-8, cpp:123,206):
         a destination within tolerance (in ray-parameter space) of the exit
         face counts as inside the current element.
+      compact_after: if set, crossings after this many full-batch iterations
+        run on compacted straggler subsets (see module docstring).
+      compact_size: lane count of the straggler subsets (default n // 8).
     """
     dtype = origin.dtype
     ntet = mesh.tet2tet.shape[0]
+    n = origin.shape[0]
     n_groups = flux.shape[1]
 
     in_flight = in_flight.astype(bool)
@@ -119,80 +144,139 @@ def trace_impl(
     nseg_dtype = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
     nseg0 = jnp.sum(in_flight).astype(nseg_dtype) * 0
 
-    def cond(carry):
-        _, _, done, _, _, _, it = carry
-        return jnp.logical_and(it < max_crossings, jnp.logical_not(jnp.all(done)))
+    def make_body(dest_a, in_flight_a, weight_a, group_a):
+        """One element-boundary crossing for every lane of a (sub)batch.
 
-    def body(carry):
-        cur, elem, done, material_id, flux, nseg, it = carry
-        active = jnp.logical_not(done)
+        The per-particle inputs that never change during the walk are closed
+        over so the same body serves both the full batch and compacted
+        straggler subsets."""
+        scat_group = jnp.where(group_a < 0, n_groups, group_a)
 
-        dirv = dest - cur
-        normals = mesh.face_normals[elem]
-        dplane = mesh.face_d[elem]
-        t_exit, face, has_exit = exit_face(normals, dplane, cur, dirv)
+        def body(carry):
+            cur, elem, done, material_id, flux, nseg, it = carry
+            active = jnp.logical_not(done)
 
-        reached = jnp.logical_or(
-            t_exit >= 1.0 - tolerance, jnp.logical_not(has_exit)
-        )
-        t_step = jnp.minimum(t_exit, 1.0)
-        xpoint = cur + t_step[:, None] * dirv
+            dirv = dest_a - cur
+            normals = mesh.face_normals[elem]
+            dplane = mesh.face_d[elem]
+            t_exit, face, has_exit = exit_face(normals, dplane, cur, dirv)
 
-        crossed = active & ~reached & has_exit
-        next_elem = jnp.where(
-            crossed, mesh.tet2tet[elem, face], jnp.int32(-1)
-        )
-
-        # --- tally (skipped on the initial location search) ---------------
-        if not initial:
-            seg = jnp.linalg.norm(xpoint - cur, axis=-1)
-            score = active & in_flight
-            contrib = jnp.where(score, seg * weight, 0.0).astype(dtype)
-            scat_elem = jnp.where(score, elem, ntet)  # OOB rows are dropped
-            # Negative indices would wrap; push them out of bounds instead.
-            scat_group = jnp.where(group < 0, n_groups, group)
-            flux = flux.at[scat_elem, scat_group, 0].add(contrib, mode="drop")
-            if score_squares:
-                flux = flux.at[scat_elem, scat_group, 1].add(
-                    contrib * contrib, mode="drop"
-                )
-            nseg = nseg + jnp.sum(score).astype(nseg.dtype)
-
-        # --- boundary conditions (apply_boundary_condition, cpp:452-515) --
-        domain_exit = crossed & (next_elem == -1)
-        if initial:
-            material_stop = jnp.zeros_like(domain_exit)
-        else:
-            material_stop = (
-                crossed
-                & (next_elem >= 0)
-                & (
-                    mesh.class_id[jnp.maximum(next_elem, 0)]
-                    != mesh.class_id[elem]
-                )
+            reached = jnp.logical_or(
+                t_exit >= 1.0 - tolerance, jnp.logical_not(has_exit)
             )
-        newly_done = (active & reached) | domain_exit | material_stop
+            t_step = jnp.minimum(t_exit, 1.0)
+            xpoint = cur + t_step[:, None] * dirv
 
-        if not initial:
-            material_id = jnp.where(
-                material_stop,
-                mesh.class_id[jnp.maximum(next_elem, 0)],
-                jnp.where(
-                    (active & reached) | domain_exit, jnp.int32(-1), material_id
-                ),
+            crossed = active & ~reached & has_exit
+            next_elem = jnp.where(
+                crossed, mesh.tet2tet[elem, face], jnp.int32(-1)
             )
 
-        # --- hop (move_to_next_element hops even freshly-done material-stop
-        # particles, cpp:440-450) -------------------------------------------
-        elem = jnp.where(crossed & (next_elem != -1), next_elem, elem)
-        cur = jnp.where(active[:, None], xpoint, cur)
-        done = done | newly_done
-        return cur, elem, done, material_id, flux, nseg, it + 1
+            # --- tally (skipped on the initial location search) -----------
+            if not initial:
+                seg = jnp.linalg.norm(xpoint - cur, axis=-1)
+                score = active & in_flight_a
+                contrib = jnp.where(score, seg * weight_a, 0.0).astype(dtype)
+                scat_elem = jnp.where(score, elem, ntet)  # OOB rows drop
+                flux = flux.at[scat_elem, scat_group, 0].add(
+                    contrib, mode="drop"
+                )
+                if score_squares:
+                    flux = flux.at[scat_elem, scat_group, 1].add(
+                        contrib * contrib, mode="drop"
+                    )
+                nseg = nseg + jnp.sum(score).astype(nseg.dtype)
 
-    carry = (origin, elem, done0, material_id, flux, nseg0, jnp.int32(0))
-    cur, elem, done, material_id, flux, nseg, it = jax.lax.while_loop(
-        cond, body, carry
+            # --- boundary conditions (apply_boundary_condition,
+            # cpp:452-515) -------------------------------------------------
+            domain_exit = crossed & (next_elem == -1)
+            if initial:
+                material_stop = jnp.zeros_like(domain_exit)
+            else:
+                material_stop = (
+                    crossed
+                    & (next_elem >= 0)
+                    & (
+                        mesh.class_id[jnp.maximum(next_elem, 0)]
+                        != mesh.class_id[elem]
+                    )
+                )
+            newly_done = (active & reached) | domain_exit | material_stop
+
+            if not initial:
+                material_id = jnp.where(
+                    material_stop,
+                    mesh.class_id[jnp.maximum(next_elem, 0)],
+                    jnp.where(
+                        (active & reached) | domain_exit,
+                        jnp.int32(-1),
+                        material_id,
+                    ),
+                )
+
+            # --- hop (move_to_next_element hops even freshly-done
+            # material-stop particles, cpp:440-450) -------------------------
+            elem = jnp.where(crossed & (next_elem != -1), next_elem, elem)
+            cur = jnp.where(active[:, None], xpoint, cur)
+            done = done | newly_done
+            return cur, elem, done, material_id, flux, nseg, it + 1
+
+        return body
+
+    def run_phase(body, carry, bound):
+        def cond(c):
+            return jnp.logical_and(
+                c[-1] < bound, jnp.logical_not(jnp.all(c[2]))
+            )
+
+        return jax.lax.while_loop(cond, body, carry)
+
+    full_body = make_body(dest, in_flight, weight, group)
+    phase1_bound = (
+        max_crossings if compact_after is None
+        else min(compact_after, max_crossings)
     )
+    carry = (origin, elem, done0, material_id, flux, nseg0, jnp.int32(0))
+    cur, elem, done, material_id, flux, nseg, it = run_phase(
+        full_body, carry, phase1_bound
+    )
+
+    if compact_after is not None and phase1_bound < max_crossings:
+        S = min(n, compact_size if compact_size is not None else max(n // 8, 256))
+        max_rounds = -(-n // S) + 1  # every round retires ≥S actives or all
+
+        def outer_body(c):
+            cur, elem, done, material_id, flux, nseg, it, rounds = c
+            # Stable sort of the done mask puts active lanes first.
+            idx = jnp.argsort(done)[:S]
+            sub_body = make_body(
+                dest[idx], in_flight[idx], weight[idx], group[idx]
+            )
+            sub_carry = (
+                cur[idx], elem[idx], done[idx], material_id[idx],
+                flux, nseg, jnp.int32(0),
+            )
+            scur, selem, sdone, smat, flux, nseg, sit = run_phase(
+                sub_body, sub_carry, max_crossings
+            )
+            cur = cur.at[idx].set(scur)
+            elem = elem.at[idx].set(selem)
+            done = done.at[idx].set(sdone)
+            material_id = material_id.at[idx].set(smat)
+            return cur, elem, done, material_id, flux, nseg, it + sit, rounds + 1
+
+        def outer_cond(c):
+            done, rounds = c[2], c[-1]
+            return jnp.logical_and(
+                rounds < max_rounds, jnp.logical_not(jnp.all(done))
+            )
+
+        cur, elem, done, material_id, flux, nseg, it, _ = jax.lax.while_loop(
+            outer_cond,
+            outer_body,
+            (cur, elem, done, material_id, flux, nseg, it, jnp.int32(0)),
+        )
+
     return TraceResult(
         position=cur,
         elem=elem,
@@ -206,7 +290,14 @@ def trace_impl(
 
 trace = jax.jit(
     trace_impl,
-    static_argnames=("initial", "max_crossings", "score_squares", "tolerance"),
+    static_argnames=(
+        "initial",
+        "max_crossings",
+        "score_squares",
+        "tolerance",
+        "compact_after",
+        "compact_size",
+    ),
     donate_argnames=("flux",),
 )
 trace.__doc__ = trace_impl.__doc__

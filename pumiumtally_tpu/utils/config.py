@@ -28,6 +28,10 @@ class TallyConfig:
         particle is done, so a generous bound costs nothing at runtime;
         it only guards against infinite cycling on degenerate meshes.
         ``None`` → derived from the mesh size at trace build time.
+      compact_after: full-batch crossings before straggler compaction kicks
+        in (ops/walk.py module docstring); None disables compaction. The
+        facade disables it automatically for small particle counts.
+      compact_size: straggler subset lane count (default n_particles // 8).
       migration_period: every how many moves the particle axis is re-sorted
         by parent element for tally/gather locality (the TPU analog of the
         reference's `iter_count_ % 100` rebuild+migrate, cpp:256).
@@ -52,6 +56,8 @@ class TallyConfig:
     n_groups: int = 2
     tolerance: float = 1e-8
     max_crossings: int | None = None
+    compact_after: int | None = 32
+    compact_size: int | None = None
     migration_period: int = 100
     sort_by_element: bool = False
     dtype: Any = jnp.float32
@@ -68,3 +74,13 @@ class TallyConfig:
         # safe universal bound. The while_loop exits as soon as every
         # particle is done, so the generous bound costs nothing at runtime.
         return ntet + 64
+
+    def resolve_compaction(self, n_particles: int) -> tuple[int | None, int | None]:
+        """Compaction kicks in only where the straggler tail matters; tiny
+        batches stay on the flat loop."""
+        if self.compact_after is None or n_particles < 1024:
+            return None, None
+        size = self.compact_size
+        if size is None:
+            size = max(256, n_particles // 8)
+        return self.compact_after, min(size, n_particles)
