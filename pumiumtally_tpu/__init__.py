@@ -9,6 +9,11 @@ material-boundary stops, normalizes by element volume, and writes VTK.
 """
 
 from .api import PumiTally
+from .parallel.mesh_partition import (
+    MeshPartition,
+    assemble_global_flux,
+    partition_mesh,
+)
 from .core.state import ParticleState, make_particle_state
 from .core.tally import make_flux, normalize_flux
 from .mesh.box import build_box, build_box_arrays
@@ -21,6 +26,9 @@ __version__ = "0.1.0"
 
 __all__ = [
     "PumiTally",
+    "MeshPartition",
+    "partition_mesh",
+    "assemble_global_flux",
     "ParticleState",
     "make_particle_state",
     "make_flux",

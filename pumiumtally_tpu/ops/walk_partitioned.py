@@ -1,0 +1,482 @@
+"""Distributed fused tracer: per-chip mesh blocks + particle migration.
+
+The multi-chip analog of ops/walk.py for partitioned meshes
+(parallel/mesh_partition.py). Each chip owns a block of elements and the
+particles currently inside them; the step alternates
+
+  1. a *walk phase* — the same per-crossing sequence as the single-chip
+     kernel (score → boundary conditions → hop), except that a crossing into
+     an element owned by another chip freezes the particle ("pending") with
+     a decoded (target_chip, target_local_elem); and
+  2. an *exchange phase* — pending particles are compacted into a
+     fixed-size buffer, `all_gather`ed across the device axis (ICI), and
+     each chip adopts the ones addressed to it into free slots,
+
+inside one `lax.while_loop` that ends when no chip has pending particles.
+This is the TPU-native equivalent of the reference's cross-rank particle
+migration — the `migrate` flag plumbed through `search(migrate)` into
+Pumi-PIC's rebuild/migrate machinery (pumipic_particle_data_structure
+.cpp:256-258, 741-769) — with XLA collectives instead of MPI messages.
+
+Tally writes touch only the chip-local flux slab `[max_local, g, 2]`; since
+every element is owned by exactly one chip there is no cross-chip tally
+reduction at all — assembly back to global element order is a permutation
+(mesh_partition.assemble_global_flux).
+
+Capacity contract: a chip's particle buffer (`cap` slots, the per-chip
+block of the global particle axis) must fit everything that migrates in.
+With `cap == total particle count` no particle can ever be dropped; smaller
+caps trade memory for a (counted, reported) risk of dropped immigrants —
+`n_dropped` in the result is the hard failure signal. Unsent emigrants
+(exchange buffer overflow) are retried next round and never lost.
+
+Material boundaries at partition cuts: the reference hops the particle into
+the far element *and* stops it there (cpp:445, 473-479). When that far
+element is remote, the particle still migrates — marked done — so its
+parent element (where the next move starts) lands on the owning chip; the
+class_id comparison itself uses the precomputed `nbr_class` table, so the
+walk never reads remote memory.
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..parallel.mesh_partition import MeshPartition
+from ..parallel.particle_sharding import PARTICLE_AXIS as AXIS
+from .geometry import exit_face
+
+
+class PartitionedTraceResult(NamedTuple):
+    """Per-slot outputs, sharded over the device axis ([n_parts * cap] or
+    [n_parts, ...] leading layout as noted).
+
+    position/material_id/group/weight/particle_id/elem/valid/done:
+      [n_parts*cap] slot-major particle state after the step; `valid` marks
+      occupied slots, `elem` is the *local* element index on the owning chip.
+    flux: [n_parts, max_local, n_groups, 2] per-chip owned-element slabs.
+    n_segments: [n_parts] scored segment count per chip.
+    n_rounds: [n_parts] walk/exchange rounds executed (replicated value).
+    n_dropped: [n_parts] immigrants dropped for lack of free slots (0 unless
+      cap was undersized).
+    """
+
+    position: jax.Array
+    dest: jax.Array
+    elem: jax.Array
+    material_id: jax.Array
+    weight: jax.Array
+    group: jax.Array
+    particle_id: jax.Array
+    valid: jax.Array
+    done: jax.Array
+    flux: jax.Array
+    n_segments: jax.Array
+    n_rounds: jax.Array
+    n_dropped: jax.Array
+
+
+def _walk_phase(
+    tables, cur, dest, elem, done, target, target_elem, material_id,
+    weight, group, flux, nseg, valid,
+    *, initial, tolerance, score_squares, max_crossings, max_local,
+):
+    """Advance every resident particle until done or pending-migration."""
+    normals_t, faced_t, enc_t, class_t, nbrclass_t, _ = tables
+    dtype = cur.dtype
+    n_groups = flux.shape[1]
+
+    def body(carry):
+        cur, elem, done, target, target_elem, material_id, flux, nseg, it = carry
+        active = valid & ~done & (target < 0)
+
+        dirv = dest - cur
+        normals = normals_t[elem]
+        dplane = faced_t[elem]
+        t_exit, face, has_exit = exit_face(normals, dplane, cur, dirv)
+
+        reached = jnp.logical_or(
+            t_exit >= 1.0 - tolerance, jnp.logical_not(has_exit)
+        )
+        t_step = jnp.minimum(t_exit, 1.0)
+        xpoint = cur + t_step[:, None] * dirv
+
+        crossed = active & ~reached & has_exit
+        enc = jnp.where(crossed, enc_t[elem, face], jnp.int32(-1))
+        domain_exit = crossed & (enc == -1)
+        remote = crossed & (enc < -1)
+        local_hop = crossed & (enc >= 0)
+
+        if not initial:
+            seg = jnp.linalg.norm(xpoint - cur, axis=-1)
+            contrib = jnp.where(active, seg * weight, 0.0).astype(dtype)
+            scat_elem = jnp.where(active, elem, max_local)
+            scat_group = jnp.where(group < 0, n_groups, group)
+            flux = flux.at[scat_elem, scat_group, 0].add(contrib, mode="drop")
+            if score_squares:
+                flux = flux.at[scat_elem, scat_group, 1].add(
+                    contrib * contrib, mode="drop"
+                )
+            nseg = nseg + jnp.sum(active).astype(nseg.dtype)
+
+        nclass = nbrclass_t[elem, face]
+        if initial:
+            material_stop = jnp.zeros_like(domain_exit)
+        else:
+            material_stop = (
+                crossed & (enc != -1) & (nclass != class_t[elem])
+            )
+        newly_done = (active & reached) | domain_exit | material_stop
+        if not initial:
+            material_id = jnp.where(
+                material_stop,
+                nclass,
+                jnp.where(
+                    (active & reached) | domain_exit,
+                    jnp.int32(-1),
+                    material_id,
+                ),
+            )
+
+        # Remote crossing → freeze + address the owner chip. A remote
+        # material-stop migrates too (done on arrival) so the parent element
+        # ends up on its owner.
+        code = -2 - enc
+        target = jnp.where(remote, code // max_local, target)
+        target_elem = jnp.where(remote, code % max_local, target_elem)
+
+        elem = jnp.where(local_hop, enc, elem)
+        cur = jnp.where(active[:, None], xpoint, cur)
+        done = done | newly_done
+        return cur, elem, done, target, target_elem, material_id, flux, nseg, it + 1
+
+    def cond(carry):
+        cur, elem, done, target, *_rest, it = carry
+        active = valid & ~done & (target < 0)
+        return jnp.logical_and(it < max_crossings, jnp.any(active))
+
+    carry = (
+        cur, elem, done, target, target_elem, material_id, flux, nseg,
+        jnp.int32(0),
+    )
+    out = jax.lax.while_loop(cond, body, carry)
+    return out[:-1]
+
+
+def make_partitioned_step(
+    device_mesh: Mesh,
+    partition: MeshPartition,
+    *,
+    n_groups: int,
+    initial: bool = False,
+    max_crossings: int = 4096,
+    max_rounds: int | None = None,
+    exchange_size: int | None = None,
+    tolerance: float = 1e-8,
+    score_squares: bool = True,
+):
+    """Build the jitted distributed trace step for one mesh partition.
+
+    Args:
+      device_mesh: 1-D `jax.sharding.Mesh`; its size must equal
+        `partition.n_parts`.
+      exchange_size: emigrant-buffer slots per chip per round (default
+        cap // 4, min 64). Overflowing emigrants wait a round.
+      max_rounds: bound on walk/exchange rounds (default 4 * n_parts + 8 —
+        a particle path can re-enter parts, Morton blocks are compact so
+        few passes suffice; truncation shows up as done=False).
+
+    Returns step(cur, dest, elem, done, material, weight, group, pid, valid,
+    flux) -> PartitionedTraceResult, where per-particle arrays are
+    [n_parts * cap] sharded over the device axis and flux is
+    [n_parts, max_local, n_groups, 2] sharded on its leading axis.
+    """
+    n_parts = partition.n_parts
+    if device_mesh.shape[AXIS] != n_parts:
+        raise ValueError(
+            f"device mesh has {device_mesh.shape[AXIS]} devices, partition "
+            f"has {n_parts} parts"
+        )
+    max_local = partition.max_local
+    rounds_bound = (
+        max_rounds if max_rounds is not None else 4 * n_parts + 8
+    )
+
+    # Pin each chip's table block onto that chip once, here — partition_mesh
+    # is device-mesh-agnostic, and without this the uncommitted tables would
+    # be resharded on every step call (and a >HBM mesh would OOM the default
+    # device before the walk ever ran).
+    table_sharding = NamedSharding(device_mesh, P(AXIS))
+    tables = tuple(
+        jax.device_put(t, table_sharding) for t in partition.device_tables()
+    )
+
+    def shard_body(
+        normals_t, faced_t, enc_t, class_t, nbrclass_t, volumes_t,
+        cur, dest, elem, done, material_id, weight, group, pid, valid, flux,
+    ):
+        # Per-chip blocks arrive with a leading axis of 1; squeeze it.
+        tables_l = (
+            normals_t[0], faced_t[0], enc_t[0], class_t[0], nbrclass_t[0],
+            volumes_t[0],
+        )
+        flux_l = flux[0]
+        cap = cur.shape[0]
+        me = jax.lax.axis_index(AXIS)
+        E = exchange_size if exchange_size is not None else max(cap // 4, 64)
+        E = min(E, cap)
+        # All loop-carried values must be device-varying from the start
+        # (shard_map's vma rule) — derive them from per-particle inputs.
+        vzero = valid.astype(jnp.int32)  # varying [cap]
+        nseg0 = jnp.sum(vzero) * 0
+        target0 = vzero * 0 - 1
+
+        walk = functools.partial(
+            _walk_phase,
+            initial=initial,
+            tolerance=tolerance,
+            score_squares=score_squares,
+            max_crossings=max_crossings,
+            max_local=max_local,
+        )
+
+        def exchange(carry):
+            (cur, dest, elem, done, target, target_elem, material_id,
+             weight, group, pid, valid, flux_l, nseg, dropped) = carry
+            emig = valid & (target >= 0)
+            # Emigrants first (stable argsort of the negated mask).
+            send_order = jnp.argsort(~emig)[:E]
+            send_mask = emig[send_order]
+
+            pay_f = jnp.concatenate(
+                [cur[send_order], dest[send_order],
+                 weight[send_order, None]], axis=1,
+            )  # [E, 7]
+            pay_i = jnp.stack(
+                [
+                    pid[send_order],
+                    group[send_order],
+                    material_id[send_order],
+                    target_elem[send_order],
+                    jnp.where(send_mask, target[send_order], -1),
+                    done[send_order].astype(jnp.int32),
+                ],
+                axis=1,
+            )  # [E, 6]
+            # Sent slots free up.
+            valid = valid.at[send_order].set(
+                jnp.where(send_mask, False, valid[send_order])
+            )
+            target = target.at[send_order].set(
+                jnp.where(send_mask, -1, target[send_order])
+            )
+
+            g_f = jax.lax.all_gather(pay_f, AXIS)  # [n_parts, E, 7]
+            g_i = jax.lax.all_gather(pay_i, AXIS)  # [n_parts, E, 6]
+            g_f = g_f.reshape(n_parts * E, 7)
+            g_i = g_i.reshape(n_parts * E, 6)
+            mine = g_i[:, 4] == me
+
+            # Place my immigrants into free slots: immigrants first among
+            # the gathered rows, free slots first among my slots.
+            imm_order = jnp.argsort(~mine)
+            free_order = jnp.argsort(valid)  # False (free) first
+            m = min(n_parts * E, cap)
+            src = imm_order[:m]
+            dst = free_order[:m]
+            take = mine[src]
+            n_mine = jnp.sum(mine)
+            n_free = jnp.sum(~valid)
+            dropped = dropped + jnp.maximum(n_mine - n_free, 0).astype(
+                dropped.dtype
+            )
+            # Rows beyond the free-slot count must not overwrite occupied
+            # slots (argsort puts occupied ones after the free ones).
+            take = take & (jnp.arange(m) < n_free)
+
+            def place(slot_arr, rows):
+                upd = jnp.where(
+                    take.reshape((-1,) + (1,) * (rows.ndim - 1)),
+                    rows,
+                    slot_arr[dst],
+                )
+                return slot_arr.at[dst].set(upd)
+
+            cur = place(cur, g_f[src, 0:3].astype(cur.dtype))
+            dest = place(dest, g_f[src, 3:6].astype(dest.dtype))
+            weight = place(weight, g_f[src, 6].astype(weight.dtype))
+            pid = place(pid, g_i[src, 0])
+            group = place(group, g_i[src, 1])
+            material_id = place(material_id, g_i[src, 2])
+            elem = place(elem, g_i[src, 3])
+            done = place(done, g_i[src, 5].astype(bool))
+            valid = place(valid, take)
+            return (cur, dest, elem, done, target, target_elem, material_id,
+                    weight, group, pid, valid, flux_l, nseg, dropped)
+
+        def run_walk(carry):
+            (cur, dest, elem, done, target, target_elem, material_id,
+             weight, group, pid, valid, flux_l, nseg, dropped) = carry
+            cur, elem, done, target, target_elem, material_id, flux_l, nseg = (
+                walk(
+                    tables_l, cur, dest, elem, done, target, target_elem,
+                    material_id, weight, group, flux_l, nseg, valid,
+                )
+            )
+            return (cur, dest, elem, done, target, target_elem, material_id,
+                    weight, group, pid, valid, flux_l, nseg, dropped)
+
+        carry = (
+            cur, dest, elem, done, target0, vzero * 0,
+            material_id, weight, group, pid, valid, flux_l, nseg0,
+            nseg0 * 0,
+        )
+        carry = run_walk(carry)
+
+        def pending_somewhere(carry):
+            target, valid = carry[4], carry[10]
+            n_pend = jnp.sum(valid & (target >= 0)).astype(jnp.int32)
+            return jax.lax.psum(n_pend, AXIS) > 0
+
+        def round_body(state):
+            carry, r = state
+            carry = run_walk(exchange(carry))
+            return carry, r + 1
+
+        def round_cond(state):
+            carry, r = state
+            return jnp.logical_and(r < rounds_bound, pending_somewhere(carry))
+
+        carry, n_rounds = jax.lax.while_loop(
+            round_cond, round_body, (carry, nseg0 * 0)
+        )
+        (cur, dest, elem, done, target, target_elem, material_id,
+         weight, group, pid, valid, flux_l, nseg, dropped) = carry
+
+        return PartitionedTraceResult(
+            position=cur,
+            dest=dest,
+            elem=elem,
+            material_id=material_id,
+            weight=weight,
+            group=group,
+            particle_id=pid,
+            valid=valid,
+            done=done,
+            flux=flux_l[None],
+            n_segments=nseg[None],
+            n_rounds=n_rounds[None],
+            n_dropped=dropped[None],
+        )
+
+    table_specs = tuple(P(AXIS) for _ in tables)
+    particle_spec = P(AXIS)
+    mapped = jax.shard_map(
+        shard_body,
+        mesh=device_mesh,
+        in_specs=table_specs + (particle_spec,) * 9 + (P(AXIS),),
+        out_specs=PartitionedTraceResult(
+            position=particle_spec,
+            dest=particle_spec,
+            elem=particle_spec,
+            material_id=particle_spec,
+            weight=particle_spec,
+            group=particle_spec,
+            particle_id=particle_spec,
+            valid=particle_spec,
+            done=particle_spec,
+            flux=P(AXIS),
+            n_segments=P(AXIS),
+            n_rounds=P(AXIS),
+            n_dropped=P(AXIS),
+        ),
+    )
+    jitted = jax.jit(mapped, donate_argnums=(15,))
+
+    def step(cur, dest, elem, done, material_id, weight, group, pid, valid,
+             flux):
+        return jitted(
+            *tables, cur, dest, elem, done, material_id, weight, group, pid,
+            valid, flux,
+        )
+
+    return step
+
+
+# --------------------------------------------------------------------------- #
+# Host-side helpers for placing particles onto their owner chips.
+# --------------------------------------------------------------------------- #
+def distribute_particles(
+    partition: MeshPartition,
+    device_mesh: Mesh,
+    global_elem: np.ndarray,
+    fields: dict,
+    cap: int | None = None,
+):
+    """Scatter host particle arrays into per-chip slot layout.
+
+    Args:
+      global_elem: [n] global parent element per particle.
+      fields: name → [n, ...] host array (must include 'origin' and 'dest';
+        'weight', 'group', 'material_id' optional).
+      cap: slots per chip (default: total particle count, the no-drop-safe
+        capacity; use smaller to trade memory when migration is bounded).
+
+    Returns (arrays dict with [n_parts*cap] leading axis, valid, pid) as
+    device arrays sharded over the device axis.
+    """
+    import jax.numpy as jnp
+
+    n = int(np.asarray(global_elem).shape[0])
+    n_parts = partition.n_parts
+    cap = int(cap) if cap is not None else n
+    owner = partition.owner[np.asarray(global_elem)].astype(np.int64)
+    counts = np.bincount(owner, minlength=n_parts)
+    if counts.max(initial=0) > cap:
+        raise ValueError(
+            f"chip {int(counts.argmax())} needs {int(counts.max())} slots at "
+            f"seed time but cap={cap}"
+        )
+    order = np.argsort(owner, kind="stable")
+    start = np.searchsorted(owner[order], np.arange(n_parts))
+    rank_in_part = np.arange(n, dtype=np.int64) - start[owner[order]]
+    slot_of = np.empty(n, np.int64)
+    slot_of[order] = owner[order] * cap + rank_in_part
+
+    sharding = NamedSharding(device_mesh, P(AXIS))
+    out = {}
+    for name, arr in fields.items():
+        arr = np.asarray(arr)
+        buf = np.zeros((n_parts * cap,) + arr.shape[1:], arr.dtype)
+        buf[slot_of] = arr
+        out[name] = jax.device_put(jnp.asarray(buf), sharding)
+    valid = np.zeros(n_parts * cap, bool)
+    valid[slot_of] = True
+    pid = np.full(n_parts * cap, -1, np.int32)
+    pid[slot_of] = np.arange(n, dtype=np.int32)
+    elem_local = np.zeros(n_parts * cap, np.int32)
+    elem_local[slot_of] = partition.global2local[np.asarray(global_elem)]
+    out["valid"] = jax.device_put(jnp.asarray(valid), sharding)
+    out["particle_id"] = jax.device_put(jnp.asarray(pid), sharding)
+    out["elem"] = jax.device_put(jnp.asarray(elem_local), sharding)
+    return out
+
+
+def collect_by_particle_id(result: PartitionedTraceResult, n: int) -> dict:
+    """Gather per-particle outputs back into host pid order."""
+    pid = np.asarray(result.particle_id)
+    valid = np.asarray(result.valid)
+    sel = valid & (pid >= 0)
+    idx = pid[sel]
+    out = {}
+    for name in ("position", "material_id", "done", "elem", "weight", "group"):
+        arr = np.asarray(getattr(result, name))
+        buf = np.zeros((n,) + arr.shape[1:], arr.dtype)
+        buf[idx] = arr[sel]
+        out[name] = buf
+    return out

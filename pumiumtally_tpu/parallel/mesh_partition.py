@@ -1,0 +1,210 @@
+"""Distributed mesh partitioning: element → chip assignment + local tables.
+
+TPU-native replacement for the reference's distributed-mesh mode — the
+pumipic::Mesh "picparts" with non-trivial owners (SURVEY.md §2b; the
+reference in-repo only ever exercises full-mesh replication with owners=0,
+pumipic_particle_data_structure.cpp:865-876, and plumbs a `migrate` flag
+through `search()` for cross-rank particle migration, cpp:256-258, 763).
+Here partitioning is first-class: meshes larger than one chip's HBM are
+split into per-chip element blocks, each chip walks only its own particles
+through its own block, and particles crossing a partition boundary migrate
+to the owning chip over ICI collectives (see ops/walk_partitioned.py).
+
+Partitioning strategy: elements are ordered along a Morton (Z-order)
+space-filling curve of their centroids and cut into ``n_parts`` contiguous
+blocks — geometrically compact parts with small surface (≈ what the
+reference gets from Omega_h/ParMETIS-style partitions) without any graph
+library dependency.
+
+Per-part tables are padded to the max part size so they stack into one
+``[n_parts, max_local, ...]`` device array sharded over the device mesh's
+leading axis — every chip holds exactly its own block.
+
+Remote-neighbor encoding in ``tet2tet_enc[p, l, f]``:
+  * ``>= 0``   — face neighbor is local element with that local index;
+  * ``-1``     — domain boundary (no neighbor), like TetMesh.tet2tet;
+  * ``<= -2``  — neighbor owned by another chip: value is
+    ``-2 - (owner_chip * max_local + neighbor_local_index)``; decode with
+    :func:`decode_remote`.
+
+``nbr_class[p, l, f]`` carries the class_id of the face neighbor (own
+class_id on domain boundaries), so the material-boundary stop
+(cpp:473-479) needs no remote lookup during the walk.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import numpy as np
+
+from ..mesh.core import TetMesh
+
+
+def morton_order(points: np.ndarray, bits: int = 10) -> np.ndarray:
+    """Order of points along a Z-order curve (argsort of interleaved-bit
+    Morton codes of the quantized coordinates)."""
+    p = np.asarray(points, np.float64)
+    lo, hi = p.min(axis=0), p.max(axis=0)
+    span = np.where(hi > lo, hi - lo, 1.0)
+    q = np.minimum(
+        ((p - lo) / span * (1 << bits)).astype(np.uint64), (1 << bits) - 1
+    )
+    code = np.zeros(len(p), np.uint64)
+    for b in range(bits):
+        for axis in range(3):
+            code |= ((q[:, axis] >> np.uint64(b)) & np.uint64(1)) << np.uint64(
+                3 * b + axis
+            )
+    return np.argsort(code, kind="stable")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshPartition:
+    """Host-side partition description + stacked per-chip device tables.
+
+    Host (numpy) fields:
+      owner: [ntet] chip owning each global element.
+      global2local: [ntet] local index of each global element on its owner.
+      local2global: [n_parts, max_local] inverse map, -1 padding.
+      counts: [n_parts] owned-element count per chip.
+
+    Device (jax, leading axis = chip) fields — shard these with
+    ``P(PARTICLE_AXIS)`` on the leading axis:
+      face_normals: [n_parts, max_local, 4, 3]
+      face_d:       [n_parts, max_local, 4]
+      tet2tet_enc:  [n_parts, max_local, 4] (encoding above)
+      class_id:     [n_parts, max_local]
+      nbr_class:    [n_parts, max_local, 4]
+      volumes:      [n_parts, max_local]
+    """
+
+    n_parts: int
+    max_local: int
+    owner: np.ndarray
+    global2local: np.ndarray
+    local2global: np.ndarray
+    counts: np.ndarray
+    face_normals: Any
+    face_d: Any
+    tet2tet_enc: Any
+    class_id: Any
+    nbr_class: Any
+    volumes: Any
+
+    @property
+    def ntet(self) -> int:
+        return int(self.owner.shape[0])
+
+    def device_tables(self) -> tuple:
+        """The stacked per-chip arrays, in walk-kernel argument order."""
+        return (
+            self.face_normals,
+            self.face_d,
+            self.tet2tet_enc,
+            self.class_id,
+            self.nbr_class,
+            self.volumes,
+        )
+
+
+def decode_remote(enc: np.ndarray, max_local: int):
+    """Inverse of the remote-neighbor encoding: (owner_chip, local_index)."""
+    code = -2 - enc
+    return code // max_local, code % max_local
+
+
+def partition_mesh(
+    mesh: TetMesh, n_parts: int, *, order: np.ndarray | None = None
+) -> MeshPartition:
+    """Partition a TetMesh into ``n_parts`` Morton-contiguous element blocks
+    and build the stacked local walk tables.
+
+    ``order`` overrides the element ordering (tests use it to force skewed
+    or adversarial partitions).
+    """
+    import jax.numpy as jnp
+
+    ntet = mesh.ntet
+    if n_parts < 1 or n_parts > ntet:
+        raise ValueError(f"n_parts={n_parts} out of range for {ntet} elements")
+
+    tet2tet = np.asarray(mesh.tet2tet, np.int64)
+    if order is None:
+        centroids = np.asarray(mesh.centroids(), np.float64)
+        order = morton_order(centroids)
+    order = np.asarray(order, np.int64)
+
+    # Contiguous cut of the curve into n_parts near-equal blocks.
+    bounds = np.linspace(0, ntet, n_parts + 1).astype(np.int64)
+    owner = np.empty(ntet, np.int32)
+    global2local = np.empty(ntet, np.int64)
+    counts = np.diff(bounds).astype(np.int64)
+    max_local = int(counts.max())
+    local2global = np.full((n_parts, max_local), -1, np.int64)
+    for p in range(n_parts):
+        block = order[bounds[p] : bounds[p + 1]]
+        owner[block] = p
+        global2local[block] = np.arange(block.size)
+        local2global[p, : block.size] = block
+
+    # Stacked per-part geometry tables (gather from the full mesh; padded
+    # rows replicate element 0 of the part — they are never addressed
+    # because tet2tet_enc never points at them).
+    g = np.where(local2global >= 0, local2global, local2global[:, :1])
+    h_normals = np.asarray(mesh.face_normals)[g]
+    h_face_d = np.asarray(mesh.face_d)[g]
+    h_class = np.asarray(mesh.class_id, np.int32)[g]
+    h_volumes = np.asarray(mesh.volumes)[g]
+
+    # Neighbor encoding + neighbor class per face.
+    nbr = tet2tet[g]  # [P, L, 4] global neighbor ids, -1 boundary
+    nbr_safe = np.maximum(nbr, 0)
+    nbr_owner = owner[nbr_safe]
+    nbr_local = global2local[nbr_safe]
+    same = nbr_owner == np.arange(n_parts, dtype=np.int32)[:, None, None]
+    enc = np.where(
+        nbr < 0,
+        -1,
+        np.where(same, nbr_local, -2 - (nbr_owner * max_local + nbr_local)),
+    ).astype(np.int64)
+    h_nbr_class = np.where(
+        nbr < 0,
+        h_class[..., None] * np.ones((1, 1, 4), np.int32),
+        np.asarray(mesh.class_id, np.int32)[nbr_safe],
+    ).astype(np.int32)
+    # Padded rows: make them inert (domain boundary on all faces).
+    pad = local2global < 0
+    enc[pad] = -1
+
+    dtype = mesh.dtype
+    return MeshPartition(
+        n_parts=n_parts,
+        max_local=max_local,
+        owner=owner,
+        global2local=global2local.astype(np.int64),
+        local2global=local2global,
+        counts=counts,
+        face_normals=jnp.asarray(h_normals, dtype),
+        face_d=jnp.asarray(h_face_d, dtype),
+        tet2tet_enc=jnp.asarray(enc, jnp.int32),
+        class_id=jnp.asarray(h_class, jnp.int32),
+        nbr_class=jnp.asarray(h_nbr_class, jnp.int32),
+        volumes=jnp.asarray(h_volumes, dtype),
+    )
+
+
+def assemble_global_flux(
+    partition: MeshPartition, flux_slabs: np.ndarray
+) -> np.ndarray:
+    """Scatter per-chip flux slabs [n_parts, max_local, g, 2] back into
+    global element order [ntet, g, 2] (the write-time analog of the
+    reference's distributed tally reduce; each element is owned by exactly
+    one chip, so this is a permutation, not a reduction)."""
+    slabs = np.asarray(flux_slabs)
+    _, _, g, s = slabs.shape
+    out = np.zeros((partition.ntet, g, s), slabs.dtype)
+    for p in range(partition.n_parts):
+        n = int(partition.counts[p])
+        out[partition.local2global[p, :n]] = slabs[p, :n]
+    return out
