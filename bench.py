@@ -10,8 +10,9 @@ clipped into the domain, so the timed loop measures the fused
 walk+scatter kernel (plus one scalar readback per run at the end).
 
 Knobs (env): BENCH_CELLS (default 55 → 6*55^3 = 997,500 tets),
-BENCH_PARTICLES (131072), BENCH_STEPS (10), BENCH_GROUPS (8),
-BENCH_DTYPE (float32). Prints exactly ONE JSON line on stdout.
+BENCH_PARTICLES (1048576), BENCH_STEPS (10), BENCH_GROUPS (8),
+BENCH_DTYPE (float32), BENCH_UNROLL (8). Prints exactly ONE JSON line on
+stdout.
 """
 from __future__ import annotations
 
@@ -25,7 +26,7 @@ import numpy as np
 
 def run(
     cells: int = 55,
-    n_particles: int = 131072,
+    n_particles: int = 1048576,
     steps: int = 10,
     n_groups: int = 8,
     dtype_name: str = "float32",
@@ -33,6 +34,7 @@ def run(
     seed: int = 0,
     compact_after: int | None = 32,
     compact_size: int | None = None,
+    unroll: int = 8,
 ) -> dict:
     import jax
     import jax.numpy as jnp
@@ -80,6 +82,7 @@ def run(
             tolerance=1e-6,
             compact_after=compact_after,
             compact_size=compact_size,
+            unroll=unroll,
         )
         return r.position, r.elem, r.flux, r.n_segments, r.n_crossings
 
@@ -99,9 +102,11 @@ def run(
     for i in range(steps):
         pos, elem_c, flux, nseg, ncross = step(keys[2 + i], pos, elem_c, flux)
         total_segments += nseg  # device-side accumulate; read once at end
-    jax.block_until_ready(pos)
-    elapsed = time.perf_counter() - t0
+    # Host readback of a value depending on every step — a stricter fence
+    # than block_until_ready on one output buffer (which proved unreliable
+    # under the remote-TPU runtime; see scripts/sweep_unroll.py).
     total_segments = int(np.asarray(total_segments))
+    elapsed = time.perf_counter() - t0
 
     segments_per_sec = total_segments / elapsed
     per_chip_baseline = 1e9 / 64.0
@@ -129,7 +134,7 @@ def run(
 def main() -> None:
     result = run(
         cells=int(os.environ.get("BENCH_CELLS", "55")),
-        n_particles=int(os.environ.get("BENCH_PARTICLES", "131072")),
+        n_particles=int(os.environ.get("BENCH_PARTICLES", "1048576")),
         steps=int(os.environ.get("BENCH_STEPS", "10")),
         n_groups=int(os.environ.get("BENCH_GROUPS", "8")),
         dtype_name=os.environ.get("BENCH_DTYPE", "float32"),
@@ -143,6 +148,7 @@ def main() -> None:
             if os.environ.get("BENCH_COMPACT_SIZE")
             else None
         ),
+        unroll=int(os.environ.get("BENCH_UNROLL", "8")),
     )
     print(
         f"[bench] {result['detail']}", file=sys.stderr

@@ -183,6 +183,7 @@ class PumiTally:
                 tolerance=self.config.tolerance,
                 compact_after=self._compact[0],
                 compact_size=self._compact[1],
+                unroll=self.config.unroll,
             )
             self.flux = result.flux
             self.state = s._replace(
@@ -255,6 +256,7 @@ class PumiTally:
                 tolerance=cfg.tolerance,
                 compact_after=self._compact[0],
                 compact_size=self._compact[1],
+                unroll=cfg.unroll,
             )
             self.flux = result.flux
             self.state = s._replace(
@@ -311,6 +313,20 @@ class PumiTally:
             )
         )
 
+    def reaction_rate(self, sigma: np.ndarray) -> np.ndarray:
+        """Multi-tally support: a reaction-rate tally (raw Σ w·l·σ and its
+        square accumulator) for a per-(region, group) response table —
+        derived from the flux accumulator, see core.tally.reaction_rate."""
+        from .core.tally import reaction_rate
+
+        return np.asarray(
+            reaction_rate(
+                self.flux,
+                self.mesh.class_id,
+                jnp.asarray(sigma, self.config.dtype),
+            )
+        )
+
     def write_pumi_tally_mesh(self, filename: str | None = None) -> str:
         """Normalize flux, attach per-group cell fields + volume, write VTK
         (finalizeAndWritePumiFlux, cpp:685-705), print phase times."""
@@ -321,6 +337,22 @@ class PumiTally:
             write_flux_vtk(out, self.mesh, self.normalized_flux())
         self.tally_times.print_times()
         return out
+
+    # ------------------------------------------------------------------ #
+    def save_checkpoint(self, filename: str) -> None:
+        """Persist the resumable tally state (flux accumulator + particle
+        state + iteration counter) — see utils/checkpoint.py. The reference
+        has no checkpointing (SURVEY.md §5); its additive tally state makes
+        this a natural extension."""
+        from .utils.checkpoint import save_checkpoint
+
+        save_checkpoint(filename, self)
+
+    def restore_checkpoint(self, filename: str) -> None:
+        """Resume from a checkpoint written against the same mesh/config."""
+        from .utils.checkpoint import restore_checkpoint
+
+        restore_checkpoint(filename, self)
 
     # ------------------------------------------------------------------ #
     @property

@@ -37,3 +37,33 @@ def normalize_flux(flux, volumes, n_particles, n_iterations=1):
     iters = jnp.maximum(jnp.asarray(n_iterations, flux.dtype), 1.0)
     sd = jnp.sqrt(jnp.maximum(m2 - m1 * m1, 0.0) / iters)
     return jnp.stack([m1, m2, sd], axis=-1)
+
+
+@jax.jit
+def reaction_rate(flux, class_id, sigma):
+    """Track-length reaction-rate tally derived from the flux accumulator.
+
+    The track-length estimator of a reaction rate is Σᵢ wᵢ·lᵢ·σ(eᵢ,gᵢ) =
+    σ(e,g)·Σᵢ wᵢ·lᵢ, because the response σ depends only on the element's
+    material region and the energy group — so every response tally is a
+    cheap post-hoc product of the single in-loop flux accumulator instead
+    of an extra in-loop scatter (the reference would need a second atomic
+    accumulator per response; the multi-tally of BASELINE.md config 5).
+
+    Args:
+      flux: [ntet, n_groups, 2] raw accumulator (Σ w·l, Σ (w·l)²).
+      class_id: [ntet] material region per element.
+      sigma: [n_regions, n_groups] response coefficient (e.g. macroscopic
+        reaction cross-section) per region and group. Region ids outside
+        [0, n_regions) contribute 0.
+
+    Returns [ntet, n_groups, 2]: (Σ w·l·σ, Σ (w·l)²·σ²).
+    """
+    n_regions = sigma.shape[0]
+    safe = jnp.clip(class_id, 0, n_regions - 1)
+    s = sigma[safe]  # [ntet, n_groups]
+    valid = (class_id >= 0) & (class_id < n_regions)
+    s = jnp.where(valid[:, None], s, 0.0).astype(flux.dtype)
+    return jnp.stack(
+        [flux[..., 0] * s, flux[..., 1] * s * s], axis=-1
+    )

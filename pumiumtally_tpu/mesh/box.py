@@ -85,6 +85,7 @@ def build_box(
     nz: int = 1,
     class_id: np.ndarray | None = None,
     dtype=None,
+    pack_tables: bool = False,
 ) -> TetMesh:
     """Build a TetMesh box. All elements share class_id 0 unless given
     (a uniform single-region box, matching the build_box fixture)."""
@@ -94,4 +95,5 @@ def build_box(
     return TetMesh.from_numpy(
         coords, tet2vert, class_id=class_id,
         dtype=jnp.float32 if dtype is None else dtype,
+        pack_tables=pack_tables,
     )

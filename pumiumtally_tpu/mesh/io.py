@@ -33,7 +33,16 @@ def load_npz_arrays(filename: str):
 def parse_gmsh(filename: str):
     """Parse an ASCII Gmsh .msh file (v2.2 and v4.1), keeping only
     4-node tetrahedra (element type 4). Returns (coords, tet2vert, class_id)
-    with class_id from the first element tag (physical group)."""
+    with class_id from the first element tag (physical group).
+
+    v2.2 files go through the native C++ tokenizer when available
+    (pumiumtally_tpu.native.parse_gmsh); v4 and fallback parsing stay in
+    Python."""
+    from .. import native
+
+    fast = native.parse_gmsh(filename)
+    if fast is not None:
+        return fast
     with open(filename) as f:
         lines = f.read().split("\n")
     i = 0

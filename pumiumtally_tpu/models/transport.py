@@ -1,0 +1,194 @@
+"""Synthetic event-based Monte Carlo transport driver.
+
+OpenMC itself is not in this environment, so this module stands in for its
+event-based transport loop (SURVEY.md §7 stage 7): it drives PumiTally
+through exactly the call sequence the reference receives from OpenMC
+(images/public_methods_explanation.svg call sites) —
+
+    ctor → initialize_particle_location → move_to_next_location per advance
+    event → write_pumi_tally_mesh
+
+with simple mono-directional flight physics: isotropic direction sampling,
+exponential free-flight distances from a per-material total cross-section,
+absorption/termination by survival weighting, and Russian roulette. The
+tally library doubles as the surface-crossing oracle exactly as in the
+reference (move_to_next_location returns clipped positions + new material
+ids when a particle crosses a region boundary; the driver then re-samples
+the remaining flight in the new material — mirroring how OpenMC re-asks for
+the next advance after a surface crossing).
+
+This is host-side orchestration; the per-event compute stays in the fused
+device kernel behind move_to_next_location.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from ..api import PumiTally
+
+
+@dataclasses.dataclass(frozen=True)
+class Material:
+    """Minimal one-speed material model per mesh region (class_id)."""
+
+    sigma_t: float = 1.0       # total macroscopic cross-section [1/cm]
+    absorption: float = 0.3    # absorbed fraction per collision
+
+
+@dataclasses.dataclass
+class TransportStats:
+    batches: int = 0
+    events: int = 0
+    collisions: int = 0
+    absorbed_weight: float = 0.0
+    boundary_escapes: int = 0
+    roulette_kills: int = 0
+
+
+class SyntheticTransport:
+    """Event-based transport of ``n`` particles per batch on a PumiTally mesh.
+
+    Args:
+      tally: the PumiTally facade to drive.
+      materials: class_id → Material map; ids not present use the default.
+      source_box: axis-aligned (lo, hi) corners of the uniform source region.
+      survival_weight: weight floor below which Russian roulette triggers.
+      max_events: safety cap on advance events per batch.
+    """
+
+    def __init__(
+        self,
+        tally: PumiTally,
+        materials: dict[int, Material] | None = None,
+        source_box: tuple[np.ndarray, np.ndarray] | None = None,
+        survival_weight: float = 0.1,
+        max_events: int = 1000,
+        seed: int = 0,
+    ):
+        self.tally = tally
+        self.materials = materials or {}
+        self.default_material = Material()
+        coords = np.asarray(tally.mesh.coords, np.float64)
+        if source_box is None:
+            lo, hi = coords.min(axis=0), coords.max(axis=0)
+            pad = 0.05 * (hi - lo)
+            source_box = (lo + pad, hi - pad)
+        self.source_box = source_box
+        self.survival_weight = float(survival_weight)
+        self.max_events = int(max_events)
+        self.rng = np.random.default_rng(seed)
+        self.stats = TransportStats()
+        # class_id per element, for material lookup at the source site.
+        self._class_id = np.asarray(tally.mesh.class_id, np.int64)
+
+    # ------------------------------------------------------------------ #
+    def _sigma_t(self, material_ids: np.ndarray) -> np.ndarray:
+        out = np.full(
+            material_ids.shape, self.default_material.sigma_t, np.float64
+        )
+        for cid, mat in self.materials.items():
+            out[material_ids == cid] = mat.sigma_t
+        return out
+
+    def _absorption(self, material_ids: np.ndarray) -> np.ndarray:
+        out = np.full(
+            material_ids.shape, self.default_material.absorption, np.float64
+        )
+        for cid, mat in self.materials.items():
+            out[material_ids == cid] = mat.absorption
+        return out
+
+    def _isotropic(self, n: int) -> np.ndarray:
+        mu = self.rng.uniform(-1.0, 1.0, n)
+        phi = self.rng.uniform(0.0, 2 * np.pi, n)
+        s = np.sqrt(1.0 - mu * mu)
+        return np.stack([s * np.cos(phi), s * np.sin(phi), mu], axis=1)
+
+    # ------------------------------------------------------------------ #
+    def run_batch(self) -> None:
+        """One source batch: sample sources, then advance events until every
+        particle is absorbed, escaped, or rouletted."""
+        t = self.tally
+        n = t.num_particles
+        lo, hi = self.source_box
+        pos = self.rng.uniform(lo, hi, (n, 3))
+        t.initialize_particle_location(pos.ravel())
+
+        # Host-side particle bookkeeping (OpenMC's role in the pairing).
+        cur = pos.copy()
+        weight = np.ones(n)
+        alive = np.ones(n, bool)
+        group = np.zeros(n, np.int32)
+        n_groups = t.config.n_groups
+        # Material at the source site from the parent element's region id.
+        material = self._class_id[t.element_ids].astype(np.int32)
+        coords = np.asarray(t.mesh.coords, np.float64)
+        # "Reached destination" test must tolerate the device float dtype:
+        # positions round-trip through (typically) float32 on the TPU.
+        eps = 1e-4 * float(
+            np.linalg.norm(coords.max(axis=0) - coords.min(axis=0))
+        )
+
+        for _ in range(self.max_events):
+            if not alive.any():
+                break
+            sigma = self._sigma_t(material)
+            dist = self.rng.exponential(1.0 / np.maximum(sigma, 1e-30))
+            direction = self._isotropic(n)
+            dest = cur + direction * dist[:, None]
+
+            flying = alive.astype(np.int8)
+            mats_out = material.copy()
+            dest_inout = dest.copy()
+            t.move_to_next_location(
+                dest_inout, flying, weight.copy(), group.copy(), mats_out
+            )
+            self.stats.events += 1
+
+            # Outcome decoding per the reference's out-param contract
+            # (apply_boundary_condition, cpp:452-515): material_id >= 0 ⇒
+            # stopped at a region boundary; material_id == -1 ⇒ either the
+            # destination was reached or the particle left the domain —
+            # disambiguated by whether the returned position was clipped.
+            near = np.linalg.norm(dest_inout - dest, axis=1) < eps
+            reached = alive & (mats_out < 0) & near
+            crossed = alive & (mats_out >= 0)
+            escaped = alive & (mats_out < 0) & ~near
+
+            # Collision physics where the sampled flight completed.
+            coll = reached
+            self.stats.collisions += int(coll.sum())
+            absorb = self._absorption(material)
+            self.stats.absorbed_weight += float(
+                (weight[coll] * absorb[coll]).sum()
+            )
+            weight[coll] *= 1.0 - absorb[coll]
+            # Energy (group) downscatter with prob 1/2 where multi-group.
+            if n_groups > 1:
+                down = coll & (self.rng.random(n) < 0.5)
+                group[down] = np.minimum(group[down] + 1, n_groups - 1)
+
+            # Region change: continue from the surface in the new material.
+            material[crossed] = mats_out[crossed]
+            self.stats.boundary_escapes += int(escaped.sum())
+            alive[escaped] = False
+
+            # Russian roulette on low weights.
+            low = alive & (weight < self.survival_weight)
+            lucky = low & (self.rng.random(n) < 0.5)
+            killed = low & ~lucky
+            weight[lucky] *= 2.0
+            alive[killed] = False
+            self.stats.roulette_kills += int(killed.sum())
+
+            cur = dest_inout
+        self.stats.batches += 1
+
+    def run(self, batches: int, output: str | None = None) -> TransportStats:
+        for _ in range(batches):
+            self.run_batch()
+        if output is not None:
+            self.tally.write_pumi_tally_mesh(output)
+        return self.stats

@@ -1,0 +1,172 @@
+"""Loader for the native C++ runtime library (``native/pumi_native.cpp``).
+
+The reference's host-side runtime — mesh ingest and adjacency construction —
+is C++ (Omega_h; SURVEY.md §2b). Ours is too: the face-adjacency hash, the
+derived face-plane/volume pass, and the Gmsh tokenizer are compiled with g++
+into ``libpumi_native.so`` and called through ctypes. The library is built
+on demand at first import (and rebuilt when the source is newer than the
+binary); if the toolchain is unavailable the callers fall back to the
+equivalent (slower) NumPy implementations, so the native layer is an
+accelerator, never a hard dependency.
+
+Set ``PUMI_TPU_NATIVE=0`` to force the NumPy fallbacks.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_SRC = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+    "pumi_native.cpp",
+)
+_LIB_DIR = os.path.join(os.path.dirname(_SRC), "build")
+_LIB = os.path.join(_LIB_DIR, "libpumi_native.so")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_load_failed = False
+
+
+def _build() -> bool:
+    # Unique tmp path per process + atomic rename: concurrent first-use
+    # builds (pytest-xdist, shared filesystems) each compile privately and
+    # the last rename wins with a complete library either way.
+    tmp = f"{_LIB}.tmp.{os.getpid()}"
+    cmd = [
+        "g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
+        _SRC, "-o", tmp,
+    ]
+    try:
+        os.makedirs(_LIB_DIR, exist_ok=True)
+        subprocess.run(
+            cmd, check=True, capture_output=True, text=True, timeout=300
+        )
+        os.replace(tmp, _LIB)
+    except (subprocess.SubprocessError, OSError):
+        try:
+            if os.path.exists(tmp):
+                os.remove(tmp)
+        except OSError:
+            pass
+        return False
+    return True
+
+
+def load() -> ctypes.CDLL | None:
+    """Return the native library, building it if needed, or None."""
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
+        return _lib
+    if os.environ.get("PUMI_TPU_NATIVE", "1") == "0":
+        return None
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        stale = not os.path.exists(_LIB) or (
+            os.path.exists(_SRC)
+            and os.path.getmtime(_SRC) > os.path.getmtime(_LIB)
+        )
+        if stale and not _build():
+            _load_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError:
+            _load_failed = True
+            return None
+        i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+        f64p = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
+        i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+        lib.pn_build_tet2tet.restype = ctypes.c_int
+        lib.pn_build_tet2tet.argtypes = [i64p, ctypes.c_int64, i64p]
+        lib.pn_derive_geometry.restype = None
+        lib.pn_derive_geometry.argtypes = [f64p, i64p, ctypes.c_int64, f64p, f64p, f64p]
+        lib.pn_gmsh_open.restype = ctypes.c_void_p
+        lib.pn_gmsh_open.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.pn_gmsh_fill.restype = None
+        lib.pn_gmsh_fill.argtypes = [ctypes.c_void_p, f64p, i64p, i32p]
+        lib.pn_gmsh_free.restype = None
+        lib.pn_gmsh_free.argtypes = [ctypes.c_void_p]
+        lib.pn_abi_version.restype = ctypes.c_int
+        if lib.pn_abi_version() != 1:
+            _load_failed = True
+            return None
+        _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def build_tet2tet(tet2vert: np.ndarray) -> np.ndarray | None:
+    """Native face-adjacency build; None if the library is unavailable.
+    Raises ValueError on a non-manifold mesh (a face shared by >2 tets) —
+    such a mesh cannot produce a valid walk table."""
+    lib = load()
+    if lib is None:
+        return None
+    tet2vert = np.ascontiguousarray(tet2vert, dtype=np.int64)
+    ntet = tet2vert.shape[0]
+    out = np.empty((ntet, 4), dtype=np.int64)
+    rc = lib.pn_build_tet2tet(tet2vert, ntet, out)
+    if rc != 0:
+        raise ValueError(
+            "non-manifold mesh: some face is shared by more than two "
+            "tetrahedra"
+        )
+    return out
+
+
+def derive_geometry(coords: np.ndarray, tet2vert: np.ndarray):
+    """Native derived tables. Canonicalizes tet2vert orientation IN PLACE and
+    returns (tet2vert, volumes, normals[nt,4,3], face_d[nt,4]), or None."""
+    lib = load()
+    if lib is None:
+        return None
+    coords = np.ascontiguousarray(coords, dtype=np.float64)
+    tet2vert = np.ascontiguousarray(tet2vert, dtype=np.int64)
+    ntet = tet2vert.shape[0]
+    volumes = np.empty(ntet, dtype=np.float64)
+    normals = np.empty(ntet * 12, dtype=np.float64)
+    face_d = np.empty(ntet * 4, dtype=np.float64)
+    lib.pn_derive_geometry(coords, tet2vert, ntet, volumes, normals, face_d)
+    return (
+        tet2vert,
+        volumes,
+        normals.reshape(ntet, 4, 3),
+        face_d.reshape(ntet, 4),
+    )
+
+
+def parse_gmsh(filename: str):
+    """Native Gmsh v2.2 ASCII reader → (coords, tet2vert, class_id), or None
+    (v4 files and parse failures fall back to the Python reader)."""
+    lib = load()
+    if lib is None:
+        return None
+    n_nodes = ctypes.c_int64(0)
+    n_tets = ctypes.c_int64(0)
+    handle = lib.pn_gmsh_open(
+        filename.encode(), ctypes.byref(n_nodes), ctypes.byref(n_tets)
+    )
+    if not handle:
+        return None
+    try:
+        coords = np.empty((n_nodes.value, 3), dtype=np.float64)
+        tet2vert = np.empty((n_tets.value, 4), dtype=np.int64)
+        class_id = np.empty(n_tets.value, dtype=np.int32)
+        lib.pn_gmsh_fill(handle, coords, tet2vert, class_id)
+    finally:
+        lib.pn_gmsh_free(handle)
+    return coords, tet2vert, class_id

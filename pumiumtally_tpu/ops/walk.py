@@ -101,6 +101,9 @@ def trace_impl(
     tolerance: float = 1e-8,
     compact_after: int | None = None,
     compact_size: int | None = None,
+    unroll: int = 1,
+    packed_gathers: bool = False,
+    fused_scatter: bool = False,
 ) -> TraceResult:
     """Advance all particles from origin to dest through the mesh.
 
@@ -124,6 +127,20 @@ def trace_impl(
       compact_after: if set, crossings after this many full-batch iterations
         run on compacted straggler subsets (see module docstring).
       compact_size: lane count of the straggler subsets (default n // 8).
+      unroll: crossings advanced per while-loop iteration. The body is a
+        no-op for already-done lanes, so semantics are unchanged; unrolling
+        amortizes the per-iteration dispatch overhead of a TPU while_loop
+        (the measured cost driver — the loop is launch-bound, not
+        bandwidth-bound) at the price of at most ``unroll - 1`` wasted
+        body evaluations at the tail.
+      packed_gathers: look up walk geometry/topology through the mesh's
+        packed tables (requires TetMesh built with pack_tables=True).
+        Measured SLOWER than the separate narrow gathers on TPU v5e
+        (scripts/sweep_unroll.py: 3.96 vs 4.44 Mseg/s) — kept as an option
+        because the tradeoff is hardware-dependent.
+      fused_scatter: score (c, c²) with one 2-wide scatter instead of two
+        scalar scatter-adds. Also measured slower on v5e (3.00 vs 3.96);
+        same caveat.
     """
     dtype = origin.dtype
     ntet = mesh.tet2tet.shape[0]
@@ -157,8 +174,16 @@ def trace_impl(
             active = jnp.logical_not(done)
 
             dirv = dest_a - cur
-            normals = mesh.face_normals[elem]
-            dplane = mesh.face_d[elem]
+            if packed_gathers:
+                # One gather for all walk geometry (normals + plane offsets)
+                # and one for all topology (neighbor, neighbor class,
+                # differs flag).
+                geo = mesh.packed_geo[elem]  # [m, 16]
+                normals = geo[:, :12].reshape(-1, 4, 3)
+                dplane = geo[:, 12:]
+            else:
+                normals = mesh.face_normals[elem]
+                dplane = mesh.face_d[elem]
             t_exit, face, has_exit = exit_face(normals, dplane, cur, dirv)
 
             reached = jnp.logical_or(
@@ -168,9 +193,15 @@ def trace_impl(
             xpoint = cur + t_step[:, None] * dirv
 
             crossed = active & ~reached & has_exit
-            next_elem = jnp.where(
-                crossed, mesh.tet2tet[elem, face], jnp.int32(-1)
-            )
+            face_col = face[:, None]
+            if packed_gathers:
+                topo = mesh.packed_topo[elem]  # [m, 12]
+                nbr = jnp.take_along_axis(topo[:, 0:4], face_col, axis=1)[
+                    :, 0
+                ]
+            else:
+                nbr = mesh.tet2tet[elem, face]
+            next_elem = jnp.where(crossed, nbr, jnp.int32(-1))
 
             # --- tally (skipped on the initial location search) -----------
             if not initial:
@@ -178,13 +209,21 @@ def trace_impl(
                 score = active & in_flight_a
                 contrib = jnp.where(score, seg * weight_a, 0.0).astype(dtype)
                 scat_elem = jnp.where(score, elem, ntet)  # OOB rows drop
-                flux = flux.at[scat_elem, scat_group, 0].add(
-                    contrib, mode="drop"
-                )
-                if score_squares:
-                    flux = flux.at[scat_elem, scat_group, 1].add(
-                        contrib * contrib, mode="drop"
+                if score_squares and fused_scatter:
+                    # Single scatter of (c, c²) rows instead of two scalar
+                    # adds.
+                    flux = flux.at[scat_elem, scat_group].add(
+                        jnp.stack([contrib, contrib * contrib], axis=-1),
+                        mode="drop",
                     )
+                else:
+                    flux = flux.at[scat_elem, scat_group, 0].add(
+                        contrib, mode="drop"
+                    )
+                    if score_squares:
+                        flux = flux.at[scat_elem, scat_group, 1].add(
+                            contrib * contrib, mode="drop"
+                        )
                 nseg = nseg + jnp.sum(score).astype(nseg.dtype)
 
             # --- boundary conditions (apply_boundary_condition,
@@ -193,20 +232,29 @@ def trace_impl(
             if initial:
                 material_stop = jnp.zeros_like(domain_exit)
             else:
-                material_stop = (
-                    crossed
-                    & (next_elem >= 0)
-                    & (
-                        mesh.class_id[jnp.maximum(next_elem, 0)]
-                        != mesh.class_id[elem]
+                if packed_gathers:
+                    nbr_class = jnp.take_along_axis(
+                        topo[:, 4:8], face_col, axis=1
+                    )[:, 0]
+                    differs = jnp.take_along_axis(
+                        topo[:, 8:12], face_col, axis=1
+                    )[:, 0]
+                    material_stop = (
+                        crossed & (next_elem >= 0) & (differs == 1)
                     )
-                )
+                else:
+                    nbr_class = mesh.class_id[jnp.maximum(next_elem, 0)]
+                    material_stop = (
+                        crossed
+                        & (next_elem >= 0)
+                        & (nbr_class != mesh.class_id[elem])
+                    )
             newly_done = (active & reached) | domain_exit | material_stop
 
             if not initial:
                 material_id = jnp.where(
                     material_stop,
-                    mesh.class_id[jnp.maximum(next_elem, 0)],
+                    nbr_class,
                     jnp.where(
                         (active & reached) | domain_exit,
                         jnp.int32(-1),
@@ -224,6 +272,14 @@ def trace_impl(
         return body
 
     def run_phase(body, carry, bound):
+        if unroll > 1:
+            inner = body
+
+            def body(c):  # noqa: F811 — unrolled wrapper
+                for _ in range(unroll):
+                    c = inner(c)
+                return c
+
         def cond(c):
             return jnp.logical_and(
                 c[-1] < bound, jnp.logical_not(jnp.all(c[2]))
@@ -297,6 +353,9 @@ trace = jax.jit(
         "tolerance",
         "compact_after",
         "compact_size",
+        "unroll",
+        "packed_gathers",
+        "fused_scatter",
     ),
     donate_argnames=("flux",),
 )

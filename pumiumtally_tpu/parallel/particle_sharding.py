@@ -75,13 +75,17 @@ def make_sharded_trace(
     max_crossings: int,
     score_squares: bool = True,
     tolerance: float = 1e-8,
+    compact_after: int | None = None,
+    compact_size: int | None = None,
+    unroll: int = 8,
 ):
     """Build the multi-chip fused trace step.
 
     Per-particle inputs are sharded over the device mesh; the TetMesh is
     replicated; `flux` carries a leading device axis ([n_dev, ntet, g, 2])
     holding each chip's partial sums. No collective runs inside the step —
-    cross-chip reduction happens only in `reduce_flux`.
+    cross-chip reduction happens only in `reduce_flux`. The walk scheduling
+    knobs (unroll / straggler compaction, see ops/walk.py) apply per shard.
     """
     kernel = functools.partial(
         trace_impl,
@@ -89,6 +93,9 @@ def make_sharded_trace(
         max_crossings=max_crossings,
         score_squares=score_squares,
         tolerance=tolerance,
+        compact_after=compact_after,
+        compact_size=compact_size,
+        unroll=unroll,
     )
 
     def shard_body(

@@ -1,0 +1,122 @@
+"""Checkpoint / resume for tally runs.
+
+The reference has no persistence besides the final VTK write (SURVEY.md §5:
+"Checkpoint / resume. Absent.") — but its state is additive, so the natural
+checkpoint is exactly (flux accumulator, particle state, iteration counter).
+This module saves/restores that as a single compressed ``.npz`` with a mesh
+fingerprint so a checkpoint can never be resumed against a different mesh.
+
+Used by ``PumiTally.save_checkpoint`` / ``PumiTally.restore_checkpoint``;
+host-side glue, not a hot path.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+
+import numpy as np
+
+FORMAT_VERSION = 1
+
+
+def mesh_fingerprint(mesh) -> str:
+    """Stable content hash of the mesh the tally ran on (connectivity +
+    coordinates + region ids)."""
+    h = hashlib.sha256()
+    for arr in (mesh.tet2vert, mesh.coords, mesh.class_id):
+        a = np.ascontiguousarray(np.asarray(arr))
+        h.update(str(a.dtype).encode())
+        h.update(str(a.shape).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
+def _normalize(filename: str) -> str:
+    # np.savez_compressed silently appends ".npz"; normalize on both the
+    # save and load side so any filename round-trips.
+    return filename if filename.endswith(".npz") else filename + ".npz"
+
+
+def save_checkpoint(filename: str, tally) -> None:
+    """Serialize a PumiTally's resumable state."""
+    filename = _normalize(filename)
+    s = tally.state
+    meta = {
+        "format_version": FORMAT_VERSION,
+        "mesh_fingerprint": mesh_fingerprint(tally.mesh),
+        "num_particles": tally.num_particles,
+        "n_groups": tally.config.n_groups,
+        "iter_count": tally.iter_count,
+        "total_segments": tally.total_segments,
+        "initialized": tally._initialized,
+        "dtype": str(np.dtype(tally.config.dtype)),
+    }
+    np.savez_compressed(
+        filename,
+        meta=np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8),
+        flux=np.asarray(tally.flux),
+        origin=np.asarray(s.origin),
+        dest=np.asarray(s.dest),
+        elem=np.asarray(s.elem),
+        in_flight=np.asarray(s.in_flight),
+        weight=np.asarray(s.weight),
+        group=np.asarray(s.group),
+        material_id=np.asarray(s.material_id),
+        particle_id=np.asarray(s.particle_id),
+        perm=(
+            np.asarray(tally._perm)
+            if tally._perm is not None
+            else np.empty(0, np.int64)
+        ),
+    )
+
+
+def load_meta(filename: str) -> dict:
+    with np.load(_normalize(filename)) as z:
+        return json.loads(bytes(z["meta"].tobytes()).decode())
+
+
+def restore_checkpoint(filename: str, tally) -> None:
+    """Restore state saved by save_checkpoint into a PumiTally constructed
+    with the same mesh and config. Raises on any mismatch rather than
+    silently resuming a different run."""
+    import jax.numpy as jnp
+
+    with np.load(_normalize(filename)) as z:
+        meta = json.loads(bytes(z["meta"].tobytes()).decode())
+        if meta["format_version"] != FORMAT_VERSION:
+            raise ValueError(
+                f"checkpoint format {meta['format_version']} != "
+                f"{FORMAT_VERSION}"
+            )
+        if meta["mesh_fingerprint"] != mesh_fingerprint(tally.mesh):
+            raise ValueError(
+                "checkpoint was written against a different mesh"
+            )
+        if meta["num_particles"] != tally.num_particles:
+            raise ValueError(
+                f"checkpoint has {meta['num_particles']} particles, tally "
+                f"has {tally.num_particles}"
+            )
+        if meta["n_groups"] != tally.config.n_groups:
+            raise ValueError(
+                f"checkpoint has {meta['n_groups']} energy groups, config "
+                f"has {tally.config.n_groups}"
+            )
+        dtype = tally.config.dtype
+        tally.flux = jnp.asarray(z["flux"], dtype)
+        tally.state = tally.state._replace(
+            origin=jnp.asarray(z["origin"], dtype),
+            dest=jnp.asarray(z["dest"], dtype),
+            elem=jnp.asarray(z["elem"], jnp.int32),
+            in_flight=jnp.asarray(z["in_flight"], bool),
+            weight=jnp.asarray(z["weight"], dtype),
+            group=jnp.asarray(z["group"], jnp.int32),
+            material_id=jnp.asarray(z["material_id"], jnp.int32),
+            particle_id=jnp.asarray(z["particle_id"], jnp.int32),
+        )
+        tally.iter_count = int(meta["iter_count"])
+        tally.total_segments = int(meta["total_segments"])
+        tally._initialized = bool(meta["initialized"])
+        perm = z["perm"]
+        tally._perm = None if perm.size == 0 else perm.astype(np.int64)

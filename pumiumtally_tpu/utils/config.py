@@ -32,6 +32,10 @@ class TallyConfig:
         in (ops/walk.py module docstring); None disables compaction. The
         facade disables it automatically for small particle counts.
       compact_size: straggler subset lane count (default n_particles // 8).
+      unroll: boundary crossings advanced per while-loop iteration
+        (ops/walk.py). The TPU while_loop is dispatch-bound, so unrolling
+        the body ~2x's throughput (scripts/sweep_unroll.py); done lanes
+        make the extra evaluations no-ops.
       migration_period: every how many moves the particle axis is re-sorted
         by parent element for tally/gather locality (the TPU analog of the
         reference's `iter_count_ % 100` rebuild+migrate, cpp:256).
@@ -58,6 +62,7 @@ class TallyConfig:
     max_crossings: int | None = None
     compact_after: int | None = 32
     compact_size: int | None = None
+    unroll: int = 8
     migration_period: int = 100
     sort_by_element: bool = False
     dtype: Any = jnp.float32

@@ -1,0 +1,94 @@
+"""Structured logging for the framework.
+
+The reference logs with bare ``printf`` tagged ``[INFO]``/``[ERROR]``/
+``[TIME]`` and has no levels or structure (SURVEY.md §5; reference
+.cpp:26-33, 533-534, 873). Here the same tags ride on the stdlib logging
+machinery: levels, an env-controlled threshold (``PUMI_TPU_LOG=debug``),
+and an optional JSON-lines mode (``PUMI_TPU_LOG_JSON=1``) for machine
+consumption of timing/metric records.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import time
+
+_LOGGER_NAME = "pumiumtally_tpu"
+_TAGS = {
+    logging.DEBUG: "[DEBUG]",
+    logging.INFO: "[INFO]",
+    logging.WARNING: "[WARN]",
+    logging.ERROR: "[ERROR]",
+}
+
+
+class _TagFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        if os.environ.get("PUMI_TPU_LOG_JSON") == "1":
+            payload = {
+                "ts": round(time.time(), 3),
+                "level": record.levelname.lower(),
+                "msg": record.getMessage(),
+            }
+            extra = getattr(record, "fields", None)
+            if extra:
+                payload.update(extra)
+            return json.dumps(payload)
+        tag = _TAGS.get(record.levelno, f"[{record.levelname}]")
+        fields = getattr(record, "fields", None)
+        suffix = (
+            " " + " ".join(f"{k}={v}" for k, v in fields.items())
+            if fields
+            else ""
+        )
+        return f"{tag} {record.getMessage()}{suffix}"
+
+
+class _StderrHandler(logging.StreamHandler):
+    """Resolves sys.stderr at emit time (not at handler creation), so
+    stream redirection — pytest capsys, host-side log capture — works."""
+
+    def __init__(self):
+        logging.Handler.__init__(self)
+
+    @property
+    def stream(self):
+        return sys.stderr
+
+
+def get_logger() -> logging.Logger:
+    logger = logging.getLogger(_LOGGER_NAME)
+    if not logger.handlers:
+        handler = _StderrHandler()
+        handler.setFormatter(_TagFormatter())
+        logger.addHandler(handler)
+        logger.propagate = False
+        level = os.environ.get("PUMI_TPU_LOG", "info").upper()
+        logger.setLevel(getattr(logging, level, logging.INFO))
+    return logger
+
+
+def log_info(msg: str, **fields) -> None:
+    get_logger().info(msg, extra={"fields": fields} if fields else None)
+
+
+def log_debug(msg: str, **fields) -> None:
+    get_logger().debug(msg, extra={"fields": fields} if fields else None)
+
+
+def log_warn(msg: str, **fields) -> None:
+    get_logger().warning(msg, extra={"fields": fields} if fields else None)
+
+
+def log_error(msg: str, **fields) -> None:
+    get_logger().error(msg, extra={"fields": fields} if fields else None)
+
+
+def log_time(phase: str, seconds: float, **fields) -> None:
+    """[TIME]-tagged record (TallyTimes print parity, reference .cpp:26-33)."""
+    get_logger().info(
+        f"{phase}: {seconds:.6f} s",
+        extra={"fields": {"phase": phase, "seconds": round(seconds, 6), **fields}},
+    )

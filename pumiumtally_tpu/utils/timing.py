@@ -22,16 +22,17 @@ class TallyTimes:
     vtk_file_write_time: float = 0.0
 
     def print_times(self) -> None:
+        from .log import log_time
+
         total = (
             self.initialization_time
             + self.total_time_to_tally
             + self.vtk_file_write_time
         )
-        print()
-        print(f"[TIME] Initialization time     : {self.initialization_time:f} seconds")
-        print(f"[TIME] Total time to tally     : {self.total_time_to_tally:f} seconds")
-        print(f"[TIME] VTK file write time     : {self.vtk_file_write_time:f} seconds")
-        print(f"[TIME] Total PumiPic time      : {total:f} seconds")
+        log_time("initialization", self.initialization_time)
+        log_time("tally", self.total_time_to_tally)
+        log_time("vtk_write", self.vtk_file_write_time)
+        log_time("total", total)
 
 
 class phase_timer(contextlib.AbstractContextManager):

@@ -1,0 +1,53 @@
+"""Structured logging: tag format, level filtering, JSON mode."""
+from __future__ import annotations
+
+import json
+import logging
+
+from pumiumtally_tpu.utils import log as plog
+
+
+def _capture(capsys):
+    return capsys.readouterr().err.strip().split("\n")
+
+
+def test_tagged_format(capsys):
+    plog.log_info("mesh loaded", ntet=6)
+    plog.log_warn("truncated")
+    lines = _capture(capsys)
+    assert lines[0] == "[INFO] mesh loaded ntet=6"
+    assert lines[1] == "[WARN] truncated"
+
+
+def test_level_filtering(capsys):
+    logger = plog.get_logger()
+    old = logger.level
+    try:
+        logger.setLevel(logging.WARNING)
+        plog.log_info("hidden")
+        plog.log_error("shown")
+        lines = _capture(capsys)
+        assert lines == ["[ERROR] shown"]
+    finally:
+        logger.setLevel(old)
+
+
+def test_json_mode(monkeypatch, capsys):
+    monkeypatch.setenv("PUMI_TPU_LOG_JSON", "1")
+    plog.log_time("tally", 1.25, steps=10)
+    (line,) = _capture(capsys)
+    rec = json.loads(line)
+    assert rec["level"] == "info"
+    assert rec["phase"] == "tally"
+    assert rec["seconds"] == 1.25
+    assert rec["steps"] == 10
+
+
+def test_tally_times_print_goes_through_logger(capsys):
+    from pumiumtally_tpu.utils.timing import TallyTimes
+
+    t = TallyTimes(initialization_time=1.0, total_time_to_tally=2.0)
+    t.print_times()
+    lines = _capture(capsys)
+    assert any("initialization" in ln and "1.0" in ln for ln in lines)
+    assert any("total" in ln and "3.0" in ln for ln in lines)

@@ -1,0 +1,76 @@
+"""Reaction-rate multi-tally + depletion loop.
+
+The reaction-rate identity is exact: because the response depends only on
+(element region, group), the post-hoc product must equal what an in-loop
+σ-weighted scatter would have accumulated — tested against a hand-built
+per-element recomputation. The depletion loop is tested for its workflow
+invariants (densities fall monotonically, rates stay consistent).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from pumiumtally_tpu import PumiTally, TallyConfig
+from pumiumtally_tpu.mesh.box import build_box_arrays
+from pumiumtally_tpu.mesh.core import TetMesh
+from pumiumtally_tpu.models.depletion import DepletionLoop, RegionNuclide
+
+
+def _two_region(cells=3):
+    coords, tets = build_box_arrays(1.0, 1.0, 1.0, cells, cells, cells)
+    cid = (coords[tets].mean(axis=1)[:, 0] > 0.5).astype(np.int32)
+    return TetMesh.from_numpy(coords, tets, cid)
+
+
+def _driven_tally(n=48, n_groups=3, moves=4, seed=0):
+    mesh = _two_region()
+    t = PumiTally(mesh, n, TallyConfig(n_groups=n_groups, tolerance=1e-6))
+    rng = np.random.default_rng(seed)
+    t.initialize_particle_location(rng.uniform(0.1, 0.9, (n, 3)).ravel())
+    for _ in range(moves):
+        dest = rng.uniform(0.05, 0.95, (n, 3))
+        t.move_to_next_location(
+            dest, np.ones(n, np.int8),
+            rng.uniform(0.5, 2.0, n),
+            rng.integers(0, n_groups, n).astype(np.int32),
+            np.full(n, -1, np.int32),
+        )
+    return t
+
+
+def test_reaction_rate_identity():
+    t = _driven_tally()
+    sigma = np.array([[0.5, 1.0, 2.0], [3.0, 0.25, 0.0]])
+    rr = t.reaction_rate(sigma)
+    flux = t.raw_flux
+    cid = np.asarray(t.mesh.class_id)
+    expect0 = flux[..., 0] * sigma[cid]
+    expect1 = flux[..., 1] * sigma[cid] ** 2
+    np.testing.assert_allclose(rr[..., 0], expect0, rtol=1e-6)
+    np.testing.assert_allclose(rr[..., 1], expect1, rtol=1e-6)
+
+
+def test_reaction_rate_out_of_range_region_scores_zero():
+    t = _driven_tally()
+    sigma = np.array([[1.0, 1.0, 1.0]])  # only region 0 covered
+    rr = t.reaction_rate(sigma)
+    cid = np.asarray(t.mesh.class_id)
+    assert np.all(rr[cid == 1] == 0.0)
+    assert rr[cid == 0, :, 0].sum() > 0
+
+
+def test_depletion_burns_density_down():
+    mesh = _two_region()
+    t = PumiTally(mesh, 64, TallyConfig(n_groups=2, tolerance=1e-6))
+    inv = {
+        0: RegionNuclide(density=1.0, micro_total=3.0, micro_absorption=1.5),
+        1: RegionNuclide(density=2.0, micro_total=5.0, micro_absorption=2.0),
+    }
+    loop = DepletionLoop(t, inv, dt=0.05, seed=7)
+    hist = loop.run(3)
+    assert len(hist) == 3
+    for rid in (0, 1):
+        dens = [h.densities[rid] for h in hist]
+        assert all(d2 < d1 for d1, d2 in zip(dens, dens[1:])), dens
+        assert all(h.absorption_rate[rid] > 0 for h in hist)
+    assert all(h.total_flux > 0 for h in hist)

@@ -1,0 +1,72 @@
+"""End-to-end synthetic transport driver over the 4-call facade.
+
+Drives PumiTally exactly the way OpenMC drives the reference (init →
+move-per-event → write), on a two-region box so every outcome class —
+destination reached, material-boundary stop, domain escape, roulette —
+occurs. Checks physical invariants rather than golden numbers.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from pumiumtally_tpu import PumiTally, TallyConfig, build_box
+from pumiumtally_tpu.mesh.box import build_box_arrays
+from pumiumtally_tpu.mesh.core import TetMesh
+from pumiumtally_tpu.models.transport import Material, SyntheticTransport
+
+
+def _two_region_mesh(cells=4):
+    coords, tets = build_box_arrays(1.0, 1.0, 1.0, cells, cells, cells)
+    centroids = coords[tets].mean(axis=1)
+    class_id = (centroids[:, 0] > 0.5).astype(np.int32)
+    return TetMesh.from_numpy(coords, tets, class_id)
+
+
+def test_transport_smoke(tmp_path):
+    mesh = _two_region_mesh()
+    tally = PumiTally(
+        mesh, 64, TallyConfig(n_groups=2, tolerance=1e-6)
+    )
+    driver = SyntheticTransport(
+        tally,
+        materials={0: Material(2.0, 0.4), 1: Material(8.0, 0.6)},
+        seed=3,
+    )
+    out = str(tmp_path / "flux.vtu")
+    stats = driver.run(batches=2, output=out)
+
+    assert stats.batches == 2
+    assert stats.events > 0
+    assert stats.collisions > 0
+    assert stats.absorbed_weight > 0
+    # On a 1 cm box with mfp 0.125-0.5 cm, some particles must escape and
+    # some must die by roulette across two 64-particle batches.
+    assert stats.boundary_escapes + stats.roulette_kills > 0
+    assert os.path.exists(out)
+
+    flux = tally.raw_flux
+    assert (flux[..., 0] >= 0).all()
+    assert flux[..., 0].sum() > 0
+    # Both regions were flown through.
+    cid = np.asarray(mesh.class_id)
+    assert flux[cid == 0, :, 0].sum() > 0
+    assert flux[cid == 1, :, 0].sum() > 0
+    # Downscatter populated group 1.
+    assert flux[:, 1, 0].sum() > 0
+
+
+def test_flux_tracks_track_length_conservation():
+    """Total scored track length equals the summed per-event segment count
+    times nothing magic — verify Σ flux·? by energy-group marginals: the
+    sum over the raw group-0+1 contributions equals weight·length summed,
+    which is bounded by events × max flight; sanity envelope only."""
+    mesh = _two_region_mesh(3)
+    tally = PumiTally(mesh, 32, TallyConfig(n_groups=2, tolerance=1e-6))
+    driver = SyntheticTransport(tally, seed=11)
+    driver.run(batches=1)
+    total = float(tally.raw_flux[..., 0].sum())
+    # Weight ≤ 1 per particle and every segment lies inside the unit box, so
+    # a single particle cannot score more than the box diagonal per event.
+    assert 0 < total <= tally.num_particles * driver.stats.events * np.sqrt(3)

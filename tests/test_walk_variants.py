@@ -1,0 +1,68 @@
+"""The walk's performance variants (unroll, packed gathers, fused scatter)
+must be bit-equivalent to the baseline flat loop — they change scheduling
+and op shapes, never semantics."""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from pumiumtally_tpu import make_flux
+from pumiumtally_tpu.mesh.box import build_box_arrays
+from pumiumtally_tpu.mesh.core import TetMesh
+from pumiumtally_tpu.ops.walk import trace_impl
+
+
+@pytest.fixture(scope="module")
+def setup():
+    coords, tets = build_box_arrays(1.0, 1.0, 1.0, 4, 4, 4)
+    cid = (coords[tets].mean(axis=1)[:, 0] > 0.5).astype(np.int32)
+    mesh = TetMesh.from_numpy(coords, tets, cid, pack_tables=True)
+    rng = np.random.default_rng(0)
+    n = 96
+    elem = jnp.asarray(rng.integers(0, mesh.ntet, n).astype(np.int32))
+    origin = jnp.asarray(
+        np.asarray(mesh.centroids())[np.asarray(elem)], jnp.float32
+    )
+    dest = jnp.asarray(rng.uniform(-0.1, 1.1, (n, 3)), jnp.float32)
+    args = (
+        mesh, origin, dest, elem,
+        jnp.ones(n, bool),
+        jnp.asarray(rng.uniform(0.5, 2.0, n), jnp.float32),
+        jnp.asarray(rng.integers(0, 2, n), jnp.int32),
+        jnp.full(n, -1, jnp.int32),
+    )
+    kw = dict(initial=False, max_crossings=mesh.ntet + 8, tolerance=1e-6)
+    base = trace_impl(*args, make_flux(mesh.ntet, 2, jnp.float32), **kw)
+    return mesh, args, kw, base
+
+
+@pytest.mark.parametrize(
+    "variant",
+    [
+        dict(unroll=4),
+        dict(packed_gathers=True),
+        dict(fused_scatter=True),
+        dict(unroll=8, packed_gathers=True, fused_scatter=True,
+             compact_after=4, compact_size=32),
+    ],
+    ids=["unroll", "packed", "fused", "all"],
+)
+def test_variant_matches_baseline(setup, variant):
+    mesh, args, kw, base = setup
+    got = trace_impl(
+        *args, make_flux(mesh.ntet, 2, jnp.float32), **kw, **variant
+    )
+    np.testing.assert_allclose(
+        np.asarray(got.flux), np.asarray(base.flux), atol=1e-5, rtol=1e-5
+    )
+    np.testing.assert_array_equal(np.asarray(got.elem), np.asarray(base.elem))
+    np.testing.assert_array_equal(
+        np.asarray(got.material_id), np.asarray(base.material_id)
+    )
+    np.testing.assert_allclose(
+        np.asarray(got.position), np.asarray(base.position), atol=1e-6
+    )
+    assert int(got.n_segments) == int(base.n_segments)
+    assert bool(np.asarray(got.done).all())
