@@ -113,6 +113,20 @@ class PumiTally:
             timer.sync((self.state, self.flux))
 
     # ------------------------------------------------------------------ #
+    def _trace(self, *args, **kwargs):
+        """Dispatch to the fused walk; with checkify_invariants on, route
+        through the checkify-wrapped variant so the reference's device
+        asserts (OMEGA_H_CHECK_PRINTF, cpp:605-608, 618-629) fire as
+        Python exceptions."""
+        if self.config.checkify_invariants:
+            from .ops.walk import checked_trace
+
+            err, result = checked_trace(*args, **kwargs)
+            err.throw()
+            return result
+        return trace(*args, **kwargs)
+
+    # ------------------------------------------------------------------ #
     def _gather_in(self, host: np.ndarray) -> np.ndarray:
         """Reorder per-particle host input into device slot order."""
         return host if self._perm is None else host[self._perm]
@@ -167,7 +181,7 @@ class PumiTally:
             dest_h = self._gather_in(pos[:size].reshape(-1, 3))
             dest = jnp.asarray(dest_h, dtype=self.config.dtype)
             s = self.state
-            result = trace(
+            result = self._trace(
                 self.mesh,
                 s.origin,
                 dest,
@@ -240,7 +254,7 @@ class PumiTally:
             weight = jnp.asarray(self._gather_in(weights_h), dtype=cfg.dtype)
             group = jnp.asarray(self._gather_in(groups_h), dtype=jnp.int32)
 
-            result = trace(
+            result = self._trace(
                 self.mesh,
                 s.origin,
                 dest,

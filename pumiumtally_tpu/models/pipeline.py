@@ -1,0 +1,152 @@
+"""Double-buffered host→device tally streaming.
+
+The reference pays 6 PCIe copies and a device sync per OpenMC advance event
+(SURVEY.md §3.3); its planned sizing dance against OpenMC's
+`particles_in_flight` (.cpp:802-825) exists because the host loop is the
+latency bottleneck. Here the same problem is solved with JAX's async
+dispatch: a pipeline accepts independent particle batches, keeps ``depth``
+trace steps in flight on the device while the host prepares/uploads the
+next batch, and defers every device→host readback until the result is
+``depth`` submissions old — so device compute, host preparation, and
+PCIe/ICI transfers overlap instead of serializing.
+
+Use when batches are independent (successive OpenMC source batches /
+generations). For the strictly sequential per-event contract, use
+``PumiTally.move_to_next_location`` — one event's output feeds the next
+event's input there, so there is nothing to overlap.
+"""
+from __future__ import annotations
+
+import collections
+from typing import Iterator, NamedTuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tally import make_flux
+from ..ops.walk import trace
+from ..utils.config import TallyConfig
+
+
+class BatchResult(NamedTuple):
+    """Host-side outputs for one streamed batch."""
+
+    index: int
+    position: np.ndarray
+    elem: np.ndarray
+    material_id: np.ndarray
+    n_segments: int
+    all_done: bool
+
+
+class StreamingTallyPipeline:
+    """Stream independent particle batches through the fused walk.
+
+    Args:
+      mesh: TetMesh (device-resident).
+      config: TallyConfig; n_groups/tolerance/unroll/compaction apply.
+      depth: number of submissions kept in flight before the oldest result
+        is read back (2 = classic double buffering).
+      want_outputs: when False, per-batch positions/material ids are never
+        copied back — only the flux accumulator is produced, and the only
+        device sync in the whole run is the final ``finish()``.
+    """
+
+    def __init__(
+        self,
+        mesh,
+        config: TallyConfig | None = None,
+        depth: int = 2,
+        want_outputs: bool = True,
+    ):
+        self.mesh = mesh
+        self.config = config or TallyConfig()
+        self.depth = max(1, int(depth))
+        self.want_outputs = want_outputs
+        self.flux = make_flux(
+            mesh.ntet, self.config.n_groups, dtype=self.config.dtype
+        )
+        self._inflight: collections.deque = collections.deque()
+        self._n_submitted = 0
+        self._results: list[BatchResult] = []
+
+    # ------------------------------------------------------------------ #
+    def submit(self, origin, dest, elem, weight=None, group=None,
+               in_flight=None, material_id=None) -> None:
+        """Dispatch one batch asynchronously (returns before the walk runs)."""
+        cfg = self.config
+        n = np.asarray(origin).shape[0]
+        dt = cfg.dtype
+        result = trace(
+            self.mesh,
+            jnp.asarray(origin, dt),
+            jnp.asarray(dest, dt),
+            jnp.asarray(elem, jnp.int32),
+            (
+                jnp.ones(n, bool)
+                if in_flight is None
+                else jnp.asarray(in_flight, bool)
+            ),
+            (
+                jnp.ones(n, dt)
+                if weight is None
+                else jnp.asarray(weight, dt)
+            ),
+            (
+                jnp.zeros(n, jnp.int32)
+                if group is None
+                else jnp.asarray(group, jnp.int32)
+            ),
+            (
+                jnp.full(n, -1, jnp.int32)
+                if material_id is None
+                else jnp.asarray(material_id, jnp.int32)
+            ),
+            self.flux,
+            initial=False,
+            max_crossings=cfg.resolve_max_crossings(self.mesh.ntet),
+            score_squares=cfg.score_squares,
+            tolerance=cfg.tolerance,
+            **dict(
+                zip(
+                    ("compact_after", "compact_size"),
+                    cfg.resolve_compaction(n),
+                )
+            ),
+            unroll=cfg.unroll,
+        )
+        # The flux chain threads through every batch (donated each step);
+        # per-batch outputs wait in the in-flight queue.
+        self.flux = result.flux
+        self._inflight.append((self._n_submitted, result))
+        self._n_submitted += 1
+        while len(self._inflight) > self.depth:
+            self._drain_one()
+
+    def _drain_one(self) -> None:
+        idx, r = self._inflight.popleft()
+        if self.want_outputs:
+            self._results.append(
+                BatchResult(
+                    index=idx,
+                    position=np.asarray(r.position),
+                    elem=np.asarray(r.elem),
+                    material_id=np.asarray(r.material_id),
+                    n_segments=int(r.n_segments),
+                    all_done=bool(np.asarray(r.done).all()),
+                )
+            )
+
+    # ------------------------------------------------------------------ #
+    def results(self) -> Iterator[BatchResult]:
+        """Results read back so far (lagging submissions by ``depth``)."""
+        return iter(self._results)
+
+    def finish(self) -> np.ndarray:
+        """Drain the queue and return the accumulated raw flux
+        [ntet, n_groups, 2]."""
+        while self._inflight:
+            self._drain_one()
+        return np.asarray(self.flux)

@@ -51,6 +51,7 @@ lane scheduling changes.
 """
 from __future__ import annotations
 
+import functools
 from typing import NamedTuple
 
 import jax
@@ -104,6 +105,7 @@ def trace_impl(
     unroll: int = 1,
     packed_gathers: bool = False,
     fused_scatter: bool = False,
+    debug_checks: bool = False,
 ) -> TraceResult:
     """Advance all particles from origin to dest through the mesh.
 
@@ -141,6 +143,14 @@ def trace_impl(
       fused_scatter: score (c, c²) with one 2-wide scatter instead of two
         scalar scatter-adds. Also measured slower on v5e (3.00 vs 3.96);
         same caveat.
+      debug_checks: thread `checkify` device assertions through the walk
+        body — the functional analog of the reference's
+        OMEGA_H_CHECK_PRINTF kernel asserts (finite intersection points
+        cpp:605-608 neighborhood, element-id range, non-negative tally
+        contributions cpp:618-629). Wrap the call in
+        `jax.experimental.checkify.checkify` (see `checked_trace`) to
+        surface the first violation; costs extra per-crossing reductions,
+        debug builds only.
     """
     dtype = origin.dtype
     ntet = mesh.tet2tet.shape[0]
@@ -203,12 +213,32 @@ def trace_impl(
                 nbr = mesh.tet2tet[elem, face]
             next_elem = jnp.where(crossed, nbr, jnp.int32(-1))
 
+            if debug_checks:
+                from jax.experimental import checkify
+
+                checkify.check(
+                    jnp.all(jnp.isfinite(jnp.where(active[:, None], xpoint, 0.0))),
+                    "non-finite intersection point in walk",
+                )
+                checkify.check(
+                    jnp.all((next_elem >= -1) & (next_elem < ntet)),
+                    "element id out of range after hop",
+                )
+
             # --- tally (skipped on the initial location search) -----------
             if not initial:
                 seg = jnp.linalg.norm(xpoint - cur, axis=-1)
                 score = active & in_flight_a
                 contrib = jnp.where(score, seg * weight_a, 0.0).astype(dtype)
                 scat_elem = jnp.where(score, elem, ntet)  # OOB rows drop
+                if debug_checks:
+                    from jax.experimental import checkify
+
+                    checkify.check(
+                        jnp.all(contrib >= 0)
+                        & jnp.all(jnp.isfinite(contrib)),
+                        "negative or non-finite tally contribution",
+                    )
                 if score_squares and fused_scatter:
                     # Single scatter of (c, c²) rows instead of two scalar
                     # adds.
@@ -344,6 +374,27 @@ def trace_impl(
     )
 
 
+@functools.lru_cache(maxsize=64)
+def _checked_jit(static_kwargs: tuple):
+    from jax.experimental import checkify
+
+    fn = functools.partial(
+        trace_impl, debug_checks=True, **dict(static_kwargs)
+    )
+    return jax.jit(checkify.checkify(fn, errors=checkify.user_checks))
+
+
+def checked_trace(*args, **kwargs):
+    """Run the walk with in-kernel invariant checks (OMEGA_H_CHECK parity).
+
+    Returns (error, TraceResult); call ``error.throw()`` to raise on the
+    first violated device assertion. The checkify-transformed walk is
+    jitted and cached per static-kwarg signature, so repeated calls pay
+    only the extra per-crossing reductions, not retracing.
+    """
+    return _checked_jit(tuple(sorted(kwargs.items())))(*args)
+
+
 trace = jax.jit(
     trace_impl,
     static_argnames=(
@@ -356,6 +407,7 @@ trace = jax.jit(
         "unroll",
         "packed_gathers",
         "fused_scatter",
+        "debug_checks",
     ),
     donate_argnames=("flux",),
 )

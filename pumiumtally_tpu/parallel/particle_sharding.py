@@ -28,9 +28,22 @@ PARTICLE_AXIS = "p"
 
 
 def make_device_mesh(n_devices: int | None = None) -> Mesh:
-    """1-D device mesh over the particle axis."""
+    """1-D device mesh over the particle axis.
+
+    Raises if fewer devices exist than requested — a silently truncated
+    mesh would run "multi-chip" code on one chip and hide sharding bugs
+    (on this platform JAX_PLATFORMS env can be overridden by a baked
+    plugin; use jax.config.update("jax_platforms", "cpu") to get the
+    virtual CPU mesh)."""
     devices = jax.devices()
     if n_devices is not None:
+        if len(devices) < n_devices:
+            raise ValueError(
+                f"requested a {n_devices}-device mesh but only "
+                f"{len(devices)} device(s) are visible; for a virtual CPU "
+                "mesh set XLA_FLAGS=--xla_force_host_platform_device_count="
+                f"{n_devices} and jax.config.update('jax_platforms', 'cpu')"
+            )
         devices = devices[:n_devices]
     return Mesh(np.asarray(devices), (PARTICLE_AXIS,))
 

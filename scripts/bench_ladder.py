@@ -59,6 +59,12 @@ def run_single_chip(name, cells, n_particles, n_groups, steps=5):
 def run_partitioned(n_devices=8, cells=32, n_particles=65536, steps=3):
     import jax
 
+    virtual = os.environ.get("PUMI_LADDER_VIRTUAL") == "1"
+    if virtual:
+        # Functional validation scale: the virtual CPU mesh measures
+        # nothing TPU-comparable, so keep compile time in check.
+        cells, n_particles, steps = 12, 8192, 2
+
     if len(jax.devices()) < n_devices:
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"
@@ -98,6 +104,11 @@ def run_partitioned(n_devices=8, cells=32, n_particles=65536, steps=3):
     dtype = jnp.float32
     n_groups = 8
     mesh = build_box(1.0, 1.0, 1.0, cells, cells, cells, dtype=dtype)
+    print(
+        f"[ladder-3] mesh {mesh.ntet} tets, {n_devices} devices, "
+        f"{n_particles} particles (virtual={virtual})",
+        file=sys.stderr, flush=True,
+    )
     part = partition_mesh(mesh, n_devices)
     dmesh = make_device_mesh(n_devices)
     step = make_partitioned_step(
@@ -143,6 +154,8 @@ def run_partitioned(n_devices=8, cells=32, n_particles=65536, steps=3):
     res = one(new_dest(), flux)
     jax.block_until_ready(res.flux)
     compile_s = time.perf_counter() - t0
+    print(f"[ladder-3] compiled in {compile_s:.0f}s", file=sys.stderr,
+          flush=True)
 
     total = 0
     t0 = time.perf_counter()
@@ -156,7 +169,6 @@ def run_partitioned(n_devices=8, cells=32, n_particles=65536, steps=3):
     flux_np = assemble_global_flux(part, res.flux)
     tr1 = time.perf_counter()
     nbytes = flux_np.nbytes
-    virtual = os.environ.get("PUMI_LADDER_VIRTUAL") == "1"
     _emit(
         {
             "config": "3_partitioned_8dev" + ("_virtual" if virtual else ""),
@@ -181,6 +193,13 @@ def main():
     ap.add_argument("--configs", default="1,2,3,4")
     args = ap.parse_args()
     configs = {c.strip() for c in args.configs.split(",")}
+
+    if os.environ.get("PUMI_LADDER_VIRTUAL") == "1":
+        # The baked TPU plugin overrides the JAX_PLATFORMS env var; only
+        # the config update reliably selects the virtual CPU mesh.
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
 
     if "1" in configs:
         run_single_chip("1_correctness_10k", cells=12, n_particles=65536,
