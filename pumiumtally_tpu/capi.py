@@ -1,0 +1,94 @@
+"""Python side of the C ABI bridge (native/pumi_tally_c.cpp).
+
+The embedded interpreter calls these functions with raw host pointers
+wrapped as writable memoryviews; ``np.frombuffer`` turns them into
+zero-copy NumPy views so the facade's out-param write-backs land directly
+in the C caller's buffers — the same raw-pointer contract the reference's
+pimpl facade gives OpenMC (pumipic_particle_data_structure.h:20-47),
+without a staging copy on the host side.
+
+Handles are integers into a registry (the C struct just carries the id).
+"""
+from __future__ import annotations
+
+import itertools
+import os
+
+import numpy as np
+
+if os.environ.get("PUMI_TPU_PLATFORM"):
+    # Let embedded hosts pin the JAX platform ("cpu" for test rigs). The
+    # plain JAX_PLATFORMS env var can be overridden by baked device
+    # plugins; the config update always wins.
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["PUMI_TPU_PLATFORM"])
+
+from .api import PumiTally
+from .utils.config import TallyConfig
+
+_registry: dict[int, PumiTally] = {}
+_ids = itertools.count(1)
+
+
+def create(mesh_file: str, num_particles: int, n_groups: int) -> int:
+    tally = PumiTally(
+        mesh_file, num_particles, TallyConfig(n_groups=n_groups)
+    )
+    handle = next(_ids)
+    _registry[handle] = tally
+    return handle
+
+
+def destroy(handle: int) -> None:
+    _registry.pop(handle, None)
+
+
+def _view(mv: memoryview, dtype, count: int) -> np.ndarray:
+    arr = np.frombuffer(mv, dtype=dtype, count=count)
+    if not arr.flags.writeable:
+        raise ValueError("C buffer must be writable")
+    return arr
+
+
+def initialize_particle_location(handle: int, positions: memoryview,
+                                 size: int) -> None:
+    t = _registry[handle]
+    pos = _view(positions, np.float64, size)
+    t.initialize_particle_location(pos, size)
+
+
+def move_to_next_location(
+    handle: int,
+    dests: memoryview,
+    flying: memoryview,
+    weights: memoryview,
+    groups: memoryview,
+    material_ids: memoryview,
+    size: int,
+) -> None:
+    t = _registry[handle]
+    n = t.num_particles
+    t.move_to_next_location(
+        _view(dests, np.float64, size),
+        _view(flying, np.int8, n),
+        _view(weights, np.float64, n),
+        _view(groups, np.int32, n),
+        _view(material_ids, np.int32, n),
+        size,
+    )
+
+
+def write(handle: int, filename: str) -> None:
+    _registry[handle].write_pumi_tally_mesh(filename)
+
+
+def get_flux(handle: int, out: memoryview, capacity: int) -> int:
+    t = _registry[handle]
+    flux = np.asarray(t.raw_flux, np.float64).ravel()
+    if flux.size > capacity:
+        raise ValueError(
+            f"flux has {flux.size} entries, buffer holds {capacity}"
+        )
+    _view(out, np.float64, flux.size)[:] = flux
+    return int(flux.size)

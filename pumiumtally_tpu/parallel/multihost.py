@@ -1,0 +1,140 @@
+"""Multi-host distributed backend.
+
+The reference rides MPI for everything cross-rank (SURVEY.md §2b: MPI is
+initialized inside pumipic::Library, the Omega_h comm does the mesh/tally
+collectives, vtk::write_parallel is a collective write). The TPU-native
+equivalent is ``jax.distributed`` + XLA collectives: every host runs the
+same program, `jax.distributed.initialize` wires the cluster (ICI/DCN
+under TPU pods; gloo/TCP for CPU test clusters), and the global device
+mesh spans all hosts' devices, so the same ``shard_map`` code that scales
+particles/mesh parts across chips on one host scales across hosts with no
+code change.
+
+This module adds the thin host-level layer around that:
+
+  * `init_distributed` — idempotent `jax.distributed.initialize` wrapper
+    driven by args or the standard env vars.
+  * `global_device_mesh` — 1-D mesh over ALL processes' devices.
+  * `host_local_batch` — slice a per-run global particle batch down to
+    this process's share (the analog of OpenMC's work_per_rank split,
+    reference .cpp:802-825 comment).
+  * `allreduce_flux` — cross-host tally reduction producing a replicated
+    flux (the MPI tally-reduce analog) via `psum` under `shard_map`.
+  * `write_parallel_vtk` — per-host VTU piece + host-0 PVTU index (the
+    Omega_h vtk::write_parallel analog; DCN-free, each host writes only
+    its own piece).
+
+Tested with multi-process CPU clusters (two `jax.distributed` processes
+over localhost TCP) in tests/test_multihost.py — the same harness pattern
+works for real pods.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+AXIS = "hosts"
+
+
+def init_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> bool:
+    """Initialize jax.distributed once; no-op when single-process.
+
+    Arguments default to the JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES /
+    JAX_PROCESS_ID env vars (the standard launcher contract). Returns True
+    when a multi-process cluster was initialized.
+    """
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS"
+    )
+    if num_processes is None:
+        num_processes = int(os.environ.get("JAX_NUM_PROCESSES", "1"))
+    if process_id is None:
+        process_id = int(os.environ.get("JAX_PROCESS_ID", "0"))
+    if num_processes <= 1 or coordinator_address is None:
+        return False
+    global _initialized
+    if _initialized:
+        return True
+    # NOTE: must run before anything touches the XLA backend (even
+    # jax.process_count() initializes it); jax.distributed raises if the
+    # backend is already live, which we surface as-is — callers must
+    # initialize first, exactly like MPI_Init in the reference stack.
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+    return True
+
+
+_initialized = False
+
+
+def global_device_mesh() -> Mesh:
+    """1-D mesh over every device of every process."""
+    return Mesh(np.asarray(jax.devices()), (AXIS,))
+
+
+def host_local_batch(n_global: int) -> tuple[int, int]:
+    """This process's contiguous (start, count) share of a global batch —
+    the work_per_rank split."""
+    rank, size = jax.process_index(), jax.process_count()
+    base, rem = divmod(n_global, size)
+    start = rank * base + min(rank, rem)
+    count = base + (1 if rank < rem else 0)
+    return start, count
+
+
+def allreduce_flux(local_flux) -> np.ndarray:
+    """Sum per-host partial flux accumulators into a replicated global
+    tally (the MPI_Allreduce the reference's distributed tallies imply).
+
+    `local_flux` is this host's [ntet, n_groups, 2] partial; every process
+    gets back the cross-process sum. One gather + sum, no host-side
+    replication of the accumulator per local device.
+    """
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.process_allgather(jnp.asarray(local_flux))
+    return np.asarray(gathered).sum(axis=0)
+
+
+def write_parallel_vtk(
+    basename: str,
+    mesh,
+    normalized_flux: np.ndarray,
+    elem_slice: slice | None = None,
+) -> str:
+    """Per-host parallel VTK: each process writes its own .vtu piece;
+    process 0 writes the .pvtu index. Returns this host's piece path."""
+    from ..io.vtk import write_pvtu, write_vtu
+
+    rank, size = jax.process_index(), jax.process_count()
+    coords = np.asarray(mesh.coords, np.float64)
+    tets = np.asarray(mesh.tet2vert, np.int64)
+    flux = np.asarray(normalized_flux)
+    if elem_slice is not None:
+        tets = tets[elem_slice]
+        flux = flux[elem_slice]
+    cell_data = {
+        f"flux_group_{g}": flux[:, g, 0] for g in range(flux.shape[1])
+    }
+    piece = f"{basename}_p{rank:04d}.vtu"
+    write_vtu(piece, coords, tets, cell_data)
+    if rank == 0:
+        write_pvtu(
+            f"{basename}.pvtu",
+            [f"{basename}_p{r:04d}.vtu" for r in range(size)],
+            list(cell_data.keys()),
+        )
+    return piece

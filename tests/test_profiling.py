@@ -1,0 +1,46 @@
+"""Profiler integration: a trace capture around real facade work must
+produce trace artifacts, and annotations/memory stats must not throw."""
+from __future__ import annotations
+
+import glob
+import os
+
+import numpy as np
+
+from pumiumtally_tpu import PumiTally, TallyConfig, build_box
+from pumiumtally_tpu.utils.profiling import (
+    annotate,
+    device_memory_stats,
+    profile_trace,
+)
+
+
+def test_profile_trace_writes_artifacts(tmp_path):
+    logdir = str(tmp_path / "trace")
+    mesh = build_box(1.0, 1.0, 1.0, 2, 2, 2)
+    t = PumiTally(mesh, 8, TallyConfig(tolerance=1e-6))
+    rng = np.random.default_rng(0)
+    with profile_trace(logdir):
+        with annotate("init"):
+            t.initialize_particle_location(
+                rng.uniform(0.1, 0.9, (8, 3)).ravel()
+            )
+        with annotate("move"):
+            t.move_to_next_location(
+                rng.uniform(0.1, 0.9, (8, 3)),
+                np.ones(8, np.int8),
+                np.ones(8),
+                np.zeros(8, np.int32),
+                np.full(8, -1, np.int32),
+            )
+    found = glob.glob(
+        os.path.join(logdir, "**", "*.xplane.pb"), recursive=True
+    ) + glob.glob(os.path.join(logdir, "**", "*.trace*"), recursive=True)
+    assert found, f"no trace artifacts under {logdir}"
+
+
+def test_device_memory_stats_shape():
+    stats = device_memory_stats()
+    for rec in stats.values():
+        for v in rec.values():
+            assert isinstance(v, int)
