@@ -1,0 +1,32 @@
+# CTest driver for the C host smoke test: generate a mesh with the Python
+# package, run demo_host against it, check for OK. PY comes from the
+# configure-time Python3_EXECUTABLE (falls back to PATH python3).
+if("${PY}" STREQUAL "")
+  find_program(_py_fallback python3 REQUIRED)
+  set(PY ${_py_fallback})
+endif()
+set(WORK ${CMAKE_CURRENT_BINARY_DIR}/c_smoke)
+file(MAKE_DIRECTORY ${WORK})
+
+execute_process(
+  COMMAND ${PY} -c "
+import sys; sys.path.insert(0, '${SRC}')
+import numpy as np
+from pumiumtally_tpu.mesh.box import build_box_arrays
+from pumiumtally_tpu.mesh.io import save_npz
+coords, tets = build_box_arrays(1.0, 1.0, 1.0, 2, 2, 2)
+save_npz('${WORK}/box.npz', coords, tets, np.zeros(tets.shape[0], np.int32))
+"
+  RESULT_VARIABLE rc)
+if(NOT rc EQUAL 0)
+  message(FATAL_ERROR "mesh generation failed")
+endif()
+
+execute_process(
+  COMMAND ${DEMO} ${WORK}/box.npz ${WORK}/flux.vtu
+  OUTPUT_VARIABLE out
+  RESULT_VARIABLE rc)
+if(NOT rc EQUAL 0 OR NOT out MATCHES "OK")
+  message(FATAL_ERROR "demo_host failed (rc=${rc}): ${out}")
+endif()
+message(STATUS "c_host_smoke passed: ${out}")

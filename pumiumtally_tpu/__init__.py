@@ -15,9 +15,12 @@ from .parallel.mesh_partition import (
     partition_mesh,
 )
 from .core.state import ParticleState, make_particle_state
-from .core.tally import make_flux, normalize_flux
+from .core.tally import make_flux, normalize_flux, reaction_rate
 from .mesh.box import build_box, build_box_arrays
 from .mesh.core import TetMesh
+from .mesh.io import load_mesh, save_npz
+from .models.pipeline import StreamingTallyPipeline
+from .models.transport import Material, SyntheticTransport
 from .ops.walk import trace, TraceResult
 from .utils.config import TallyConfig
 from .utils.timing import TallyTimes
@@ -33,9 +36,15 @@ __all__ = [
     "make_particle_state",
     "make_flux",
     "normalize_flux",
+    "reaction_rate",
     "build_box",
     "build_box_arrays",
     "TetMesh",
+    "load_mesh",
+    "save_npz",
+    "StreamingTallyPipeline",
+    "Material",
+    "SyntheticTransport",
     "trace",
     "TraceResult",
     "TallyConfig",

@@ -30,6 +30,15 @@ Atomics disappear: the per-crossing tally writes become one XLA scatter-add
 over the particle axis per iteration (duplicate indices accumulate), and
 race-freedom is by construction.
 
+Why XLA and not a Pallas kernel: the walk is random-gather/-scatter bound
+(mesh tables indexed by data-dependent element ids), and Mosaic on TPU has
+no vectorized random-gather lowering — jnp.take / advanced indexing /
+one-hot-matmul forms all fail to lower inside a kernel
+(scripts/probe_pallas_gather.py records the probes on hardware), so a
+Pallas version could only scalar-loop over lanes, far slower than XLA's
+native gather/scatter ops. Pallas wins on dense tiled compute; this op is
+neither.
+
 Straggler compaction
 --------------------
 Crossing counts are long-tailed (a few particles cross 10x more elements

@@ -38,6 +38,11 @@ class _TagFormatter(logging.Formatter):
             return json.dumps(payload)
         tag = _TAGS.get(record.levelno, f"[{record.levelname}]")
         fields = getattr(record, "fields", None)
+        rendered = getattr(record, "fields_in_message", ())
+        if fields and rendered:
+            # Drop only the fields already present in the message text;
+            # caller-supplied extras still print.
+            fields = {k: v for k, v in fields.items() if k not in rendered}
         suffix = (
             " " + " ".join(f"{k}={v}" for k, v in fields.items())
             if fields
@@ -87,8 +92,15 @@ def log_error(msg: str, **fields) -> None:
 
 
 def log_time(phase: str, seconds: float, **fields) -> None:
-    """[TIME]-tagged record (TallyTimes print parity, reference .cpp:26-33)."""
+    """[TIME]-tagged record (TallyTimes print parity, reference .cpp:26-33).
+    The phase/seconds fields feed the JSON mode; the text mode already has
+    them in the message."""
     get_logger().info(
         f"{phase}: {seconds:.6f} s",
-        extra={"fields": {"phase": phase, "seconds": round(seconds, 6), **fields}},
+        extra={
+            "fields": {
+                "phase": phase, "seconds": round(seconds, 6), **fields
+            },
+            "fields_in_message": ("phase", "seconds"),
+        },
     )

@@ -1,0 +1,82 @@
+"""Probe: which in-kernel gather forms does Mosaic lower on this TPU?
+
+Decides whether a VMEM-resident Pallas walk kernel is viable for small
+meshes (tables in VMEM, whole walk in one launch — no per-crossing
+dispatch, no HBM gather latency). The blocker is vectorized random
+row-gather from a VMEM table; this probes the candidate lowerings:
+
+  take      — jnp.take(table, idx, axis=0)
+  onehot    — one-hot matmul gather (MXU; viable for tiny tables)
+  loop      — per-lane fori_loop of dynamic slices (scalar fallback)
+
+Each probe prints OK + a rough bandwidth, or the Mosaic error.
+"""
+from __future__ import annotations
+
+import functools
+import time
+import traceback
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+T, C = 4096, 16        # table rows x cols (fits VMEM easily)
+N = 2048               # lanes gathered per call
+
+
+def run(name, kernel, reps=50):
+    tbl = jnp.asarray(np.random.default_rng(0).normal(size=(T, C)), jnp.float32)
+    idx = jnp.asarray(
+        np.random.default_rng(1).integers(0, T, (N,)).astype(np.int32)
+    )
+    try:
+        f = pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((N, C), jnp.float32),
+        )
+        f = jax.jit(f)
+        out = jax.block_until_ready(f(tbl, idx))
+        expect = np.asarray(tbl)[np.asarray(idx)]
+        np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-6)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = f(tbl, idx)
+        jax.block_until_ready(out)
+        dt = (time.perf_counter() - t0) / reps
+        gbps = N * C * 4 / dt / 1e9
+        print(f"{name:8s} OK  {dt*1e6:8.1f} us/call  {gbps:7.2f} GB/s")
+    except Exception as e:
+        msg = str(e).split("\n")[0][:140]
+        print(f"{name:8s} FAIL {type(e).__name__}: {msg}")
+
+
+def k_take(tbl_ref, idx_ref, out_ref):
+    out_ref[:] = jnp.take(tbl_ref[:], idx_ref[:], axis=0)
+
+
+def k_onehot(tbl_ref, idx_ref, out_ref):
+    oh = jax.nn.one_hot(idx_ref[:], T, dtype=jnp.float32)  # [N, T]
+    out_ref[:] = jnp.dot(oh, tbl_ref[:], preferred_element_type=jnp.float32)
+
+
+def k_loop(tbl_ref, idx_ref, out_ref):
+    def body(i, _):
+        out_ref[i, :] = tbl_ref[idx_ref[i], :]
+        return 0
+
+    jax.lax.fori_loop(0, N, body, 0)
+
+
+def main():
+    print(f"table [{T},{C}] f32, {N} lanes, device={jax.devices()[0]}")
+    run("take", k_take)
+    run("onehot", k_onehot)
+    run("loop", k_loop, reps=5)
+
+
+if __name__ == "__main__":
+    main()
