@@ -85,6 +85,7 @@ def _walk_phase(
     tables, cur, dest, elem, done, target, target_elem, material_id,
     weight, group, flux, nseg, valid,
     *, initial, tolerance, score_squares, max_crossings, max_local,
+    unroll=1,
 ):
     """Advance every resident particle until done or pending-migration."""
     normals_t, faced_t, enc_t, class_t, nbrclass_t, _ = tables
@@ -155,6 +156,14 @@ def _walk_phase(
         done = done | newly_done
         return cur, elem, done, target, target_elem, material_id, flux, nseg, it + 1
 
+    if unroll > 1:
+        inner = body
+
+        def body(c):  # noqa: F811 — dispatch-amortizing unroll (walk.py)
+            for _ in range(unroll):
+                c = inner(c)
+            return c
+
     def cond(carry):
         cur, elem, done, target, *_rest, it = carry
         active = valid & ~done & (target < 0)
@@ -179,6 +188,7 @@ def make_partitioned_step(
     exchange_size: int | None = None,
     tolerance: float = 1e-8,
     score_squares: bool = True,
+    unroll: int = 1,
 ):
     """Build the jitted distributed trace step for one mesh partition.
 
@@ -243,6 +253,7 @@ def make_partitioned_step(
             score_squares=score_squares,
             max_crossings=max_crossings,
             max_local=max_local,
+            unroll=unroll,
         )
 
         def exchange(carry):

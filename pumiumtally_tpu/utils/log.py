@@ -36,7 +36,9 @@ class _TagFormatter(logging.Formatter):
             if extra:
                 payload.update(extra)
             return json.dumps(payload)
-        tag = _TAGS.get(record.levelno, f"[{record.levelname}]")
+        tag = getattr(record, "tag", None) or _TAGS.get(
+            record.levelno, f"[{record.levelname}]"
+        )
         fields = getattr(record, "fields", None)
         rendered = getattr(record, "fields_in_message", ())
         if fields and rendered:
@@ -102,5 +104,6 @@ def log_time(phase: str, seconds: float, **fields) -> None:
                 "phase": phase, "seconds": round(seconds, 6), **fields
             },
             "fields_in_message": ("phase", "seconds"),
+            "tag": "[TIME]",
         },
     )

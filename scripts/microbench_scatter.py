@@ -30,8 +30,9 @@ def bench(name, f, args, reps=20):
     for _ in range(reps):
         out = f(*args)
         args = (out,) + args[1:]
-    total = float(np.asarray(out).sum())  # readback fence
+    jax.block_until_ready(out)
     dt = (time.perf_counter() - t0) / reps
+    total = float(np.asarray(out).sum())  # checksum outside the clock
     n = args[1].shape[0]
     print(
         f"{name:9s} {dt*1e3:8.2f} ms  {n/dt/1e6:8.1f} Mupd/s  (sum {total:.3e})",

@@ -121,7 +121,8 @@ def _single_chip(mesh, elem, origin, dest, weight, group, n_groups=2):
 
 
 def _partitioned(mesh, part, elem, origin, dest, weight, group,
-                 n_groups=2, exchange_size=None, max_rounds=None):
+                 n_groups=2, exchange_size=None, max_rounds=None,
+                 unroll=1):
     n = len(elem)
     dmesh = make_device_mesh(N_DEV)
     placed = distribute_particles(
@@ -144,6 +145,7 @@ def _partitioned(mesh, part, elem, origin, dest, weight, group,
         tolerance=1e-8,
         exchange_size=exchange_size,
         max_rounds=max_rounds,
+        unroll=unroll,
     )
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -235,6 +237,22 @@ def test_partitioned_small_exchange_buffer(box):
     g_flux = assemble_global_flux(part, res.flux)
     np.testing.assert_allclose(g_flux, np.asarray(ref.flux), atol=1e-12)
     assert int(np.asarray(res.n_rounds)[0]) > 1
+
+
+def test_partitioned_unroll_matches(box):
+    """The dispatch-amortizing unroll must not change partitioned results
+    (done lanes and migration-frozen lanes are no-ops in the body)."""
+    part = partition_mesh(box, N_DEV)
+    elem, origin, dest, weight, group = _random_batch(box, 48, seed=13)
+    _, base = _partitioned(box, part, elem, origin, dest, weight, group)
+    res, got = _partitioned(
+        box, part, elem, origin, dest, weight, group, unroll=4
+    )
+    assert got["done"].all()
+    np.testing.assert_allclose(
+        got["position"], base["position"], atol=1e-12
+    )
+    np.testing.assert_array_equal(got["material_id"], base["material_id"])
 
 
 def test_morton_order_is_permutation():
