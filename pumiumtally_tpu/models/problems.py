@@ -1,0 +1,62 @@
+"""Canonical benchmark-problem geometries (the BASELINE.md ladder).
+
+Reusable constructors for the problem family the performance ladder runs
+on, so benchmarks, examples, and tests share one definition:
+
+  * unit_cube    — config 1: homogeneous unit cube (correctness scale).
+  * pincell      — config 2: one absorber pin in moderator.
+  * assembly     — configs 3/4: an N×N pin lattice (the multi-region
+    geometry that stresses material-boundary stops and, partitioned,
+    halo migration).
+
+Each returns a TetMesh whose class_id encodes the material regions
+(0 = moderator, 1..k = pins), the region scheme the reference requires of
+every input mesh (class_id tag, reference .cpp:904-906).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..mesh.box import build_box_arrays
+from ..mesh.core import TetMesh
+
+
+def unit_cube(cells: int = 12, dtype=None) -> TetMesh:
+    """Homogeneous unit cube; ~6·cells³ tets (config 1 at the default)."""
+    from ..mesh.box import build_box
+
+    return build_box(1.0, 1.0, 1.0, cells, cells, cells, dtype=dtype)
+
+
+def pincell(
+    cells: int = 16, pin_radius: float = 0.25, dtype=None
+) -> TetMesh:
+    """One z-aligned absorber pin (region 1) centered in moderator
+    (region 0)."""
+    coords, tets = build_box_arrays(1.0, 1.0, 1.0, cells, cells, cells)
+    centroids = coords[tets].mean(axis=1)
+    r = np.linalg.norm(centroids[:, :2] - 0.5, axis=1)
+    class_id = (r < pin_radius).astype(np.int32)
+    return TetMesh.from_numpy(coords, tets, class_id, dtype=dtype)
+
+
+def assembly(
+    cells: int = 32,
+    lattice: int = 3,
+    pin_radius_frac: float = 0.35,
+    dtype=None,
+) -> TetMesh:
+    """An N×N pin lattice in a unit box: pin (i, j) gets region id
+    1 + i*lattice + j; moderator is region 0. ~6·cells³ tets."""
+    coords, tets = build_box_arrays(1.0, 1.0, 1.0, cells, cells, cells)
+    centroids = coords[tets].mean(axis=1)
+    pitch = 1.0 / lattice
+    radius = pin_radius_frac * pitch
+    ij = np.floor(centroids[:, :2] / pitch).astype(np.int64)
+    ij = np.clip(ij, 0, lattice - 1)
+    center = (ij + 0.5) * pitch
+    in_pin = np.linalg.norm(centroids[:, :2] - center, axis=1) < radius
+    class_id = np.where(
+        in_pin, 1 + ij[:, 0] * lattice + ij[:, 1], 0
+    ).astype(np.int32)
+    return TetMesh.from_numpy(coords, tets, class_id, dtype=dtype)

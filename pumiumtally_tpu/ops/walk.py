@@ -111,6 +111,7 @@ def trace_impl(
     tolerance: float = 1e-8,
     compact_after: int | None = None,
     compact_size: int | None = None,
+    compact_stages: tuple | None = None,
     unroll: int = 1,
     packed_gathers: bool = False,
     fused_scatter: bool = False,
@@ -138,6 +139,14 @@ def trace_impl(
       compact_after: if set, crossings after this many full-batch iterations
         run on compacted straggler subsets (see module docstring).
       compact_size: lane count of the straggler subsets (default n // 8).
+      compact_stages: generalizes the two knobs above to a schedule:
+        ((start_crossing, subset_size), ...) with strictly increasing
+        starts. Each intermediate stage runs ONE compaction round of its
+        width until the next stage's start; the final stage loops rounds
+        to completion (identical semantics to compact_after/compact_size,
+        which are sugar for a single stage). Lanes that don't fit a
+        stage's width simply wait for a later stage — the final stage
+        guarantees completion.
       unroll: crossings advanced per while-loop iteration. The body is a
         no-op for already-done lanes, so semantics are unchanged; unrolling
         amortizes the per-iteration dispatch overhead of a TPU while_loop
@@ -326,51 +335,93 @@ def trace_impl(
 
         return jax.lax.while_loop(cond, body, carry)
 
+    if compact_stages is None and compact_after is not None:
+        compact_stages = (
+            (
+                compact_after,
+                compact_size if compact_size is not None else max(n // 8, 256),
+            ),
+        )
+    if compact_stages is not None:
+        if len(compact_stages) == 0:
+            raise ValueError(
+                "compact_stages must be None or a non-empty schedule"
+            )
+        starts = [s for s, _ in compact_stages]
+        if starts != sorted(set(starts)):
+            raise ValueError(
+                f"compact_stages starts must be strictly increasing: {starts}"
+            )
+
     full_body = make_body(dest, in_flight, weight, group)
     phase1_bound = (
-        max_crossings if compact_after is None
-        else min(compact_after, max_crossings)
+        max_crossings if compact_stages is None
+        else min(compact_stages[0][0], max_crossings)
     )
     carry = (origin, elem, done0, material_id, flux, nseg0, jnp.int32(0))
     cur, elem, done, material_id, flux, nseg, it = run_phase(
         full_body, carry, phase1_bound
     )
 
-    if compact_after is not None and phase1_bound < max_crossings:
-        S = min(n, compact_size if compact_size is not None else max(n // 8, 256))
-        max_rounds = -(-n // S) + 1  # every round retires ≥S actives or all
-
-        def outer_body(c):
-            cur, elem, done, material_id, flux, nseg, it, rounds = c
-            # Stable sort of the done mask puts active lanes first.
-            idx = jnp.argsort(done)[:S]
-            sub_body = make_body(
-                dest[idx], in_flight[idx], weight[idx], group[idx]
-            )
-            sub_carry = (
-                cur[idx], elem[idx], done[idx], material_id[idx],
-                flux, nseg, jnp.int32(0),
-            )
-            scur, selem, sdone, smat, flux, nseg, sit = run_phase(
-                sub_body, sub_carry, max_crossings
-            )
-            cur = cur.at[idx].set(scur)
-            elem = elem.at[idx].set(selem)
-            done = done.at[idx].set(sdone)
-            material_id = material_id.at[idx].set(smat)
-            return cur, elem, done, material_id, flux, nseg, it + sit, rounds + 1
-
-        def outer_cond(c):
-            done, rounds = c[2], c[-1]
-            return jnp.logical_and(
-                rounds < max_rounds, jnp.logical_not(jnp.all(done))
-            )
-
-        cur, elem, done, material_id, flux, nseg, it, _ = jax.lax.while_loop(
-            outer_cond,
-            outer_body,
-            (cur, elem, done, material_id, flux, nseg, it, jnp.int32(0)),
+    def compact_round(state, S, bound):
+        """One compaction round: gather the S most-active lanes, advance
+        them up to `bound` crossings, scatter results back."""
+        cur, elem, done, material_id, flux, nseg, it = state
+        # Stable sort of the done mask puts active lanes first.
+        idx = jnp.argsort(done)[:S]
+        sub_body = make_body(
+            dest[idx], in_flight[idx], weight[idx], group[idx]
         )
+        sub_carry = (
+            cur[idx], elem[idx], done[idx], material_id[idx],
+            flux, nseg, jnp.int32(0),
+        )
+        scur, selem, sdone, smat, flux, nseg, sit = run_phase(
+            sub_body, sub_carry, bound
+        )
+        cur = cur.at[idx].set(scur)
+        elem = elem.at[idx].set(selem)
+        done = done.at[idx].set(sdone)
+        material_id = material_id.at[idx].set(smat)
+        return cur, elem, done, material_id, flux, nseg, it + sit
+
+    if compact_stages is not None and phase1_bound < max_crossings:
+        state = (cur, elem, done, material_id, flux, nseg, it)
+        for i, (start, size) in enumerate(compact_stages):
+            S = min(n, max(int(size), 1))
+            if i + 1 < len(compact_stages):
+                # Intermediate stage: one bounded round; leftovers wait.
+                # Guarded so an all-done batch skips the argsort +
+                # gather/scatter entirely (the guard the final stage's
+                # outer_cond provides).
+                span = min(compact_stages[i + 1][0], max_crossings) - start
+                if span > 0:
+                    state = jax.lax.cond(
+                        jnp.all(state[2]),
+                        lambda s: s,
+                        lambda s: compact_round(s, S, span),
+                        state,
+                    )
+            else:
+                # Final stage: loop rounds to completion.
+                max_rounds = -(-n // S) + 1  # each retires ≥S actives or all
+
+                def outer_body(c):
+                    *st, rounds = c
+                    st = compact_round(tuple(st), S, max_crossings)
+                    return (*st, rounds + 1)
+
+                def outer_cond(c):
+                    done, rounds = c[2], c[-1]
+                    return jnp.logical_and(
+                        rounds < max_rounds, jnp.logical_not(jnp.all(done))
+                    )
+
+                *state, _ = jax.lax.while_loop(
+                    outer_cond, outer_body, (*state, jnp.int32(0))
+                )
+                state = tuple(state)
+        cur, elem, done, material_id, flux, nseg, it = state
 
     return TraceResult(
         position=cur,
@@ -413,6 +464,7 @@ trace = jax.jit(
         "tolerance",
         "compact_after",
         "compact_size",
+        "compact_stages",
         "unroll",
         "packed_gathers",
         "fused_scatter",

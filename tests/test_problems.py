@@ -1,0 +1,40 @@
+"""Benchmark-problem geometry constructors."""
+from __future__ import annotations
+
+import numpy as np
+
+from pumiumtally_tpu.models.problems import assembly, pincell, unit_cube
+
+
+def test_unit_cube_counts():
+    m = unit_cube(4)
+    assert m.ntet == 6 * 4**3
+    assert np.all(np.asarray(m.class_id) == 0)
+
+
+def test_pincell_regions():
+    m = pincell(8, pin_radius=0.3)
+    cid = np.asarray(m.class_id)
+    assert set(np.unique(cid)) == {0, 1}
+    # Pin occupies roughly pi*r^2 of the cross-section.
+    frac = (cid == 1).mean()
+    assert 0.5 * np.pi * 0.09 < frac < 1.6 * np.pi * 0.09
+
+
+def test_assembly_lattice_ids():
+    m = assembly(cells=12, lattice=3)
+    cid = np.asarray(m.class_id)
+    pins = set(np.unique(cid)) - {0}
+    assert pins == set(range(1, 10))
+    # Each pin region is spatially coherent: its centroids cluster inside
+    # one lattice cell.
+    coords = np.asarray(m.coords)
+    tets = np.asarray(m.tet2vert)
+    centroids = coords[tets].mean(axis=1)
+    for pid in pins:
+        i, j = (pid - 1) // 3, (pid - 1) % 3
+        c = centroids[cid == pid][:, :2]
+        assert np.all(c[:, 0] >= i / 3 - 1e-9)
+        assert np.all(c[:, 0] <= (i + 1) / 3 + 1e-9)
+        assert np.all(c[:, 1] >= j / 3 - 1e-9)
+        assert np.all(c[:, 1] <= (j + 1) / 3 + 1e-9)

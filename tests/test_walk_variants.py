@@ -46,8 +46,9 @@ def setup():
         dict(fused_scatter=True),
         dict(unroll=8, packed_gathers=True, fused_scatter=True,
              compact_after=4, compact_size=32),
+        dict(compact_stages=((4, 64), (8, 48), (16, 24)), unroll=2),
     ],
-    ids=["unroll", "packed", "fused", "all"],
+    ids=["unroll", "packed", "fused", "all", "stages"],
 )
 def test_variant_matches_baseline(setup, variant):
     mesh, args, kw, base = setup
