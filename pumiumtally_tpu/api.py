@@ -100,6 +100,9 @@ class PumiTally:
             self.num_particles = int(num_particles)
             self._max_crossings = cfg.resolve_max_crossings(mesh.ntet)
             self._compact = cfg.resolve_compaction(int(num_particles))
+            self._compact_stages = cfg.resolve_compact_stages(
+                int(num_particles)
+            )
             self.state: ParticleState = seed_at_element_centroid(
                 make_particle_state(self.num_particles, dtype=cfg.dtype), mesh
             )
@@ -197,6 +200,7 @@ class PumiTally:
                 tolerance=self.config.tolerance,
                 compact_after=self._compact[0],
                 compact_size=self._compact[1],
+                compact_stages=self._compact_stages,
                 unroll=self.config.unroll,
             )
             self.flux = result.flux
@@ -270,6 +274,7 @@ class PumiTally:
                 tolerance=cfg.tolerance,
                 compact_after=self._compact[0],
                 compact_size=self._compact[1],
+                compact_stages=self._compact_stages,
                 unroll=cfg.unroll,
             )
             self.flux = result.flux

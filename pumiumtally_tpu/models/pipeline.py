@@ -115,6 +115,7 @@ class StreamingTallyPipeline:
                     cfg.resolve_compaction(n),
                 )
             ),
+            compact_stages=cfg.resolve_compact_stages(n),
             unroll=cfg.unroll,
         )
         # The flux chain threads through every batch (donated each step);

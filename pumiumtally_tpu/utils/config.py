@@ -32,6 +32,10 @@ class TallyConfig:
         in (ops/walk.py module docstring); None disables compaction. The
         facade disables it automatically for small particle counts.
       compact_size: straggler subset lane count (default n_particles // 8).
+      compact_stages: multi-stage compaction schedule
+        ((start_crossing, subset_size), ...) overriding the two knobs
+        above (ops/walk.py docstring); the measured-fastest schedule on
+        v5e is n/2@16 → n/4@24 → n/8@40 (BENCHMARKS.md).
       unroll: boundary crossings advanced per while-loop iteration
         (ops/walk.py). The TPU while_loop is dispatch-bound, so unrolling
         the body ~2x's throughput (scripts/sweep_unroll.py); done lanes
@@ -62,6 +66,7 @@ class TallyConfig:
     max_crossings: int | None = None
     compact_after: int | None = 32
     compact_size: int | None = None
+    compact_stages: tuple | None = None
     unroll: int = 8
     migration_period: int = 100
     sort_by_element: bool = False
@@ -89,3 +94,13 @@ class TallyConfig:
         if size is None:
             size = max(256, n_particles // 8)
         return self.compact_after, min(size, n_particles)
+
+    def resolve_compact_stages(self, n_particles: int) -> tuple | None:
+        """Clamp a configured stage schedule to the batch size (None when
+        unset — the single-stage knobs apply)."""
+        if self.compact_stages is None or n_particles < 1024:
+            return None
+        return tuple(
+            (int(start), min(max(int(size), 1), n_particles))
+            for start, size in self.compact_stages
+        )
