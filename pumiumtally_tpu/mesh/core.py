@@ -44,15 +44,30 @@ class TetMesh:
       face_d: [ntet, 4] plane offsets; a point x is outside face f when
         dot(n_f, x) > d_f.
       volumes: [ntet] positive tet volumes.
-      packed_geo: [ntet, 16] per-element walk geometry — the 12 normal
-        components followed by the 4 plane offsets — so the hot loop's
-        geometry lookup is ONE gather per crossing instead of two. Only
-        built with ``pack_tables=True`` (None otherwise): on TPU v5e the
-        separate narrow gathers measured faster (scripts/sweep_unroll.py),
-        and the packed copies cost ~112 B/tet of HBM.
-      packed_topo: [ntet, 12] int32 per-element walk topology — tet2tet(4),
-        neighbor class_id(4, own class on boundaries), and a 0/1
-        class-differs flag(4). None unless ``pack_tables=True``.
+      geo16: [ntet, 16] per-element walk geometry — the 12 normal components
+        followed by the 4 plane offsets. On TPU a 16-wide row gather costs
+        the same as the 12-wide normals gather alone
+        (scripts/microbench_costmodel.py: 24.8 ms vs 24.2+14.3 ms separate
+        at 1M indices), so the hot loop reads geometry in ONE gather.
+      topo_flat: [ntet*4] int32 packed per-face walk topology, indexed by
+        ``elem*4 + face`` (a flat 1-D gather costs 10.7 ms/M rows vs
+        17.7 ms for the 2-D form). Bit layout:
+          bits 0..23  neighbor element id + 1 (0 = domain boundary)
+          bits 24..29 class INDEX of the neighbor (into class_values)
+          bit  30     1 when the neighbor's class_id differs (material
+                      boundary, reference cpp:473-479)
+        None when the mesh exceeds the packing limits (ntet+1 >= 2^24 or
+        more than 64 distinct class ids); the walk then falls back to the
+        unpacked tables.
+      class_values: [nclasses] int32 sorted distinct class_id values;
+        topo_flat stores indices into this so material ids are resolved
+        with one tiny-table gather after the walk instead of a full
+        class_id gather per crossing.
+      packed_geo: [ntet, 16] legacy alias table for the ``packed_gathers``
+        walk option. Only built with ``pack_tables=True``.
+      packed_topo: [ntet, 12] int32 legacy per-element walk topology —
+        tet2tet(4), neighbor class_id(4, own class on boundaries), and a
+        0/1 class-differs flag(4). None unless ``pack_tables=True``.
     """
 
     coords: jax.Array
@@ -64,6 +79,9 @@ class TetMesh:
     volumes: jax.Array
     packed_geo: jax.Array | None = None
     packed_topo: jax.Array | None = None
+    geo16: jax.Array | None = None
+    topo_flat: jax.Array | None = None
+    class_values: jax.Array | None = None
 
     # -- pytree protocol ----------------------------------------------------
     def tree_flatten(self):
@@ -77,6 +95,9 @@ class TetMesh:
             self.volumes,
             self.packed_geo,
             self.packed_topo,
+            self.geo16,
+            self.topo_flat,
+            self.class_values,
         )
         return children, None
 
@@ -132,23 +153,34 @@ class TetMesh:
             normals, d = _face_planes(coords, tet2vert)
         tet2tet = build_tet2tet(tet2vert)
 
-        packed_geo = packed_topo = None
+        nbr_safe = np.maximum(tet2tet, 0)
+        nbr_class = np.where(
+            tet2tet >= 0, class_id[nbr_safe], class_id[:, None]
+        )
+        differs = (
+            (tet2tet >= 0) & (nbr_class != class_id[:, None])
+        ).astype(np.int64)
+
+        packed_topo = None
         if pack_tables:
-            packed_geo = np.concatenate(
-                [normals.reshape(ntet, 12), d], axis=1
-            )
-            nbr_safe = np.maximum(tet2tet, 0)
-            nbr_class = np.where(
-                tet2tet >= 0, class_id[nbr_safe], class_id[:, None]
-            )
-            differs = (
-                (tet2tet >= 0) & (nbr_class != class_id[:, None])
-            ).astype(np.int64)
             packed_topo = np.concatenate(
                 [tet2tet, nbr_class, differs], axis=1
             )
 
+        geo16 = np.concatenate([normals.reshape(ntet, 12), d], axis=1)
+        class_values, class_idx = np.unique(class_id, return_inverse=True)
+        topo_flat = None
+        if ntet + 1 < (1 << 24) and class_values.shape[0] <= 64:
+            nbr_clsidx = class_idx[nbr_safe]  # [ntet, 4]
+            code = (
+                (tet2tet + 1)
+                | (nbr_clsidx.astype(np.int64) << 24)
+                | (differs << 30)
+            )
+            topo_flat = code.reshape(ntet * 4).astype(np.int32)
+
         put = lambda a, dt: jnp.asarray(a, dtype=dt)
+        geo16_dev = put(geo16, dtype)
         return cls(
             coords=put(coords, dtype),
             tet2vert=put(tet2vert, jnp.int32),
@@ -157,10 +189,17 @@ class TetMesh:
             face_normals=put(normals, dtype),
             face_d=put(d, dtype),
             volumes=put(volumes, dtype),
-            packed_geo=None if packed_geo is None else put(packed_geo, dtype),
+            # Same layout as geo16; alias the same device buffer rather
+            # than holding a second identical [ntet,16] copy.
+            packed_geo=geo16_dev if pack_tables else None,
             packed_topo=(
                 None if packed_topo is None else put(packed_topo, jnp.int32)
             ),
+            geo16=geo16_dev,
+            topo_flat=(
+                None if topo_flat is None else put(topo_flat, jnp.int32)
+            ),
+            class_values=put(class_values.astype(np.int64), jnp.int32),
         )
 
 

@@ -37,7 +37,19 @@ one-hot-matmul forms all fail to lower inside a kernel
 (scripts/probe_pallas_gather.py records the probes on hardware), so a
 Pallas version could only scalar-loop over lanes, far slower than XLA's
 native gather/scatter ops. Pallas wins on dense tiled compute; this op is
-neither.
+neither. (A VMEM-resident table kernel is also out: this generation has
+~16 MB VMEM/core, far below the ~80 MB of walk tables at 1M tets.)
+
+Gather budget (round 2). TPU gather cost at 1M indices is ~10.7 ms base
++ ~1 ms per 4-byte column, independent of table size and index order
+(scripts/microbench_costmodel.py). The walk therefore reads, per
+crossing, exactly TWO gathers when the mesh carries the packed tables:
+one 16-wide ``geo16`` row (face normals + plane offsets — costs the same
+as the 12-wide normals gather alone) and one 1-D ``topo_flat`` scalar
+(neighbor + material-boundary bit + neighbor class index, decoded by bit
+masks), replacing the four separate gathers (normals, offsets, neighbor,
+class) of the round-1 body. Material ids are resolved from class
+*indices* with one tiny-table gather after the loop, never per crossing.
 
 Straggler compaction
 --------------------
@@ -133,9 +145,15 @@ def trace_impl(
         particle (cpp:472's !initial guard); only the domain boundary clips.
       max_crossings: static bound on boundary crossings; the loop exits as
         soon as every particle is done.
-      tolerance: geometric tolerance (reference walk tol 1e-8, cpp:123,206):
-        a destination within tolerance (in ray-parameter space) of the exit
-        face counts as inside the current element.
+      tolerance: GEOMETRIC tolerance (reference walk tol 1e-8, cpp:123,206):
+        a destination within this distance of the exit face counts as
+        inside the current element. Converted to ray-parameter space per
+        particle per crossing as ``tolerance / |dest - cur|`` (plane
+        normals are unit, so ray-parameter × |ray| = geometric distance),
+        then floored at ``8·eps(dtype)`` so the comparison
+        ``t_exit >= 1 - tol`` cannot round to a no-op in float32 (under
+        f32, ``1 - 1e-8 == 1`` exactly; the floor makes the effective
+        tolerance a few ulps of the ray length instead of zero).
       compact_after: if set, crossings after this many full-batch iterations
         run on compacted straggler subsets (see module docstring).
       compact_size: lane count of the straggler subsets (default n // 8).
@@ -183,11 +201,33 @@ def trace_impl(
     # (cpp:634-638). The facade additionally rejects them host-side.
     group = group.astype(jnp.int32)
 
+    # Two-gather packed body (see module docstring "Gather budget"); falls
+    # back to the round-1 four-gather body when the mesh lacks the packed
+    # tables (>=2^24 elements or >64 classes) or legacy packed_gathers is
+    # requested.
+    v2 = (
+        not packed_gathers
+        and getattr(mesh, "geo16", None) is not None
+        and getattr(mesh, "topo_flat", None) is not None
+    )
+
     done0 = jnp.logical_not(in_flight)
     # Derive the zero from a per-particle input so the counter carries the
     # same device-varying type as its in-loop update under shard_map.
     nseg_dtype = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
     nseg0 = jnp.sum(in_flight).astype(nseg_dtype) * 0
+
+    # In the v2 body the loop-carried material lane holds a CODE, resolved
+    # to real class values once after the loop: -2 = untouched (keep the
+    # caller's material_id), -1 = destination reached / domain exit,
+    # >=0 = index into mesh.class_values of the stopping neighbor.
+    # (derived from material_id, not jnp.full, so the carry keeps the same
+    # device-varying type under shard_map — see nseg0 below.)
+    mat0 = material_id * 0 - 2 if v2 else material_id
+
+    # Ray-parameter tolerance floor: a few ulps so `t >= 1 - tol` survives
+    # f32 rounding (1 - 1e-8 == 1 in f32). See the tolerance docstring.
+    tol_floor = 8 * float(jnp.finfo(dtype).eps)
 
     def make_body(dest_a, in_flight_a, weight_a, group_a):
         """One element-boundary crossing for every lane of a (sub)batch.
@@ -198,11 +238,15 @@ def trace_impl(
         scat_group = jnp.where(group_a < 0, n_groups, group_a)
 
         def body(carry):
-            cur, elem, done, material_id, flux, nseg, it = carry
+            cur, elem, done, mat, flux, nseg, it = carry
             active = jnp.logical_not(done)
 
             dirv = dest_a - cur
-            if packed_gathers:
+            if v2:
+                geo = mesh.geo16[elem]  # [m, 16] — ONE geometry gather
+                normals = geo[:, :12].reshape(-1, 4, 3)
+                dplane = geo[:, 12:16]
+            elif packed_gathers:
                 # One gather for all walk geometry (normals + plane offsets)
                 # and one for all topology (neighbor, neighbor class,
                 # differs flag).
@@ -214,21 +258,33 @@ def trace_impl(
                 dplane = mesh.face_d[elem]
             t_exit, face, has_exit = exit_face(normals, dplane, cur, dirv)
 
+            # Geometric tolerance → ray-parameter space (normals are unit,
+            # so geometric distance = t × |dirv|), floored at a few ulps.
+            dnorm = jnp.linalg.norm(dirv, axis=-1)
+            tol_eff = jnp.maximum(
+                tolerance / jnp.where(dnorm > 0, dnorm, 1.0), tol_floor
+            ).astype(dtype)
             reached = jnp.logical_or(
-                t_exit >= 1.0 - tolerance, jnp.logical_not(has_exit)
+                t_exit >= 1.0 - tol_eff, jnp.logical_not(has_exit)
             )
             t_step = jnp.minimum(t_exit, 1.0)
             xpoint = cur + t_step[:, None] * dirv
 
             crossed = active & ~reached & has_exit
-            face_col = face[:, None]
-            if packed_gathers:
-                topo = mesh.packed_topo[elem]  # [m, 12]
-                nbr = jnp.take_along_axis(topo[:, 0:4], face_col, axis=1)[
-                    :, 0
-                ]
+            if v2:
+                # ONE flat topology gather: neighbor id, material-boundary
+                # bit and neighbor class index in a single int32.
+                code = mesh.topo_flat[elem * 4 + face]
+                nbr = (code & 0xFFFFFF) - 1
             else:
-                nbr = mesh.tet2tet[elem, face]
+                face_col = face[:, None]
+                if packed_gathers:
+                    topo = mesh.packed_topo[elem]  # [m, 12]
+                    nbr = jnp.take_along_axis(
+                        topo[:, 0:4], face_col, axis=1
+                    )[:, 0]
+                else:
+                    nbr = mesh.tet2tet[elem, face]
             next_elem = jnp.where(crossed, nbr, jnp.int32(-1))
 
             if debug_checks:
@@ -245,7 +301,7 @@ def trace_impl(
 
             # --- tally (skipped on the initial location search) -----------
             if not initial:
-                seg = jnp.linalg.norm(xpoint - cur, axis=-1)
+                seg = t_step * dnorm  # |xpoint - cur|
                 score = active & in_flight_a
                 contrib = jnp.where(score, seg * weight_a, 0.0).astype(dtype)
                 scat_elem = jnp.where(score, elem, ntet)  # OOB rows drop
@@ -280,7 +336,12 @@ def trace_impl(
             if initial:
                 material_stop = jnp.zeros_like(domain_exit)
             else:
-                if packed_gathers:
+                if v2:
+                    # differs bit is only ever set for interior faces, so
+                    # no next_elem >= 0 check is needed.
+                    material_stop = crossed & (((code >> 30) & 1) == 1)
+                    nbr_class = (code >> 24) & 0x3F  # class INDEX
+                elif packed_gathers:
                     nbr_class = jnp.take_along_axis(
                         topo[:, 4:8], face_col, axis=1
                     )[:, 0]
@@ -300,13 +361,13 @@ def trace_impl(
             newly_done = (active & reached) | domain_exit | material_stop
 
             if not initial:
-                material_id = jnp.where(
+                mat = jnp.where(
                     material_stop,
                     nbr_class,
                     jnp.where(
                         (active & reached) | domain_exit,
                         jnp.int32(-1),
-                        material_id,
+                        mat,
                     ),
                 )
 
@@ -315,7 +376,7 @@ def trace_impl(
             elem = jnp.where(crossed & (next_elem != -1), next_elem, elem)
             cur = jnp.where(active[:, None], xpoint, cur)
             done = done | newly_done
-            return cur, elem, done, material_id, flux, nseg, it + 1
+            return cur, elem, done, mat, flux, nseg, it + 1
 
         return body
 
@@ -358,35 +419,55 @@ def trace_impl(
         max_crossings if compact_stages is None
         else min(compact_stages[0][0], max_crossings)
     )
-    carry = (origin, elem, done0, material_id, flux, nseg0, jnp.int32(0))
-    cur, elem, done, material_id, flux, nseg, it = run_phase(
+    carry = (origin, elem, done0, mat0, flux, nseg0, jnp.int32(0))
+    cur, elem, done, mat, flux, nseg, it = run_phase(
         full_body, carry, phase1_bound
     )
 
+    lane_ids = jnp.arange(n, dtype=jnp.int32)
+
     def compact_round(state, S, bound):
-        """One compaction round: gather the S most-active lanes, advance
-        them up to `bound` crossings, scatter results back."""
-        cur, elem, done, material_id, flux, nseg, it = state
-        # Stable sort of the done mask puts active lanes first.
-        idx = jnp.argsort(done)[:S]
+        """One compaction round: gather the first S active lanes, advance
+        them up to `bound` crossings, scatter results back.
+
+        The active-lane index is built with a cumsum stable partition (one
+        n-row scalar scatter) instead of argsort — same first-S-active
+        selection, far cheaper than a 1M-lane sort. Slots past the number
+        of active lanes gather clamped garbage; they are neutralized by
+        forcing their done flag and dropping their write-back rows."""
+        cur, elem, done, mat, flux, nseg, it = state
+        active = jnp.logical_not(done)
+        n_active = jnp.sum(active.astype(jnp.int32))
+        pos = jnp.cumsum(active.astype(jnp.int32)) - 1
+        dst = jnp.where(active, pos, n)
+        idx = (
+            jnp.zeros(n, jnp.int32)
+            .at[dst]
+            .set(lane_ids, mode="drop")[:S]
+        )
+        valid = jnp.arange(S) < n_active
         sub_body = make_body(
-            dest[idx], in_flight[idx], weight[idx], group[idx]
+            dest[idx],
+            jnp.ones(S, bool),  # selected lanes are in flight by definition
+            weight[idx],
+            group[idx],
         )
         sub_carry = (
-            cur[idx], elem[idx], done[idx], material_id[idx],
+            cur[idx], elem[idx], jnp.logical_not(valid), mat[idx],
             flux, nseg, jnp.int32(0),
         )
         scur, selem, sdone, smat, flux, nseg, sit = run_phase(
             sub_body, sub_carry, bound
         )
-        cur = cur.at[idx].set(scur)
-        elem = elem.at[idx].set(selem)
-        done = done.at[idx].set(sdone)
-        material_id = material_id.at[idx].set(smat)
-        return cur, elem, done, material_id, flux, nseg, it + sit
+        idx_sb = jnp.where(valid, idx, n)
+        cur = cur.at[idx_sb].set(scur, mode="drop")
+        elem = elem.at[idx_sb].set(selem, mode="drop")
+        done = done.at[idx_sb].set(sdone, mode="drop")
+        mat = mat.at[idx_sb].set(smat, mode="drop")
+        return cur, elem, done, mat, flux, nseg, it + sit
 
     if compact_stages is not None and phase1_bound < max_crossings:
-        state = (cur, elem, done, material_id, flux, nseg, it)
+        state = (cur, elem, done, mat, flux, nseg, it)
         for i, (start, size) in enumerate(compact_stages):
             S = min(n, max(int(size), 1))
             if i + 1 < len(compact_stages):
@@ -421,7 +502,23 @@ def trace_impl(
                     outer_cond, outer_body, (*state, jnp.int32(0))
                 )
                 state = tuple(state)
-        cur, elem, done, material_id, flux, nseg, it = state
+        cur, elem, done, mat, flux, nseg, it = state
+
+    if v2:
+        # Resolve material codes to real class_id values (one tiny-table
+        # gather): -2 → caller's material_id untouched, -1 → reached /
+        # domain exit, >=0 → class_values[index] of the stopping neighbor.
+        material_id = jnp.where(
+            mat == -2,
+            material_id,
+            jnp.where(
+                mat == -1,
+                jnp.int32(-1),
+                mesh.class_values[jnp.maximum(mat, 0)],
+            ),
+        )
+    else:
+        material_id = mat
 
     return TraceResult(
         position=cur,

@@ -101,8 +101,16 @@ def _walk_phase(
         dplane = faced_t[elem]
         t_exit, face, has_exit = exit_face(normals, dplane, cur, dirv)
 
+        # Geometric tolerance → ray-parameter space with an ulp floor,
+        # matching ops/walk.py exactly so the partitioned and single-chip
+        # walks agree on borderline reached decisions.
+        dnorm = jnp.linalg.norm(dirv, axis=-1)
+        tol_eff = jnp.maximum(
+            tolerance / jnp.where(dnorm > 0, dnorm, 1.0),
+            8 * float(jnp.finfo(dtype).eps),
+        ).astype(dtype)
         reached = jnp.logical_or(
-            t_exit >= 1.0 - tolerance, jnp.logical_not(has_exit)
+            t_exit >= 1.0 - tol_eff, jnp.logical_not(has_exit)
         )
         t_step = jnp.minimum(t_exit, 1.0)
         xpoint = cur + t_step[:, None] * dirv
