@@ -85,7 +85,7 @@ def build_box(
     nz: int = 1,
     class_id: np.ndarray | None = None,
     dtype=None,
-    pack_tables: bool = False,
+    packed: bool = True,
 ) -> TetMesh:
     """Build a TetMesh box. All elements share class_id 0 unless given
     (a uniform single-region box, matching the build_box fixture)."""
@@ -95,5 +95,5 @@ def build_box(
     return TetMesh.from_numpy(
         coords, tet2vert, class_id=class_id,
         dtype=jnp.float32 if dtype is None else dtype,
-        pack_tables=pack_tables,
+        packed=packed,
     )

@@ -28,6 +28,14 @@ FACE_LOCAL_VERTS = np.array(
 )
 
 
+def can_pack_walk_tables(ntet: int, nclasses: int, itemsize: int) -> bool:
+    """Whether the merged geo20 walk table can encode this mesh: neighbor
+    ids + 1 must fit 24 bits (largest stored code is ntet-1 + 1 = ntet),
+    class indices 6 bits, and the float dtype must be 4 or 8 bytes wide
+    for the int-bits bitcast."""
+    return ntet < (1 << 24) and nclasses <= 64 and itemsize in (4, 8)
+
+
 @jax.tree_util.register_pytree_node_class
 @dataclasses.dataclass(frozen=True)
 class TetMesh:
@@ -44,30 +52,27 @@ class TetMesh:
       face_d: [ntet, 4] plane offsets; a point x is outside face f when
         dot(n_f, x) > d_f.
       volumes: [ntet] positive tet volumes.
-      geo16: [ntet, 16] per-element walk geometry — the 12 normal components
-        followed by the 4 plane offsets. On TPU a 16-wide row gather costs
-        the same as the 12-wide normals gather alone
-        (scripts/microbench_costmodel.py: 24.8 ms vs 24.2+14.3 ms separate
-        at 1M indices), so the hot loop reads geometry in ONE gather.
-      topo_flat: [ntet*4] int32 packed per-face walk topology, indexed by
-        ``elem*4 + face`` (a flat 1-D gather costs 10.7 ms/M rows vs
-        17.7 ms for the 2-D form). Bit layout:
+      geo20: [ntet, 20] per-element walk table — EVERYTHING the hot loop
+        needs about an element in ONE gather: the 12 outward unit face
+        normal components, the 4 plane offsets, then the 4 per-face
+        topology codes BITCAST into the float dtype (a gather moves bits
+        untouched, so storing int codes as floats is safe; the walk
+        bitcasts them back). TPU gather cost is flat in row width up to
+        ~24 f32 columns (scripts/microbench_costmodel2.py), so the merged
+        row costs the same as the 16-wide geometry row alone and saves the
+        round-2 body's separate topology gather entirely. Code bit layout
+        (in int32; stored widened to int64 bits for float64 meshes):
           bits 0..23  neighbor element id + 1 (0 = domain boundary)
           bits 24..29 class INDEX of the neighbor (into class_values)
           bit  30     1 when the neighbor's class_id differs (material
                       boundary, reference cpp:473-479)
         None when the mesh exceeds the packing limits (ntet+1 >= 2^24 or
-        more than 64 distinct class ids); the walk then falls back to the
-        unpacked tables.
+        more than 64 distinct class ids) or ``packed=False``; the walk
+        then falls back to the unpacked four-gather tables.
       class_values: [nclasses] int32 sorted distinct class_id values;
-        topo_flat stores indices into this so material ids are resolved
+        geo20 codes store indices into this so material ids are resolved
         with one tiny-table gather after the walk instead of a full
         class_id gather per crossing.
-      packed_geo: [ntet, 16] legacy alias table for the ``packed_gathers``
-        walk option. Only built with ``pack_tables=True``.
-      packed_topo: [ntet, 12] int32 legacy per-element walk topology —
-        tet2tet(4), neighbor class_id(4, own class on boundaries), and a
-        0/1 class-differs flag(4). None unless ``pack_tables=True``.
     """
 
     coords: jax.Array
@@ -77,10 +82,7 @@ class TetMesh:
     face_normals: jax.Array
     face_d: jax.Array
     volumes: jax.Array
-    packed_geo: jax.Array | None = None
-    packed_topo: jax.Array | None = None
-    geo16: jax.Array | None = None
-    topo_flat: jax.Array | None = None
+    geo20: jax.Array | None = None
     class_values: jax.Array | None = None
 
     # -- pytree protocol ----------------------------------------------------
@@ -93,10 +95,7 @@ class TetMesh:
             self.face_normals,
             self.face_d,
             self.volumes,
-            self.packed_geo,
-            self.packed_topo,
-            self.geo16,
-            self.topo_flat,
+            self.geo20,
             self.class_values,
         )
         return children, None
@@ -131,7 +130,7 @@ class TetMesh:
         tet2vert: np.ndarray,
         class_id: np.ndarray | None = None,
         dtype: Any = jnp.float32,
-        pack_tables: bool = False,
+        packed: bool = True,
     ) -> "TetMesh":
         """Build all derived tables on host (float64 numpy for precision),
         then place them on device in the requested dtype."""
@@ -161,26 +160,36 @@ class TetMesh:
             (tet2tet >= 0) & (nbr_class != class_id[:, None])
         ).astype(np.int64)
 
-        packed_topo = None
-        if pack_tables:
-            packed_topo = np.concatenate(
-                [tet2tet, nbr_class, differs], axis=1
-            )
-
-        geo16 = np.concatenate([normals.reshape(ntet, 12), d], axis=1)
         class_values, class_idx = np.unique(class_id, return_inverse=True)
-        topo_flat = None
-        if ntet + 1 < (1 << 24) and class_values.shape[0] <= 64:
+        geo20 = None
+        # Resolve the dtype the device will actually store (x64 disabled
+        # silently narrows f64→f32, which would corrupt bitcast codes if
+        # we packed int64 bits).
+        np_dtype = np.dtype(jnp.zeros((), dtype).dtype.name)
+        if packed and can_pack_walk_tables(
+            ntet, class_values.shape[0], np_dtype.itemsize
+        ):
             nbr_clsidx = class_idx[nbr_safe]  # [ntet, 4]
             code = (
                 (tet2tet + 1)
                 | (nbr_clsidx.astype(np.int64) << 24)
                 | (differs << 30)
             )
-            topo_flat = code.reshape(ntet * 4).astype(np.int32)
+            # Bitcast the codes into the mesh float dtype so geometry and
+            # topology ride one gather row; int32 bits for f32, int64 bits
+            # for f64.
+            int_t = np.int32 if np_dtype.itemsize == 4 else np.int64
+            code_f = code.astype(int_t).view(np_dtype)
+            geo20 = np.concatenate(
+                [
+                    normals.reshape(ntet, 12).astype(np_dtype),
+                    d.astype(np_dtype),
+                    code_f,
+                ],
+                axis=1,
+            )
 
         put = lambda a, dt: jnp.asarray(a, dtype=dt)
-        geo16_dev = put(geo16, dtype)
         return cls(
             coords=put(coords, dtype),
             tet2vert=put(tet2vert, jnp.int32),
@@ -189,16 +198,7 @@ class TetMesh:
             face_normals=put(normals, dtype),
             face_d=put(d, dtype),
             volumes=put(volumes, dtype),
-            # Same layout as geo16; alias the same device buffer rather
-            # than holding a second identical [ntet,16] copy.
-            packed_geo=geo16_dev if pack_tables else None,
-            packed_topo=(
-                None if packed_topo is None else put(packed_topo, jnp.int32)
-            ),
-            geo16=geo16_dev,
-            topo_flat=(
-                None if topo_flat is None else put(topo_flat, jnp.int32)
-            ),
+            geo20=None if geo20 is None else put(geo20, dtype),
             class_values=put(class_values.astype(np.int64), jnp.int32),
         )
 

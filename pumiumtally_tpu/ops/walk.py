@@ -40,16 +40,24 @@ native gather/scatter ops. Pallas wins on dense tiled compute; this op is
 neither. (A VMEM-resident table kernel is also out: this generation has
 ~16 MB VMEM/core, far below the ~80 MB of walk tables at 1M tets.)
 
-Gather budget (round 2). TPU gather cost at 1M indices is ~10.7 ms base
-+ ~1 ms per 4-byte column, independent of table size and index order
-(scripts/microbench_costmodel.py). The walk therefore reads, per
-crossing, exactly TWO gathers when the mesh carries the packed tables:
-one 16-wide ``geo16`` row (face normals + plane offsets — costs the same
-as the 12-wide normals gather alone) and one 1-D ``topo_flat`` scalar
-(neighbor + material-boundary bit + neighbor class index, decoded by bit
-masks), replacing the four separate gathers (normals, offsets, neighbor,
-class) of the round-1 body. Material ids are resolved from class
-*indices* with one tiny-table gather after the loop, never per crossing.
+Gather budget (round 3). In-loop TPU gather/scatter cost is linear in
+rows (~9-11 ns/row) with width nearly free up to ~24 f32 columns
+(scripts/microbench_costmodel2.py, microbench_record_scatter.py), so the
+walk does exactly ONE gather per crossing when the mesh carries the
+packed ``geo20`` table: a 20-wide row holding face normals, plane
+offsets, AND the four per-face topology codes bitcast into the float
+dtype (neighbor + material-boundary bit + neighbor class index, decoded
+by bit masks after the exit face is known). This replaces round 2's
+geo16 + topo_flat pair (two gathers) and round 1's four separate
+gathers. Material ids are resolved from class *indices* with one
+tiny-table gather after the loop, never per crossing.
+
+Tally scatter: both tally rows (c into slot 0, c² into slot 1) ride ONE
+interleaved scalar scatter-add into the flux viewed flat as
+[ntet*n_groups*2] — keys 2k and 2k+1 — which measures ~11% cheaper than
+two separate scatters and 3.6× cheaper than a 2-wide window scatter
+(scripts/microbench_complex_scatter.py; complex64 packing is
+unimplemented on this TPU backend).
 
 Straggler compaction
 --------------------
@@ -61,8 +69,9 @@ lengths called out in SURVEY.md §7 (hard part 1). With
 
   1. the full batch advances for ``compact_after`` crossings (finishing the
      bulk of particles),
-  2. the still-active stragglers are compacted to the front (argsort of the
-     done mask) into a ``compact_size``-lane subset which loops to
+  2. the still-active stragglers are compacted to the front (a cumsum
+     stable partition of the done mask — one n-row scatter, far cheaper
+     than a sort) into a ``compact_size``-lane subset which loops to
      completion; an outer while_loop repeats the compaction while any
      particle remains active, so correctness never depends on the tail
      fitting in one subset.
@@ -125,8 +134,6 @@ def trace_impl(
     compact_size: int | None = None,
     compact_stages: tuple | None = None,
     unroll: int = 1,
-    packed_gathers: bool = False,
-    fused_scatter: bool = False,
     debug_checks: bool = False,
 ) -> TraceResult:
     """Advance all particles from origin to dest through the mesh.
@@ -171,14 +178,6 @@ def trace_impl(
         (the measured cost driver — the loop is launch-bound, not
         bandwidth-bound) at the price of at most ``unroll - 1`` wasted
         body evaluations at the tail.
-      packed_gathers: look up walk geometry/topology through the mesh's
-        packed tables (requires TetMesh built with pack_tables=True).
-        Measured SLOWER than the separate narrow gathers on TPU v5e
-        (scripts/sweep_unroll.py: 3.96 vs 4.44 Mseg/s) — kept as an option
-        because the tradeoff is hardware-dependent.
-      fused_scatter: score (c, c²) with one 2-wide scatter instead of two
-        scalar scatter-adds. Also measured slower on v5e (3.00 vs 3.96);
-        same caveat.
       debug_checks: thread `checkify` device assertions through the walk
         body — the functional analog of the reference's
         OMEGA_H_CHECK_PRINTF kernel asserts (finite intersection points
@@ -201,15 +200,10 @@ def trace_impl(
     # (cpp:634-638). The facade additionally rejects them host-side.
     group = group.astype(jnp.int32)
 
-    # Two-gather packed body (see module docstring "Gather budget"); falls
-    # back to the round-1 four-gather body when the mesh lacks the packed
-    # tables (>=2^24 elements or >64 classes) or legacy packed_gathers is
-    # requested.
-    v2 = (
-        not packed_gathers
-        and getattr(mesh, "geo16", None) is not None
-        and getattr(mesh, "topo_flat", None) is not None
-    )
+    # One-gather packed body (see module docstring "Gather budget"); falls
+    # back to the four-gather body when the mesh lacks the packed table
+    # (>=2^24 elements, >64 classes, or built with packed=False).
+    packed = getattr(mesh, "geo20", None) is not None
 
     done0 = jnp.logical_not(in_flight)
     # Derive the zero from a per-particle input so the counter carries the
@@ -217,13 +211,31 @@ def trace_impl(
     nseg_dtype = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
     nseg0 = jnp.sum(in_flight).astype(nseg_dtype) * 0
 
-    # In the v2 body the loop-carried material lane holds a CODE, resolved
-    # to real class values once after the loop: -2 = untouched (keep the
-    # caller's material_id), -1 = destination reached / domain exit,
-    # >=0 = index into mesh.class_values of the stopping neighbor.
+    # In the packed body the loop-carried material lane holds a CODE,
+    # resolved to real class values once after the loop: -2 = untouched
+    # (keep the caller's material_id), -1 = destination reached / domain
+    # exit, >=0 = index into mesh.class_values of the stopping neighbor.
     # (derived from material_id, not jnp.full, so the carry keeps the same
     # device-varying type under shard_map — see nseg0 below.)
-    mat0 = material_id * 0 - 2 if v2 else material_id
+    mat0 = material_id * 0 - 2 if packed else material_id
+
+    # The flux rides the loop flat so both tally rows (c at 2k, c² at
+    # 2k+1) go through ONE interleaved scalar scatter per crossing.
+    flux_shape = flux.shape
+    if flux_shape != (ntet, n_groups, 2):
+        raise ValueError(
+            f"flux must be [ntet, n_groups, 2] = ({ntet}, {n_groups}, 2); "
+            f"got {flux_shape} — the flat interleaved tally scatter depends "
+            "on the trailing (Σc, Σc²) pair layout"
+        )
+    flux = flux.reshape(-1)
+    nbins = ntet * n_groups  # OOB sentinel key; 2·nbins is OOB in flat
+    if 2 * nbins >= 2**31:
+        raise NotImplementedError(
+            "flat tally keys overflow int32: ntet*n_groups*2 = "
+            f"{2 * nbins} >= 2^31; shard the mesh (parallel/mesh_partition)"
+        )
+    code_int = jnp.int32 if dtype == jnp.float32 else jnp.int64
 
     # Ray-parameter tolerance floor: a few ulps so `t >= 1 - tol` survives
     # f32 rounding (1 - 1e-8 == 1 in f32). See the tolerance docstring.
@@ -235,24 +247,19 @@ def trace_impl(
         The per-particle inputs that never change during the walk are closed
         over so the same body serves both the full batch and compacted
         straggler subsets."""
-        scat_group = jnp.where(group_a < 0, n_groups, group_a)
+        # Out-of-range groups map to the OOB key so their rows drop.
+        good_group = (group_a >= 0) & (group_a < n_groups)
 
         def body(carry):
             cur, elem, done, mat, flux, nseg, it = carry
             active = jnp.logical_not(done)
 
             dirv = dest_a - cur
-            if v2:
-                geo = mesh.geo16[elem]  # [m, 16] — ONE geometry gather
+            if packed:
+                # ONE gather: normals + plane offsets + bitcast topo codes.
+                geo = mesh.geo20[elem]  # [m, 20]
                 normals = geo[:, :12].reshape(-1, 4, 3)
                 dplane = geo[:, 12:16]
-            elif packed_gathers:
-                # One gather for all walk geometry (normals + plane offsets)
-                # and one for all topology (neighbor, neighbor class,
-                # differs flag).
-                geo = mesh.packed_geo[elem]  # [m, 16]
-                normals = geo[:, :12].reshape(-1, 4, 3)
-                dplane = geo[:, 12:]
             else:
                 normals = mesh.face_normals[elem]
                 dplane = mesh.face_d[elem]
@@ -271,20 +278,18 @@ def trace_impl(
             xpoint = cur + t_step[:, None] * dirv
 
             crossed = active & ~reached & has_exit
-            if v2:
-                # ONE flat topology gather: neighbor id, material-boundary
-                # bit and neighbor class index in a single int32.
-                code = mesh.topo_flat[elem * 4 + face]
+            if packed:
+                # Topology came along in the geo20 row: select the exit
+                # face's code locally (no second table gather) and bitcast
+                # the float bits back to int.
+                code_f = jnp.take_along_axis(
+                    geo[:, 16:20], face[:, None], axis=1
+                )[:, 0]
+                code = jax.lax.bitcast_convert_type(code_f, code_int)
+                code = code.astype(jnp.int32)
                 nbr = (code & 0xFFFFFF) - 1
             else:
-                face_col = face[:, None]
-                if packed_gathers:
-                    topo = mesh.packed_topo[elem]  # [m, 12]
-                    nbr = jnp.take_along_axis(
-                        topo[:, 0:4], face_col, axis=1
-                    )[:, 0]
-                else:
-                    nbr = mesh.tet2tet[elem, face]
+                nbr = mesh.tet2tet[elem, face]
             next_elem = jnp.where(crossed, nbr, jnp.int32(-1))
 
             if debug_checks:
@@ -304,7 +309,14 @@ def trace_impl(
                 seg = t_step * dnorm  # |xpoint - cur|
                 score = active & in_flight_a
                 contrib = jnp.where(score, seg * weight_a, 0.0).astype(dtype)
-                scat_elem = jnp.where(score, elem, ntet)  # OOB rows drop
+                # Flat (elem, group) key; non-scoring rows get the OOB
+                # sentinel and drop — the functional analog of the
+                # reference's group-bounds device assert (cpp:634-638).
+                key = jnp.where(
+                    score & good_group,
+                    elem * n_groups + group_a,
+                    nbins,
+                )
                 if debug_checks:
                     from jax.experimental import checkify
 
@@ -313,21 +325,14 @@ def trace_impl(
                         & jnp.all(jnp.isfinite(contrib)),
                         "negative or non-finite tally contribution",
                     )
-                if score_squares and fused_scatter:
-                    # Single scatter of (c, c²) rows instead of two scalar
-                    # adds.
-                    flux = flux.at[scat_elem, scat_group].add(
-                        jnp.stack([contrib, contrib * contrib], axis=-1),
-                        mode="drop",
-                    )
+                if score_squares:
+                    # Both tally rows in ONE interleaved scalar scatter:
+                    # c at flat slot 2k, c² at 2k+1.
+                    kk = jnp.concatenate([key * 2, key * 2 + 1])
+                    vv = jnp.concatenate([contrib, contrib * contrib])
+                    flux = flux.at[kk].add(vv, mode="drop")
                 else:
-                    flux = flux.at[scat_elem, scat_group, 0].add(
-                        contrib, mode="drop"
-                    )
-                    if score_squares:
-                        flux = flux.at[scat_elem, scat_group, 1].add(
-                            contrib * contrib, mode="drop"
-                        )
+                    flux = flux.at[key * 2].add(contrib, mode="drop")
                 nseg = nseg + jnp.sum(score).astype(nseg.dtype)
 
             # --- boundary conditions (apply_boundary_condition,
@@ -336,21 +341,11 @@ def trace_impl(
             if initial:
                 material_stop = jnp.zeros_like(domain_exit)
             else:
-                if v2:
+                if packed:
                     # differs bit is only ever set for interior faces, so
                     # no next_elem >= 0 check is needed.
                     material_stop = crossed & (((code >> 30) & 1) == 1)
                     nbr_class = (code >> 24) & 0x3F  # class INDEX
-                elif packed_gathers:
-                    nbr_class = jnp.take_along_axis(
-                        topo[:, 4:8], face_col, axis=1
-                    )[:, 0]
-                    differs = jnp.take_along_axis(
-                        topo[:, 8:12], face_col, axis=1
-                    )[:, 0]
-                    material_stop = (
-                        crossed & (next_elem >= 0) & (differs == 1)
-                    )
                 else:
                     nbr_class = mesh.class_id[jnp.maximum(next_elem, 0)]
                     material_stop = (
@@ -504,7 +499,7 @@ def trace_impl(
                 state = tuple(state)
         cur, elem, done, mat, flux, nseg, it = state
 
-    if v2:
+    if packed:
         # Resolve material codes to real class_id values (one tiny-table
         # gather): -2 → caller's material_id untouched, -1 → reached /
         # domain exit, >=0 → class_values[index] of the stopping neighbor.
@@ -524,7 +519,7 @@ def trace_impl(
         position=cur,
         elem=elem,
         material_id=material_id,
-        flux=flux,
+        flux=flux.reshape(flux_shape),
         n_segments=nseg,
         n_crossings=it,
         done=done,
@@ -563,8 +558,6 @@ trace = jax.jit(
         "compact_size",
         "compact_stages",
         "unroll",
-        "packed_gathers",
-        "fused_scatter",
         "debug_checks",
     ),
     donate_argnames=("flux",),

@@ -1,0 +1,139 @@
+"""Does amortizing the tally scatter across walk iterations pay?
+
+The walk currently issues TWO scatter-adds per while-loop iteration
+(~208 iterations/step at the bench config). The crossing-record design
+instead buffers (key, contrib) per iteration with a dense
+dynamic_update_slice (cheap) and reduces once per phase with a single
+big scatter. This microbench measures the two cost structures head-on:
+
+  iter_scatter  — K repetitions of: 2 scalar scatter-adds of n rows
+                  into [ntet*G, 2]   (the current in-loop cost, modeled
+                  inside ONE jitted while_loop so dispatch is device-side)
+  record+flush  — K repetitions of: 2 dynamic_update_slice writes of n
+                  rows into a [K, n] buffer, then ONE flush: 2 scatter-adds
+                  of K*n rows
+  record+seg    — same records, flush via sort + segment_sum
+  flush_only    — just the big scatter of K*n rows (isolates flush cost)
+
+Usage: python scripts/microbench_record_scatter.py [n] [K] [ntet] [G]
+"""
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def timeit(name, f, args, reps=5):
+    # block_until_ready is unreliable on the remote axon runtime (see
+    # bench.py): fence with a host readback of a value that depends on
+    # every rep instead.
+    f = jax.jit(f, donate_argnums=(0,))
+    out = f(*args)
+    float(jnp.sum(out))  # compile + fence
+    args = (out,) + args[1:]
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = f(*args)
+        args = (out,) + args[1:]
+    total = float(jnp.sum(out))  # host readback = fence
+    dt = (time.perf_counter() - t0) / reps
+    print(f"{name:14s} {dt*1e3:9.2f} ms  (sum {total:.4e})", flush=True)
+    return dt
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 1_048_576
+    K = int(sys.argv[2]) if len(sys.argv) > 2 else 16
+    ntet = int(sys.argv[3]) if len(sys.argv) > 3 else 998_250
+    G = int(sys.argv[4]) if len(sys.argv) > 4 else 8
+    NG = ntet * G
+    rng = np.random.default_rng(0)
+    # Fresh pseudo-random keys per iteration derived on device so the
+    # while-loop body is honest (data-dependent indices every iteration).
+    key0 = jnp.asarray(rng.integers(0, NG, n).astype(np.int32))
+    c0 = jnp.asarray(rng.random(n).astype(np.float32))
+
+    def next_key(k, i):
+        # cheap LCG-ish permutation to vary indices per iteration
+        return ((k * 1664525 + 1013904223 + i) % NG).astype(jnp.int32)
+
+    def iter_scatter(flux, key0, c0):
+        def body(carry):
+            flux, i = carry
+            k = next_key(key0, i)
+            flux = flux.at[k, 0].add(c0, mode="drop")
+            flux = flux.at[k, 1].add(c0 * c0, mode="drop")
+            return flux, i + 1
+
+        flux, _ = jax.lax.while_loop(
+            lambda c: c[1] < K, body, (flux, jnp.int32(0))
+        )
+        return flux
+
+    def record_flush(flux, key0, c0):
+        rec_k = jnp.zeros((K, n), jnp.int32)
+        rec_c = jnp.zeros((K, n), jnp.float32)
+
+        def body(carry):
+            rk, rc, i = carry
+            k = next_key(key0, i)
+            rk = jax.lax.dynamic_update_index_in_dim(rk, k, i, 0)
+            rc = jax.lax.dynamic_update_index_in_dim(rc, c0, i, 0)
+            return rk, rc, i + 1
+
+        rk, rc, _ = jax.lax.while_loop(
+            lambda c: c[2] < K, body, (rec_k, rec_c, jnp.int32(0))
+        )
+        fk, fc = rk.reshape(-1), rc.reshape(-1)
+        flux = flux.at[fk, 0].add(fc, mode="drop")
+        flux = flux.at[fk, 1].add(fc * fc, mode="drop")
+        return flux
+
+    def record_seg(flux, key0, c0):
+        rec_k = jnp.zeros((K, n), jnp.int32)
+        rec_c = jnp.zeros((K, n), jnp.float32)
+
+        def body(carry):
+            rk, rc, i = carry
+            k = next_key(key0, i)
+            rk = jax.lax.dynamic_update_index_in_dim(rk, k, i, 0)
+            rc = jax.lax.dynamic_update_index_in_dim(rc, c0, i, 0)
+            return rk, rc, i + 1
+
+        rk, rc, _ = jax.lax.while_loop(
+            lambda c: c[2] < K, body, (rec_k, rec_c, jnp.int32(0))
+        )
+        fk, fc = rk.reshape(-1), rc.reshape(-1)
+        order = jnp.argsort(fk)
+        si, sc = fk[order], fc[order]
+        add0 = jax.ops.segment_sum(sc, si, num_segments=NG)
+        add1 = jax.ops.segment_sum(sc * sc, si, num_segments=NG)
+        return flux + jnp.stack([add0, add1], axis=-1)
+
+    big_k = jnp.asarray(rng.integers(0, NG, K * n).astype(np.int32))
+    big_c = jnp.asarray(rng.random(K * n).astype(np.float32))
+
+    def flush_only(flux, fk, fc):
+        flux = flux.at[fk, 0].add(fc, mode="drop")
+        flux = flux.at[fk, 1].add(fc * fc, mode="drop")
+        return flux
+
+    z = lambda: jnp.zeros((NG, 2), jnp.float32)
+    print(f"n={n} K={K} ntet={ntet} G={G}  ({K*n/1e6:.1f}M records)")
+    t_iter = timeit("iter_scatter", iter_scatter, (z(), key0, c0))
+    t_rec = timeit("record+flush", record_flush, (z(), key0, c0))
+    t_seg = timeit("record+seg", record_seg, (z(), key0, c0))
+    t_fl = timeit("flush_only", flush_only, (z(), big_k, big_c))
+    print(
+        f"per-iter: scatter {t_iter/K*1e3:.2f} ms vs record "
+        f"{(t_rec - t_fl)/K*1e3:.2f} ms (+flush {t_fl*1e3:.1f} ms/{K} iters)"
+    )
+
+
+if __name__ == "__main__":
+    main()

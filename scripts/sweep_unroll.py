@@ -29,9 +29,7 @@ def main():
     dtype = jnp.float32
 
     t0 = time.perf_counter()
-    mesh = build_box(
-        1.0, 1.0, 1.0, cells, cells, cells, dtype=dtype, pack_tables=True
-    )
+    mesh = build_box(1.0, 1.0, 1.0, cells, cells, cells, dtype=dtype)
     print(f"mesh: {mesh.ntet} tets, build {time.perf_counter()-t0:.1f}s",
           flush=True)
 
@@ -79,18 +77,9 @@ def main():
 
     M = 1048576
     variants = [
-        ("pack_scalar", M, dict(compact_after=32, unroll=8,
-                                packed_gathers=True)),
-        ("pack_fused", M, dict(compact_after=32, unroll=8,
-                               packed_gathers=True, fused_scatter=True)),
-        ("unpack_scalar", M, dict(compact_after=32, unroll=8,
-                                  packed_gathers=False)),
-        ("unpack_fused", M, dict(compact_after=32, unroll=8,
-                                 packed_gathers=False, fused_scatter=True)),
-        ("pack_scalar_u16", M, dict(compact_after=32, unroll=16,
-                                    packed_gathers=True)),
-        ("pack_scalar_2m", 2 * M, dict(compact_after=32, unroll=8,
-                                       packed_gathers=True)),
+        ("u8", M, dict(compact_after=32, unroll=8)),
+        ("u16", M, dict(compact_after=32, unroll=16)),
+        ("u8_2m", 2 * M, dict(compact_after=32, unroll=8)),
     ]
     for name, n, kw in variants:
         mseg, ms, iters, cs = run(n, **kw)
