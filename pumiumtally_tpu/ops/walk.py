@@ -90,6 +90,27 @@ import jax.numpy as jnp
 from .geometry import exit_face
 
 
+def first_k_active(active: jax.Array, k: int):
+    """Indices of the first ``k`` active lanes, via a cumsum stable
+    partition (one n-row scatter — far cheaper than argsort on TPU).
+
+    Shared by the single-chip and partitioned walks' straggler
+    compaction. Returns ``(idx[k], n_active)``; slots past ``n_active``
+    gather lane 0's garbage, which callers neutralize with an
+    ``arange(k) < n_active`` validity mask.
+    """
+    n = active.shape[0]
+    n_active = jnp.sum(active.astype(jnp.int32))
+    pos = jnp.cumsum(active.astype(jnp.int32)) - 1
+    dst = jnp.where(active, pos, n)
+    idx = (
+        jnp.zeros(n, jnp.int32)
+        .at[dst]
+        .set(jnp.arange(n, dtype=jnp.int32), mode="drop")[:k]
+    )
+    return idx, n_active
+
+
 class TraceResult(NamedTuple):
     """Outputs of one fused trace step.
 
@@ -235,7 +256,14 @@ def trace_impl(
             "flat tally keys overflow int32: ntet*n_groups*2 = "
             f"{2 * nbins} >= 2^31; shard the mesh (parallel/mesh_partition)"
         )
-    code_int = jnp.int32 if dtype == jnp.float32 else jnp.int64
+    # Bitcast width must follow the TABLE dtype (geo20 stores int32 bits
+    # for f32 meshes, int64 bits for f64), not the particle dtype — they
+    # can legitimately differ under x64.
+    code_int = (
+        jnp.int32
+        if (packed and mesh.geo20.dtype.itemsize == 4)
+        else jnp.int64
+    )
 
     # Ray-parameter tolerance floor: a few ulps so `t >= 1 - tol` survives
     # f32 rounding (1 - 1e-8 == 1 in f32). See the tolerance docstring.
@@ -419,27 +447,18 @@ def trace_impl(
         full_body, carry, phase1_bound
     )
 
-    lane_ids = jnp.arange(n, dtype=jnp.int32)
-
     def compact_round(state, S, bound):
         """One compaction round: gather the first S active lanes, advance
         them up to `bound` crossings, scatter results back.
 
-        The active-lane index is built with a cumsum stable partition (one
-        n-row scalar scatter) instead of argsort — same first-S-active
+        The active-lane index is built with `first_k_active` (cumsum
+        stable partition) instead of argsort — same first-S-active
         selection, far cheaper than a 1M-lane sort. Slots past the number
         of active lanes gather clamped garbage; they are neutralized by
         forcing their done flag and dropping their write-back rows."""
         cur, elem, done, mat, flux, nseg, it = state
         active = jnp.logical_not(done)
-        n_active = jnp.sum(active.astype(jnp.int32))
-        pos = jnp.cumsum(active.astype(jnp.int32)) - 1
-        dst = jnp.where(active, pos, n)
-        idx = (
-            jnp.zeros(n, jnp.int32)
-            .at[dst]
-            .set(lane_ids, mode="drop")[:S]
-        )
+        idx, n_active = first_k_active(active, S)
         valid = jnp.arange(S) < n_active
         sub_body = make_body(
             dest[idx],

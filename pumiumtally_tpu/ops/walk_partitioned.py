@@ -8,11 +8,26 @@ particles currently inside them; the step alternates
      kernel (score → boundary conditions → hop), except that a crossing into
      an element owned by another chip freezes the particle ("pending") with
      a decoded (target_chip, target_local_elem); and
-  2. an *exchange phase* — pending particles are compacted into a
-     fixed-size buffer, `all_gather`ed across the device axis (ICI), and
-     each chip adopts the ones addressed to it into free slots,
+  2. an *exchange phase* — pending particles are bucketed by destination
+     chip into fixed-size per-destination blocks and exchanged with ONE
+     `all_to_all` over the device axis (ICI): each chip receives only the
+     rows addressed to it and adopts them into free slots,
 
 inside one `lax.while_loop` that ends when no chip has pending particles.
+
+The all_to_all keeps per-round traffic proportional to what actually
+migrates (each chip receives n_parts·E2 rows, E2 = per-destination block
+size), unlike an `all_gather` of every chip's full emigrant buffer whose
+received volume grows as n_parts²·E — at pod scale a Morton-partitioned
+mesh has few neighbor parts, so replicating every chip's emigrants to
+every chip is almost entirely waste. Overflowing a destination block is
+harmless: those emigrants simply wait a round (counted in n_rounds).
+
+The walk phase supports the same straggler compaction as the single-chip
+kernel (ops/walk.py): after ``compact_after`` crossings the still-active
+lanes are compacted into ``compact_size``-lane subsets (cumsum stable
+partition), so the long tail of crossing counts doesn't run every
+resident slot to the bitter end.
 This is the TPU-native equivalent of the reference's cross-rank particle
 migration — the `migrate` flag plumbed through `search(migrate)` into
 Pumi-PIC's rebuild/migrate machinery (pumipic_particle_data_structure
@@ -50,6 +65,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..parallel.mesh_partition import MeshPartition
 from ..parallel.particle_sharding import PARTICLE_AXIS as AXIS
 from .geometry import exit_face
+from .walk import first_k_active
 
 
 class PartitionedTraceResult(NamedTuple):
@@ -85,104 +101,179 @@ def _walk_phase(
     tables, cur, dest, elem, done, target, target_elem, material_id,
     weight, group, flux, nseg, valid,
     *, initial, tolerance, score_squares, max_crossings, max_local,
-    unroll=1,
+    unroll=1, compact_after=None, compact_size=None,
 ):
-    """Advance every resident particle until done or pending-migration."""
+    """Advance every resident particle until done or pending-migration.
+
+    With ``compact_after`` set, lanes still active after that many
+    crossings are compacted into ``compact_size``-lane subsets which loop
+    to completion — the straggler scheme of ops/walk.py applied to the
+    partitioned body (lanes that froze pending-migration drop out of
+    "active" either way)."""
     normals_t, faced_t, enc_t, class_t, nbrclass_t, _ = tables
     dtype = cur.dtype
     n_groups = flux.shape[1]
+    cap = cur.shape[0]
 
-    def body(carry):
-        cur, elem, done, target, target_elem, material_id, flux, nseg, it = carry
-        active = valid & ~done & (target < 0)
+    def make_body(dest_a, weight_a, group_a, valid_a):
+        def body(carry):
+            cur, elem, done, target, target_elem, material_id, flux, nseg, it = carry
+            active = valid_a & ~done & (target < 0)
 
-        dirv = dest - cur
-        normals = normals_t[elem]
-        dplane = faced_t[elem]
-        t_exit, face, has_exit = exit_face(normals, dplane, cur, dirv)
+            dirv = dest_a - cur
+            normals = normals_t[elem]
+            dplane = faced_t[elem]
+            t_exit, face, has_exit = exit_face(normals, dplane, cur, dirv)
 
-        # Geometric tolerance → ray-parameter space with an ulp floor,
-        # matching ops/walk.py exactly so the partitioned and single-chip
-        # walks agree on borderline reached decisions.
-        dnorm = jnp.linalg.norm(dirv, axis=-1)
-        tol_eff = jnp.maximum(
-            tolerance / jnp.where(dnorm > 0, dnorm, 1.0),
-            8 * float(jnp.finfo(dtype).eps),
-        ).astype(dtype)
-        reached = jnp.logical_or(
-            t_exit >= 1.0 - tol_eff, jnp.logical_not(has_exit)
-        )
-        t_step = jnp.minimum(t_exit, 1.0)
-        xpoint = cur + t_step[:, None] * dirv
+            # Geometric tolerance → ray-parameter space with an ulp floor,
+            # matching ops/walk.py exactly so the partitioned and
+            # single-chip walks agree on borderline reached decisions.
+            dnorm = jnp.linalg.norm(dirv, axis=-1)
+            tol_eff = jnp.maximum(
+                tolerance / jnp.where(dnorm > 0, dnorm, 1.0),
+                8 * float(jnp.finfo(dtype).eps),
+            ).astype(dtype)
+            reached = jnp.logical_or(
+                t_exit >= 1.0 - tol_eff, jnp.logical_not(has_exit)
+            )
+            t_step = jnp.minimum(t_exit, 1.0)
+            xpoint = cur + t_step[:, None] * dirv
 
-        crossed = active & ~reached & has_exit
-        enc = jnp.where(crossed, enc_t[elem, face], jnp.int32(-1))
-        domain_exit = crossed & (enc == -1)
-        remote = crossed & (enc < -1)
-        local_hop = crossed & (enc >= 0)
+            crossed = active & ~reached & has_exit
+            enc = jnp.where(crossed, enc_t[elem, face], jnp.int32(-1))
+            domain_exit = crossed & (enc == -1)
+            remote = crossed & (enc < -1)
+            local_hop = crossed & (enc >= 0)
 
-        if not initial:
-            seg = jnp.linalg.norm(xpoint - cur, axis=-1)
-            contrib = jnp.where(active, seg * weight, 0.0).astype(dtype)
-            scat_elem = jnp.where(active, elem, max_local)
-            scat_group = jnp.where(group < 0, n_groups, group)
-            flux = flux.at[scat_elem, scat_group, 0].add(contrib, mode="drop")
-            if score_squares:
-                flux = flux.at[scat_elem, scat_group, 1].add(
-                    contrib * contrib, mode="drop"
+            if not initial:
+                seg = jnp.linalg.norm(xpoint - cur, axis=-1)
+                contrib = jnp.where(active, seg * weight_a, 0.0).astype(dtype)
+                scat_elem = jnp.where(active, elem, max_local)
+                scat_group = jnp.where(group_a < 0, n_groups, group_a)
+                flux = flux.at[scat_elem, scat_group, 0].add(
+                    contrib, mode="drop"
                 )
-            nseg = nseg + jnp.sum(active).astype(nseg.dtype)
+                if score_squares:
+                    flux = flux.at[scat_elem, scat_group, 1].add(
+                        contrib * contrib, mode="drop"
+                    )
+                nseg = nseg + jnp.sum(active).astype(nseg.dtype)
 
-        nclass = nbrclass_t[elem, face]
-        if initial:
-            material_stop = jnp.zeros_like(domain_exit)
-        else:
-            material_stop = (
-                crossed & (enc != -1) & (nclass != class_t[elem])
-            )
-        newly_done = (active & reached) | domain_exit | material_stop
-        if not initial:
-            material_id = jnp.where(
-                material_stop,
-                nclass,
-                jnp.where(
-                    (active & reached) | domain_exit,
-                    jnp.int32(-1),
-                    material_id,
-                ),
-            )
+            nclass = nbrclass_t[elem, face]
+            if initial:
+                material_stop = jnp.zeros_like(domain_exit)
+            else:
+                material_stop = (
+                    crossed & (enc != -1) & (nclass != class_t[elem])
+                )
+            newly_done = (active & reached) | domain_exit | material_stop
+            if not initial:
+                material_id = jnp.where(
+                    material_stop,
+                    nclass,
+                    jnp.where(
+                        (active & reached) | domain_exit,
+                        jnp.int32(-1),
+                        material_id,
+                    ),
+                )
 
-        # Remote crossing → freeze + address the owner chip. A remote
-        # material-stop migrates too (done on arrival) so the parent element
-        # ends up on its owner.
-        code = -2 - enc
-        target = jnp.where(remote, code // max_local, target)
-        target_elem = jnp.where(remote, code % max_local, target_elem)
+            # Remote crossing → freeze + address the owner chip. A remote
+            # material-stop migrates too (done on arrival) so the parent
+            # element ends up on its owner.
+            code = -2 - enc
+            target = jnp.where(remote, code // max_local, target)
+            target_elem = jnp.where(remote, code % max_local, target_elem)
 
-        elem = jnp.where(local_hop, enc, elem)
-        cur = jnp.where(active[:, None], xpoint, cur)
-        done = done | newly_done
-        return cur, elem, done, target, target_elem, material_id, flux, nseg, it + 1
+            elem = jnp.where(local_hop, enc, elem)
+            cur = jnp.where(active[:, None], xpoint, cur)
+            done = done | newly_done
+            return (cur, elem, done, target, target_elem, material_id,
+                    flux, nseg, it + 1)
 
-    if unroll > 1:
-        inner = body
+        return body
 
-        def body(c):  # noqa: F811 — dispatch-amortizing unroll (walk.py)
-            for _ in range(unroll):
-                c = inner(c)
-            return c
+    def run(body, valid_a, carry, bound):
+        if unroll > 1:
+            inner = body
 
-    def cond(carry):
-        cur, elem, done, target, *_rest, it = carry
-        active = valid & ~done & (target < 0)
-        return jnp.logical_and(it < max_crossings, jnp.any(active))
+            def body(c):  # noqa: F811 — dispatch-amortizing unroll
+                for _ in range(unroll):
+                    c = inner(c)
+                return c
 
+        def cond(carry):
+            cur, elem, done, target, *_rest, it = carry
+            active = valid_a & ~done & (target < 0)
+            return jnp.logical_and(it < bound, jnp.any(active))
+
+        return jax.lax.while_loop(cond, body, carry)
+
+    full_body = make_body(dest, weight, group, valid)
+    phase1_bound = (
+        max_crossings if compact_after is None
+        else min(compact_after, max_crossings)
+    )
     carry = (
         cur, elem, done, target, target_elem, material_id, flux, nseg,
         jnp.int32(0),
     )
-    out = jax.lax.while_loop(cond, body, carry)
-    return out[:-1]
+    carry = run(full_body, valid, carry, phase1_bound)
+
+    if compact_after is not None and phase1_bound < max_crossings:
+        S = min(cap, max(
+            int(compact_size) if compact_size is not None else max(cap // 8, 64),
+            1,
+        ))
+        def compact_round(state):
+            """Gather the first S active lanes, advance them until done or
+            pending, scatter back (first_k_active, shared with walk.py)."""
+            (cur, elem, done, target, target_elem, material_id, flux,
+             nseg, it) = state
+            active = valid & ~done & (target < 0)
+            idx, n_active = first_k_active(active, S)
+            sub_ok = jnp.arange(S) < n_active
+            sub_body = make_body(
+                dest[idx], weight[idx], group[idx], sub_ok
+            )
+            sub_carry = (
+                cur[idx], elem[idx], jnp.logical_not(sub_ok), target[idx],
+                target_elem[idx], material_id[idx], flux, nseg,
+                jnp.int32(0),
+            )
+            (scur, selem, sdone, star, stare, smat, flux, nseg, sit) = run(
+                sub_body, sub_ok, sub_carry, max_crossings
+            )
+            idx_sb = jnp.where(sub_ok, idx, cap)
+            cur = cur.at[idx_sb].set(scur, mode="drop")
+            elem = elem.at[idx_sb].set(selem, mode="drop")
+            done = done.at[idx_sb].set(sdone, mode="drop")
+            target = target.at[idx_sb].set(star, mode="drop")
+            target_elem = target_elem.at[idx_sb].set(stare, mode="drop")
+            material_id = material_id.at[idx_sb].set(smat, mode="drop")
+            return (cur, elem, done, target, target_elem, material_id,
+                    flux, nseg, it + sit)
+
+        # Each round retires >= S active lanes (to done or pending) or all
+        # of them, so ceil(cap/S)+1 rounds always suffice.
+        max_rounds = -(-cap // S) + 1
+
+        def outer_body(c):
+            *st, rounds = c
+            st = compact_round(tuple(st))
+            return (*st, rounds + 1)
+
+        def outer_cond(c):
+            (cur, elem, done, target, *_rest), rounds = c[:-1], c[-1]
+            active = valid & ~done & (target < 0)
+            return jnp.logical_and(rounds < max_rounds, jnp.any(active))
+
+        *carry, _ = jax.lax.while_loop(
+            outer_cond, outer_body, (*carry, jnp.int32(0))
+        )
+        carry = tuple(carry)
+
+    return carry[:-1]
 
 
 def make_partitioned_step(
@@ -197,17 +288,23 @@ def make_partitioned_step(
     tolerance: float = 1e-8,
     score_squares: bool = True,
     unroll: int = 1,
+    compact_after: int | None = None,
+    compact_size: int | None = None,
 ):
     """Build the jitted distributed trace step for one mesh partition.
 
     Args:
       device_mesh: 1-D `jax.sharding.Mesh`; its size must equal
         `partition.n_parts`.
-      exchange_size: emigrant-buffer slots per chip per round (default
-        cap // 4, min 64). Overflowing emigrants wait a round.
+      exchange_size: emigrant slots PER DESTINATION CHIP per round
+        (default max(cap // (2·n_parts), 64)); the all_to_all moves
+        n_parts·exchange_size rows per chip per round. Overflowing
+        emigrants wait a round.
       max_rounds: bound on walk/exchange rounds (default 4 * n_parts + 8 —
         a particle path can re-enter parts, Morton blocks are compact so
         few passes suffice; truncation shows up as done=False).
+      compact_after/compact_size: straggler compaction for each walk
+        phase, as in ops/walk.py (default off).
 
     Returns step(cur, dest, elem, done, material, weight, group, pid, valid,
     flux) -> PartitionedTraceResult, where per-particle arrays are
@@ -245,8 +342,11 @@ def make_partitioned_step(
         )
         flux_l = flux[0]
         cap = cur.shape[0]
-        me = jax.lax.axis_index(AXIS)
-        E = exchange_size if exchange_size is not None else max(cap // 4, 64)
+        E = (
+            exchange_size
+            if exchange_size is not None
+            else max(cap // (2 * n_parts), 64)
+        )
         E = min(E, cap)
         # All loop-carried values must be device-varying from the start
         # (shard_map's vma rule) — derive them from per-particle inputs.
@@ -262,47 +362,69 @@ def make_partitioned_step(
             max_crossings=max_crossings,
             max_local=max_local,
             unroll=unroll,
+            compact_after=compact_after,
+            compact_size=compact_size,
         )
 
         def exchange(carry):
             (cur, dest, elem, done, target, target_elem, material_id,
              weight, group, pid, valid, flux_l, nseg, dropped) = carry
             emig = valid & (target >= 0)
-            # Emigrants first (stable argsort of the negated mask).
-            send_order = jnp.argsort(~emig)[:E]
-            send_mask = emig[send_order]
 
-            pay_f = jnp.concatenate(
-                [cur[send_order], dest[send_order],
-                 weight[send_order, None]], axis=1,
-            )  # [E, 7]
-            pay_i = jnp.stack(
-                [
-                    pid[send_order],
-                    group[send_order],
-                    material_id[send_order],
-                    target_elem[send_order],
-                    jnp.where(send_mask, target[send_order], -1),
-                    done[send_order].astype(jnp.int32),
-                ],
-                axis=1,
-            )  # [E, 6]
+            # Bucket emigrants by destination chip: a stable sort on the
+            # target (non-emigrants keyed past every chip) makes each
+            # destination's emigrants a contiguous run; the rank within
+            # the run addresses a fixed E-slot block of the send buffer.
+            # Rows overflowing their destination block stay resident and
+            # retry next round.
+            key = jnp.where(emig, target, n_parts)
+            order = jnp.argsort(key, stable=True)
+            skey = key[order]
+            first = jnp.searchsorted(skey, skey, side="left")
+            rank = jnp.arange(cap, dtype=first.dtype) - first
+            sendable = (skey < n_parts) & (rank < E)
+            slot = jnp.where(
+                sendable, skey * E + rank, n_parts * E
+            )  # OOB rows drop
+
+            def fill(rows):
+                buf = jnp.zeros((n_parts * E,) + rows.shape[1:], rows.dtype)
+                return buf.at[slot].set(rows[order], mode="drop")
+
+            pay_f = fill(
+                jnp.concatenate([cur, dest, weight[:, None]], axis=1)
+            )  # [n_parts*E, 7]
+            pay_i = fill(
+                jnp.stack(
+                    [
+                        pid,
+                        group,
+                        material_id,
+                        target_elem,
+                        valid.astype(jnp.int32),  # occupied marker
+                        done.astype(jnp.int32),
+                    ],
+                    axis=1,
+                )
+            )  # [n_parts*E, 6]
+
             # Sent slots free up.
-            valid = valid.at[send_order].set(
-                jnp.where(send_mask, False, valid[send_order])
-            )
-            target = target.at[send_order].set(
-                jnp.where(send_mask, -1, target[send_order])
-            )
+            sent_src = jnp.where(sendable, order, cap)
+            valid = valid.at[sent_src].set(False, mode="drop")
+            target = target.at[sent_src].set(-1, mode="drop")
 
-            g_f = jax.lax.all_gather(pay_f, AXIS)  # [n_parts, E, 7]
-            g_i = jax.lax.all_gather(pay_i, AXIS)  # [n_parts, E, 6]
-            g_f = g_f.reshape(n_parts * E, 7)
-            g_i = g_i.reshape(n_parts * E, 6)
-            mine = g_i[:, 4] == me
+            # ONE all_to_all: block d of my send buffer goes to chip d;
+            # I receive n_parts blocks of rows all addressed to me.
+            g_f = jax.lax.all_to_all(
+                pay_f.reshape(n_parts, E, 7), AXIS, 0, 0, tiled=False
+            ).reshape(n_parts * E, 7)
+            g_i = jax.lax.all_to_all(
+                pay_i.reshape(n_parts, E, 6), AXIS, 0, 0, tiled=False
+            ).reshape(n_parts * E, 6)
+            mine = g_i[:, 4] == 1  # occupied rows (all addressed to me)
 
             # Place my immigrants into free slots: immigrants first among
-            # the gathered rows, free slots first among my slots.
+            # the received rows, free slots first among my slots.
             imm_order = jnp.argsort(~mine)
             free_order = jnp.argsort(valid)  # False (free) first
             m = min(n_parts * E, cap)

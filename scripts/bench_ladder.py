@@ -59,11 +59,17 @@ def run_single_chip(name, cells, n_particles, n_groups, steps=5):
 def run_partitioned(n_devices=8, cells=32, n_particles=65536, steps=3):
     import jax
 
+    if os.environ.get("PUMI_FORCE_CPU") == "1":
+        jax.config.update("jax_platforms", "cpu")
+
     virtual = os.environ.get("PUMI_LADDER_VIRTUAL") == "1"
     if virtual:
         # Functional validation scale: the virtual CPU mesh measures
-        # nothing TPU-comparable, so keep compile time in check.
-        cells, n_particles, steps = 12, 8192, 2
+        # nothing TPU-comparable, so keep compile time in check. Scale is
+        # overridable for the large partitioned dryruns (BENCH task 2).
+        cells = int(os.environ.get("PUMI_LADDER_CELLS", "12"))
+        n_particles = int(os.environ.get("PUMI_LADDER_PARTICLES", "8192"))
+        steps = int(os.environ.get("PUMI_LADDER_STEPS", "2"))
 
     if len(jax.devices()) < n_devices:
         env = dict(os.environ)
@@ -111,6 +117,9 @@ def run_partitioned(n_devices=8, cells=32, n_particles=65536, steps=3):
     )
     part = partition_mesh(mesh, n_devices)
     dmesh = make_device_mesh(n_devices)
+    # unroll/compact_after are TPU dispatch-amortization knobs; on the
+    # virtual CPU mesh they only add wasted body evaluations (measured
+    # 184k vs 283k seg/s), so the ladder leaves them off.
     step = make_partitioned_step(
         dmesh, part, n_groups=n_groups, max_crossings=mesh.ntet + 64,
         tolerance=1e-6,

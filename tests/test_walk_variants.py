@@ -119,6 +119,29 @@ def test_variant_matches_baseline(setup, variant, body):
     _assert_same(got, base, flux_exact=False)
 
 
+def test_mixed_dtype_particles_on_f32_mesh(setup):
+    """f64 particles on an f32 mesh (legal under x64) must walk the packed
+    body: the topology bitcast width follows the TABLE dtype, not the
+    particle dtype."""
+    mesh, _mesh_unpacked, args, kw, base = setup
+    args64 = (
+        mesh,
+        args[1].astype(jnp.float64),
+        args[2].astype(jnp.float64),
+        *args[3:],
+    )
+    got = trace_impl(
+        *args64, make_flux(mesh.ntet, 2, jnp.float64), **kw
+    )
+    assert bool(np.asarray(got.done).all())
+    np.testing.assert_array_equal(
+        np.asarray(got.material_id), np.asarray(base.material_id)
+    )
+    np.testing.assert_allclose(
+        np.asarray(got.flux), np.asarray(base.flux), rtol=1e-5, atol=1e-6
+    )
+
+
 def test_packing_limits():
     """Packing-boundary behavior (round-2 test debt, VERDICT item 3b):
     exactly 64 distinct class ids still packs, 65 falls back; the 2^24
