@@ -23,19 +23,43 @@ def make_flux(ntet: int, n_groups: int, dtype=jnp.float32) -> jax.Array:
 
 @jax.jit
 def normalize_flux(flux, volumes, n_particles, n_iterations=1):
-    """Normalize raw tallies by element volume and particle count.
+    """Normalize raw tallies by element volume and particle count, with a
+    statistically correct standard deviation of the flux estimate.
 
-    Mirrors normalizeFlux (cpp:660-677): slot 0 /= vol·N, slot 1 /= vol²·N,
-    then sd = sqrt(max(m2 − m1², 0) / max(iters, 1)).
+    Mean and second moment keep reference parity (normalizeFlux,
+    cpp:660-666): slot 0 = Σc/(vol·N), slot 1 = Σc²/(vol²·N), where
+    c = w·len per scored segment.
+
+    The sd replaces the reference's in-code-flagged-broken
+    ``sqrt(m2 − m1²)`` (cpp:673-677, "FIXME ... needs number of
+    iterations"). Derivation — the accumulator's per-segment squares are
+    per-(particle, move) samples because a straight ray scores at most
+    one segment per tet per move, so with N particles over M moves there
+    are H = N·M independent samples y of the per-move element score:
+
+        s²_y   = (Σc² − (Σc)²/H) / (H − 1)        unbiased Var(y)
+        flux   = Σc / (vol·N)                      = M · mean(y) / vol
+        Var(f) = M² · Var(mean y) / vol²
+               = M² · s²_y / (H·vol²) = M·s²_y / (N·vol²)
+        sd     = sqrt(M · s²_y / N) / vol
+
+    i.e. the iteration count enters MULTIPLICATIVELY through the M-move
+    accumulation, not as the reference FIXME's flat divide — pinned
+    against an analytic known-variance oracle in
+    tests/test_tally_oracle.py::test_sd_matches_analytic_variance.
 
     Returns [ntet, n_groups, 3]: (mean flux, second moment, sd).
     """
     vol = volumes[:, None]
     n = jnp.asarray(n_particles, flux.dtype)
+    m = jnp.maximum(jnp.asarray(n_iterations, flux.dtype), 1.0)
     m1 = flux[..., 0] / (vol * n)
     m2 = flux[..., 1] / (vol * vol * n)
-    iters = jnp.maximum(jnp.asarray(n_iterations, flux.dtype), 1.0)
-    sd = jnp.sqrt(jnp.maximum(m2 - m1 * m1, 0.0) / iters)
+    h = n * m  # total samples
+    var_y = jnp.maximum(
+        flux[..., 1] - flux[..., 0] * flux[..., 0] / h, 0.0
+    ) / jnp.maximum(h - 1.0, 1.0)
+    sd = jnp.sqrt(m * var_y / n) / vol
     return jnp.stack([m1, m2, sd], axis=-1)
 
 

@@ -166,3 +166,114 @@ def test_parked_particles_keep_position_and_material(tally):
     )
     np.testing.assert_array_equal(tally.element_ids, 4)
     np.testing.assert_allclose(tally.raw_flux, before, atol=TOL)
+
+
+def test_sd_matches_analytic_variance():
+    """Analytic MC-variance oracle for the sd slot (round-2 VERDICT item 8).
+
+    Model: N particles each make M moves; in one tet of volume V every
+    (particle, move) scores y = w·L with fixed segment length L and
+    weights drawn from a known-variance distribution. The flux estimate
+    is Σy/(V·N) with variance M·Var(y)/(N·V²), so
+
+        sd_true ≈ L·sqrt(M·Var(w)/N) / V.
+
+    The raw accumulator (Σc, Σc²) is built directly from the samples, so
+    the test isolates the normalization math from the walk. The exact
+    finite-sample identity sd = sqrt(M·s²_y/N)/V must hold to rounding,
+    and the analytic value within sampling error. The reference's
+    formula sqrt(m2 − m1²) (its own FIXME, cpp:673-677) fails both — it
+    is off by ~sqrt(N/M)·... a factor growing with N — which this test
+    demonstrates explicitly.
+    """
+    import jax.numpy as jnp
+
+    from pumiumtally_tpu.core.tally import normalize_flux
+
+    rng = np.random.default_rng(123)
+    N, M = 40_000, 7
+    L, V = 0.25, 1.0 / 6.0
+    w = rng.uniform(0.5, 1.5, (N, M))  # Var(w) = 1/12
+    y = (w * L).reshape(-1)
+    flux = np.zeros((1, 1, 2))
+    flux[0, 0, 0] = y.sum()
+    flux[0, 0, 1] = (y * y).sum()
+
+    norm = np.asarray(
+        normalize_flux(
+            jnp.asarray(flux), jnp.asarray([V]), N, M
+        )
+    )
+    got_sd = norm[0, 0, 2]
+
+    # Exact finite-sample identity.
+    h = N * M
+    s2y = (y * y).sum() - y.sum() ** 2 / h
+    s2y /= h - 1
+    sd_exact = np.sqrt(M * s2y / N) / V
+    assert got_sd == pytest.approx(sd_exact, rel=1e-6)
+
+    # Analytic convergence: Var(w)=1/12 ⇒ sd_true = L·sqrt(M/(12N))/V.
+    sd_true = L * np.sqrt(M / (12 * N)) / V
+    assert got_sd == pytest.approx(sd_true, rel=0.05)
+
+    # The reference's broken formula (cpp:673-677) fails outright: its
+    # m2 − m1² goes negative under multi-move accumulation (m1 grows
+    # with M, m2 doesn't), so its sqrt is NaN — the very failure its
+    # in-code FIXME flags.
+    m1 = flux[0, 0, 0] / (V * N)
+    m2 = flux[0, 0, 1] / (V * V * N)
+    assert m2 - m1 * m1 < 0
+    assert np.isnan(np.sqrt(m2 - m1 * m1))
+
+    # Mean parity is untouched: E[flux] = M·E[w]·L/V.
+    assert norm[0, 0, 0] == pytest.approx(M * 1.0 * L / V, rel=0.01)
+
+
+def test_intersection_points_surface():
+    """getIntersectionPoints() parity behind TallyConfig.record_xpoints
+    (reference test_pumi_tally_impl_methods.cpp:403-479): the oracle ray
+    (0.1,0.4,0.5)→(1.2,0.4,0.5) crosses faces at x=0.4 and x=0.5 and is
+    clipped at the x=1 wall, so each particle records exactly those three
+    points in order."""
+    mesh = build_box(dtype=jnp.float64)
+    tally = PumiTally(
+        mesh, NUM, TallyConfig(dtype=jnp.float64, record_xpoints=8)
+    )
+    _init(tally)
+    _move1(tally)
+    xp, counts = tally.intersection_points()
+    assert xp.shape == (NUM, 8, 3)
+    np.testing.assert_array_equal(counts, 3)
+    expected = np.array(
+        [[0.4, 0.4, 0.5], [0.5, 0.4, 0.5], [1.0, 0.4, 0.5]]
+    )
+    for i in range(NUM):
+        np.testing.assert_allclose(xp[i, :3], expected, atol=TOL)
+    # Flag off → the surface is explicitly unavailable, and the hot path
+    # carries no buffer.
+    t2 = PumiTally(mesh, NUM, TallyConfig(dtype=jnp.float64))
+    with pytest.raises(ValueError, match="record_xpoints"):
+        t2.intersection_points()
+
+
+def test_intersection_points_no_crossing_and_pre_trace_errors():
+    """A particle that never leaves its tet records ZERO crossing points
+    (the recorder logs genuine boundary crossings only), and calling the
+    surface before any trace raises a clear error."""
+    mesh = build_box(dtype=jnp.float64)
+    t = PumiTally(
+        mesh, NUM, TallyConfig(dtype=jnp.float64, record_xpoints=4)
+    )
+    with pytest.raises(RuntimeError, match="no trace has run"):
+        t.intersection_points()
+    _init(t)
+    # Tiny in-element hop: start (0.1,0.4,0.5) in elem 2, move 1e-3 in x.
+    dest = np.tile([0.101, 0.4, 0.5], NUM)
+    flying = np.ones(NUM, np.int8)
+    t.move_to_next_location(
+        dest, flying, np.ones(NUM), np.zeros(NUM, np.int32),
+        np.zeros(NUM, np.int32), dest.size,
+    )
+    _, counts = t.intersection_points()
+    np.testing.assert_array_equal(counts, 0)
