@@ -19,7 +19,9 @@ This module adds the thin host-level layer around that:
     this process's share (the analog of OpenMC's work_per_rank split,
     reference .cpp:802-825 comment).
   * `allreduce_flux` — cross-host tally reduction producing a replicated
-    flux (the MPI tally-reduce analog) via `psum` under `shard_map`.
+    flux (the MPI tally-reduce analog): an in-program jitted sum over a
+    device-sharded leading axis (lowers to an XLA all-reduce over
+    ICI/DCN), with a host-gather fallback.
   * `write_parallel_vtk` — per-host VTU piece + host-0 PVTU index (the
     Omega_h vtk::write_parallel analog; DCN-free, each host writes only
     its own piece).
@@ -95,18 +97,74 @@ def host_local_batch(n_global: int) -> tuple[int, int]:
     return start, count
 
 
-def allreduce_flux(local_flux) -> np.ndarray:
+def allreduce_flux(local_flux, in_program: bool = True) -> np.ndarray:
     """Sum per-host partial flux accumulators into a replicated global
     tally (the MPI_Allreduce the reference's distributed tallies imply).
 
     `local_flux` is this host's [ntet, n_groups, 2] partial; every process
-    gets back the cross-process sum. One gather + sum, no host-side
-    replication of the accumulator per local device.
+    gets back the cross-process sum.
+
+    The default path stays IN PROGRAM: each host's partial becomes one
+    block of a leading-axis-sharded global array over the full device
+    mesh, and a jitted sum over that axis lowers to an XLA all-reduce
+    riding ICI/DCN — no host gather of every partial. The host-side
+    `process_allgather` + numpy sum survives as the fallback
+    (`in_program=False`, or automatically when the backend lacks
+    multi-process collectives).
+
+    Memory bound (BASELINE config 5): a replicated global flux at ~100M
+    tets × 64 groups × 2 × f32 is ~51 GB — too large for either path on a
+    single host/chip. At that scale the tally must stay PARTITIONED
+    (per-chip owned-element slabs via `ops/walk_partitioned`, where no
+    global flux reduction exists at all: assembly is a permutation of
+    owned slabs, `parallel/mesh_partition.assemble_global_flux`).
+    allreduce_flux is for the full-mesh-replicated mode, whose flux must
+    fit one host — exactly like the reference's full-mesh picparts mode
+    (owners all 0, cpp:865-876).
     """
     from jax.experimental import multihost_utils
 
+    local_flux = np.asarray(local_flux)
+    if jax.process_count() == 1:
+        return local_flux
+
+    if in_program:
+        try:
+            return _allreduce_flux_in_program(local_flux)
+        except Exception as e:  # pragma: no cover - backend-dependent
+            from ..utils.log import get_logger
+
+            get_logger().warning(
+                "in-program flux all-reduce unavailable (%s); "
+                "falling back to host gather", e,
+            )
+
     gathered = multihost_utils.process_allgather(jnp.asarray(local_flux))
     return np.asarray(gathered).sum(axis=0)
+
+
+def _allreduce_flux_in_program(local_flux: np.ndarray) -> np.ndarray:
+    """The collective all-reduce path (no fallback): a jitted sum over a
+    device-sharded leading axis, which XLA lowers to an all-reduce over
+    the interconnect."""
+    from jax.experimental import multihost_utils
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = global_device_mesh()
+    L = jax.local_device_count()
+    # One leading-axis block per DEVICE: local device 0 carries this
+    # host's partial, the other local devices zeros, so the global array
+    # is [n_devices, ...] sharded over the mesh.
+    block = np.zeros((L,) + local_flux.shape, local_flux.dtype)
+    block[0] = local_flux
+    garr = multihost_utils.host_local_array_to_global_array(
+        block, mesh, P(AXIS)
+    )
+    summed = jax.jit(
+        lambda x: jnp.sum(x, axis=0),
+        out_shardings=NamedSharding(mesh, P()),
+    )(garr)  # sharded-axis sum ⇒ XLA all-reduce; result replicated
+    return np.asarray(summed.addressable_data(0))
 
 
 def write_parallel_vtk(

@@ -62,7 +62,14 @@ WORKER = textwrap.dedent(
         max_crossings=mesh.ntet + 8,
         tolerance=1e-8,
     )
-    total = allreduce_flux(r.flux)
+    # The collective path, DIRECTLY (no silent fallback): failure here
+    # fails the worker rather than degrading to the host gather.
+    from pumiumtally_tpu.parallel.multihost import _allreduce_flux_in_program
+    total = _allreduce_flux_in_program(np.asarray(r.flux))
+    total_host = allreduce_flux(r.flux, in_program=False)  # host fallback
+    assert np.allclose(total, total_host, rtol=0, atol=1e-12), (
+        "in-program all-reduce disagrees with host-gather fallback"
+    )
     print("RESULT", pid, float(np.asarray(total)[..., 0].sum()), count)
     """
 )
