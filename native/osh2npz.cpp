@@ -1,0 +1,227 @@
+// osh2npz — offline converter from a genuine Omega_h binary .osh mesh to
+// the .npz layout pumiumtally_tpu.mesh.io.load_mesh reads.
+//
+// Why this exists: the reference loads its production meshes with
+// Omega_h::binary::read (pumipic_particle_data_structure.cpp:900), whose
+// on-disk stream is Omega_h-version- and compression-dependent. Rather
+// than chase byte-level compatibility, this tool links against the REAL
+// Omega_h already present in any working PumiTally/OpenMC environment
+// and dumps the three arrays the tally consumes: vertex coordinates,
+// tet->vertex connectivity, and the required class_id region tag
+// (cpp:904-906).
+//
+// Build (in the user's Omega_h environment; not buildable in this repo's
+// CI, which has no Omega_h):
+//   g++ -std=c++17 osh2npz.cpp -o osh2npz \
+//       -I$OMEGA_H_PREFIX/include -L$OMEGA_H_PREFIX/lib -lomega_h
+// Run:
+//   ./osh2npz mesh.osh mesh.npz
+//   python -c "from pumiumtally_tpu.mesh.io import load_mesh; load_mesh('mesh.npz')"
+//
+// The output is a stored (uncompressed) zip holding coords.npy [nverts,3]
+// f8, tet2vert.npy [ntet,4] i8, class_id.npy [ntet] i4 — written here
+// with a minimal zip/npy emitter so the tool has no dependencies beyond
+// Omega_h itself.
+
+#include <Omega_h_file.hpp>
+#include <Omega_h_library.hpp>
+#include <Omega_h_mesh.hpp>
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+// --- minimal .npy + stored .zip writers ---------------------------------
+struct NpyArray {
+  std::string name;          // "coords.npy"
+  std::string header;        // full npy header bytes
+  std::vector<char> payload; // raw data bytes
+  uint32_t crc = 0;
+};
+
+uint32_t crc32_update(uint32_t crc, const char* buf, size_t len) {
+  static uint32_t table[256];
+  static bool init = false;
+  if (!init) {
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; ++k)
+        c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      table[i] = c;
+    }
+    init = true;
+  }
+  crc = ~crc;
+  for (size_t i = 0; i < len; ++i)
+    crc = table[(crc ^ static_cast<unsigned char>(buf[i])) & 0xFF] ^ (crc >> 8);
+  return ~crc;
+}
+
+std::string npy_header(const std::string& descr,
+                       const std::vector<int64_t>& shape) {
+  std::string dict = "{'descr': '" + descr + "', 'fortran_order': False, "
+                     "'shape': (";
+  for (size_t i = 0; i < shape.size(); ++i) {
+    dict += std::to_string(shape[i]);
+    if (shape.size() == 1 || i + 1 < shape.size()) dict += ",";
+    if (i + 1 < shape.size()) dict += " ";
+  }
+  dict += "), }";
+  size_t unpadded = 10 + dict.size() + 1;
+  size_t pad = (64 - unpadded % 64) % 64;
+  dict += std::string(pad, ' ');
+  dict += '\n';
+  std::string h = "\x93NUMPY";
+  h += '\x01';
+  h += '\x00';
+  uint16_t hlen = static_cast<uint16_t>(dict.size());
+  h += static_cast<char>(hlen & 0xFF);
+  h += static_cast<char>(hlen >> 8);
+  h += dict;
+  return h;
+}
+
+template <typename T>
+void put_le(std::string& s, T v) {
+  for (size_t i = 0; i < sizeof(T); ++i)
+    s += static_cast<char>((static_cast<uint64_t>(v) >> (8 * i)) & 0xFF);
+}
+
+void check_u32(uint64_t v, const char* what) {
+  // No zip64 support: fail loudly instead of silently truncating sizes
+  // or central-directory offsets on >4 GiB archives (a ~100M-tet mesh's
+  // tet2vert entry alone is 3.2 GB; split such meshes or extend this
+  // writer to zip64 before converting them).
+  if (v > 0xFFFFFFFFull) {
+    std::fprintf(stderr,
+                 "error: %s (%llu bytes) exceeds the 4 GiB zip32 limit; "
+                 "this writer has no zip64 support\n",
+                 what, static_cast<unsigned long long>(v));
+    std::exit(1);
+  }
+}
+
+void write_zip(const char* path, std::vector<NpyArray>& entries) {
+  FILE* f = std::fopen(path, "wb");
+  if (!f) { std::perror("fopen"); std::exit(1); }
+  std::vector<uint64_t> offsets;
+  for (auto& e : entries) {
+    e.crc = crc32_update(0, e.header.data(), e.header.size());
+    e.crc = crc32_update(e.crc, e.payload.data(), e.payload.size());
+    uint64_t size = e.header.size() + e.payload.size();
+    check_u32(size, e.name.c_str());
+    offsets.push_back(static_cast<uint64_t>(std::ftell(f)));
+    check_u32(offsets.back(), "entry offset");
+    std::string lh;
+    put_le<uint32_t>(lh, 0x04034b50);
+    put_le<uint16_t>(lh, 20);     // version needed
+    put_le<uint16_t>(lh, 0);      // flags
+    put_le<uint16_t>(lh, 0);      // stored
+    put_le<uint16_t>(lh, 0);      // time
+    put_le<uint16_t>(lh, 0);      // date
+    put_le<uint32_t>(lh, e.crc);
+    put_le<uint32_t>(lh, static_cast<uint32_t>(size));
+    put_le<uint32_t>(lh, static_cast<uint32_t>(size));
+    put_le<uint16_t>(lh, static_cast<uint16_t>(e.name.size()));
+    put_le<uint16_t>(lh, 0);
+    lh += e.name;
+    std::fwrite(lh.data(), 1, lh.size(), f);
+    std::fwrite(e.header.data(), 1, e.header.size(), f);
+    std::fwrite(e.payload.data(), 1, e.payload.size(), f);
+  }
+  uint64_t cd_start = static_cast<uint64_t>(std::ftell(f));
+  for (size_t i = 0; i < entries.size(); ++i) {
+    auto& e = entries[i];
+    uint64_t size = e.header.size() + e.payload.size();
+    std::string cd;
+    put_le<uint32_t>(cd, 0x02014b50);
+    put_le<uint16_t>(cd, 20);
+    put_le<uint16_t>(cd, 20);
+    put_le<uint16_t>(cd, 0);
+    put_le<uint16_t>(cd, 0);
+    put_le<uint16_t>(cd, 0);
+    put_le<uint16_t>(cd, 0);
+    put_le<uint32_t>(cd, e.crc);
+    put_le<uint32_t>(cd, static_cast<uint32_t>(size));
+    put_le<uint32_t>(cd, static_cast<uint32_t>(size));
+    put_le<uint16_t>(cd, static_cast<uint16_t>(e.name.size()));
+    put_le<uint16_t>(cd, 0);
+    put_le<uint16_t>(cd, 0);
+    put_le<uint16_t>(cd, 0);
+    put_le<uint16_t>(cd, 0);
+    put_le<uint32_t>(cd, 0);
+    put_le<uint32_t>(cd, static_cast<uint32_t>(offsets[i]));
+    cd += e.name;
+    std::fwrite(cd.data(), 1, cd.size(), f);
+  }
+  uint64_t cd_end = static_cast<uint64_t>(std::ftell(f));
+  std::string eocd;
+  put_le<uint32_t>(eocd, 0x06054b50);
+  put_le<uint16_t>(eocd, 0);
+  put_le<uint16_t>(eocd, 0);
+  put_le<uint16_t>(eocd, static_cast<uint16_t>(entries.size()));
+  put_le<uint16_t>(eocd, static_cast<uint16_t>(entries.size()));
+  put_le<uint32_t>(eocd, static_cast<uint32_t>(cd_end - cd_start));
+  put_le<uint32_t>(eocd, static_cast<uint32_t>(cd_start));
+  put_le<uint16_t>(eocd, 0);
+  std::fwrite(eocd.data(), 1, eocd.size(), f);
+  std::fclose(f);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc != 3) {
+    std::fprintf(stderr, "usage: %s <mesh.osh> <out.npz>\n", argv[0]);
+    return 2;
+  }
+  auto lib = Omega_h::Library(&argc, &argv);
+  auto mesh = Omega_h::binary::read(argv[1], lib.world());
+  if (mesh.dim() != 3) {
+    std::fprintf(stderr, "error: mesh must be 3-D (got %d)\n", mesh.dim());
+    return 1;
+  }
+  if (!mesh.has_tag(Omega_h::REGION, "class_id")) {
+    std::fprintf(stderr, "error: mesh lacks the class_id region tag the "
+                         "tally requires\n");
+    return 1;
+  }
+  auto coords_d = Omega_h::HostRead<Omega_h::Real>(mesh.coords());
+  auto t2v = Omega_h::HostRead<Omega_h::LO>(
+      mesh.ask_down(Omega_h::REGION, Omega_h::VERT).ab2b);
+  auto cls = Omega_h::HostRead<Omega_h::ClassId>(
+      mesh.get_array<Omega_h::ClassId>(Omega_h::REGION, "class_id"));
+  int64_t nverts = mesh.nverts(), ntets = mesh.nelems();
+
+  std::vector<NpyArray> out(3);
+  out[0].name = "coords.npy";
+  out[0].header = npy_header("<f8", {nverts, 3});
+  out[0].payload.resize(static_cast<size_t>(nverts) * 3 * 8);
+  std::memcpy(out[0].payload.data(), coords_d.data(), out[0].payload.size());
+
+  out[1].name = "tet2vert.npy";
+  out[1].header = npy_header("<i8", {ntets, 4});
+  out[1].payload.resize(static_cast<size_t>(ntets) * 4 * 8);
+  {
+    auto* p = reinterpret_cast<int64_t*>(out[1].payload.data());
+    for (int64_t i = 0; i < ntets * 4; ++i) p[i] = t2v[i];
+  }
+
+  out[2].name = "class_id.npy";
+  out[2].header = npy_header("<i4", {ntets});
+  out[2].payload.resize(static_cast<size_t>(ntets) * 4);
+  {
+    auto* p = reinterpret_cast<int32_t*>(out[2].payload.data());
+    for (int64_t i = 0; i < ntets; ++i) p[i] = static_cast<int32_t>(cls[i]);
+  }
+
+  write_zip(argv[2], out);
+  std::fprintf(stderr, "[osh2npz] %s: %lld verts, %lld tets -> %s\n",
+               argv[1], static_cast<long long>(nverts),
+               static_cast<long long>(ntets), argv[2]);
+  return 0;
+}

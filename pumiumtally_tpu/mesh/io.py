@@ -140,8 +140,16 @@ def load_mesh(filename: str, dtype=None) -> TetMesh:
         coords, tet2vert, class_id = load_npz_arrays(filename)
     elif ext == ".msh":
         coords, tet2vert, class_id = parse_gmsh(filename)
+    elif ext == ".osh":
+        # The reference's production format (Omega_h binary::read,
+        # cpp:900) — subset reader; full-fidelity files route through the
+        # offline converter (see mesh/osh.py).
+        from .osh import read_osh
+
+        coords, tet2vert, class_id = read_osh(filename)
     else:
         raise ValueError(
-            f"unsupported mesh format '{ext}' (.npz and .msh supported)"
+            f"unsupported mesh format '{ext}' (.npz, .msh and .osh "
+            "supported)"
         )
     return TetMesh.from_numpy(coords, tet2vert, class_id, dtype=dtype)

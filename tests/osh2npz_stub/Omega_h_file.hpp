@@ -1,0 +1,66 @@
+// Minimal Omega_h API stub so native/osh2npz.cpp's npz-emitting pipeline
+// can be compiled and exercised WITHOUT the real Omega_h (absent in this
+// environment). The stub "reads" a fixed 2-tet mesh regardless of path;
+// tests/test_osh.py::test_osh2npz_emitter_roundtrip then checks numpy can
+// load the produced .npz bit-exactly. Only the symbols osh2npz.cpp
+// touches exist here — this is NOT an Omega_h reimplementation.
+#pragma once
+#include <cstdint>
+#include <string>
+#include <vector>
+
+namespace Omega_h {
+
+using Real = double;
+using LO = int32_t;
+using ClassId = int32_t;
+enum { VERT = 0, REGION = 3 };
+
+template <typename T>
+struct HostRead {
+  std::vector<T> v;
+  HostRead() = default;
+  explicit HostRead(std::vector<T> x) : v(std::move(x)) {}
+  const T* data() const { return v.data(); }
+  T operator[](int64_t i) const { return v[static_cast<size_t>(i)]; }
+};
+
+struct Adj {
+  std::vector<LO> ab2b;
+};
+
+struct CommPtr {};
+
+struct Mesh {
+  int dim() const { return 3; }
+  int64_t nverts() const { return 5; }
+  int64_t nelems() const { return 2; }
+  bool has_tag(int, const std::string& name) const {
+    return name == "class_id";
+  }
+  std::vector<Real> coords() const {
+    return {0, 0, 0, 1, 0, 0, 0, 1, 0, 0, 0, 1, 1, 1, 1};
+  }
+  Adj ask_down(int, int) const { return Adj{{0, 1, 2, 3, 1, 2, 3, 4}}; }
+  template <typename T>
+  std::vector<T> get_array(int, const std::string&) const {
+    return {7, 9};
+  }
+};
+
+struct Library {
+  Library(int*, char***) {}
+  CommPtr world() { return {}; }
+};
+
+namespace binary {
+inline Mesh read(const std::string&, CommPtr) { return Mesh{}; }
+}  // namespace binary
+
+// HostRead over the plain vectors the stub hands out.
+template <typename T>
+HostRead<T> make_host_read(std::vector<T> v) {
+  return HostRead<T>(std::move(v));
+}
+
+}  // namespace Omega_h

@@ -1,0 +1,2 @@
+#pragma once
+#include "Omega_h_file.hpp"

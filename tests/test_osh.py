@@ -1,0 +1,101 @@
+"""Round-trip of the .osh-subset mesh directory format (VERDICT round-2
+item 7): build_box → write_osh → load_mesh must reproduce identical
+connectivity, coordinates, and class ids; genuine Omega_h streams are
+rejected with a pointer at the offline converter instead of misparsed."""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from pumiumtally_tpu.mesh.box import build_box_arrays
+from pumiumtally_tpu.mesh.io import load_mesh
+from pumiumtally_tpu.mesh.osh import read_osh, write_osh
+
+
+def test_osh_roundtrip(tmp_path):
+    coords, tets = build_box_arrays(1.0, 2.0, 3.0, 3, 2, 4)
+    cid = (np.arange(tets.shape[0]) % 5).astype(np.int32)
+    path = str(tmp_path / "mesh.osh")
+    write_osh(path, coords, tets, cid)
+    assert os.path.isfile(os.path.join(path, "nparts"))
+    assert os.path.isfile(os.path.join(path, "0.osh"))
+
+    rc, rt, rcid = read_osh(path)
+    np.testing.assert_array_equal(rc, coords)
+    np.testing.assert_array_equal(rt, tets)
+    np.testing.assert_array_equal(rcid, cid)
+
+    # Through the generic loader: a walkable TetMesh with the same
+    # connectivity-derived tables as the in-memory build.
+    mesh = load_mesh(path, dtype=jnp.float64)
+    assert mesh.ntet == tets.shape[0]
+    direct = __import__(
+        "pumiumtally_tpu.mesh.core", fromlist=["TetMesh"]
+    ).TetMesh.from_numpy(coords, tets, cid, dtype=jnp.float64)
+    np.testing.assert_array_equal(
+        np.asarray(mesh.tet2tet), np.asarray(direct.tet2tet)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(mesh.class_id), np.asarray(direct.class_id)
+    )
+    np.testing.assert_allclose(
+        np.asarray(mesh.volumes), np.asarray(direct.volumes), rtol=1e-12
+    )
+
+
+def test_osh_foreign_stream_rejected(tmp_path):
+    path = tmp_path / "foreign.osh"
+    path.mkdir()
+    (path / "nparts").write_text("1\n")
+    # A stream that is not ours (e.g. genuine Omega_h bytes).
+    (path / "0.osh").write_bytes(b"\x00mega_h!" + b"\x00" * 64)
+    with pytest.raises(NotImplementedError, match="osh2npz"):
+        read_osh(str(path))
+
+
+def test_osh_missing_nparts(tmp_path):
+    d = tmp_path / "empty.osh"
+    d.mkdir()
+    with pytest.raises(FileNotFoundError, match="nparts"):
+        read_osh(str(d))
+
+
+def test_osh2npz_emitter_roundtrip(tmp_path):
+    """Compile native/osh2npz.cpp against the minimal Omega_h API stub in
+    tests/osh2npz_stub (the real library is absent here) and check numpy
+    loads the .npz it emits bit-exactly — validating the tool's zip/npy
+    emitter end to end, which is everything except Omega_h's own reader."""
+    import shutil
+    import subprocess
+
+    gxx = shutil.which("g++")
+    if gxx is None:
+        pytest.skip("no g++ in environment")
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    exe = str(tmp_path / "osh2npz")
+    r = subprocess.run(
+        [
+            gxx, "-std=c++17", "-O1",
+            "-I", os.path.join(root, "tests", "osh2npz_stub"),
+            os.path.join(root, "native", "osh2npz.cpp"),
+            "-o", exe,
+        ],
+        capture_output=True, text=True,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    out = str(tmp_path / "out.npz")
+    r = subprocess.run([exe, "fake.osh", out], capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr[-2000:]
+    z = np.load(out)
+    assert sorted(z.files) == ["class_id", "coords", "tet2vert"]
+    assert z["coords"].shape == (5, 3) and z["coords"].dtype == np.float64
+    np.testing.assert_array_equal(
+        z["tet2vert"], [[0, 1, 2, 3], [1, 2, 3, 4]]
+    )
+    np.testing.assert_array_equal(z["class_id"], [7, 9])
+    # The stub's coords row 1 is the unit-x vertex.
+    np.testing.assert_array_equal(z["coords"][1], [1.0, 0.0, 0.0])
