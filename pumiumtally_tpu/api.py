@@ -113,6 +113,7 @@ class PumiTally:
             # Host-order permutation: device slot i holds particle
             # _perm[i]; None while the layout is still identity.
             self._perm: np.ndarray | None = None
+            self._last_xpoints: tuple | None = None
             timer.sync((self.state, self.flux))
 
     # ------------------------------------------------------------------ #
@@ -202,11 +203,13 @@ class PumiTally:
                 compact_size=self._compact[1],
                 compact_stages=self._compact_stages,
                 unroll=self.config.unroll,
+                record_xpoints=self.config.record_xpoints,
             )
             self.flux = result.flux
             self.state = s._replace(
                 origin=result.position, dest=dest, elem=result.elem
             )
+            self._store_xpoints(result)
             self._initialized = True
             self._warn_if_truncated(result.done)
             if self.config.measure_time:
@@ -276,6 +279,7 @@ class PumiTally:
                 compact_size=self._compact[1],
                 compact_stages=self._compact_stages,
                 unroll=cfg.unroll,
+                record_xpoints=cfg.record_xpoints,
             )
             self.flux = result.flux
             self.state = s._replace(
@@ -303,6 +307,7 @@ class PumiTally:
                 mats_flat[:n][self._perm] = final_mats
             flying_flat[:n] = 0
             self.total_segments += int(result.n_segments)
+            self._store_xpoints(result)
             self._warn_if_truncated(result.done)
 
             # Periodic locality sort (the migrate-every-100 analog,
@@ -320,6 +325,43 @@ class PumiTally:
                 timer.sync(self.state)
 
     # ------------------------------------------------------------------ #
+    def _store_xpoints(self, result) -> None:
+        if result.xpoints is not None:
+            xp = np.asarray(result.xpoints, np.float64)
+            counts = np.asarray(result.n_xpoints, np.int32)
+            # Un-permute into host particle order NOW, with the perm that
+            # was active for this trace — a later periodic sort replaces
+            # self._perm and must not re-map an already-stored buffer.
+            if self._perm is not None:
+                out_xp = np.empty_like(xp)
+                out_c = np.empty_like(counts)
+                out_xp[self._perm] = xp
+                out_c[self._perm] = counts
+                xp, counts = out_xp, out_c
+            self._last_xpoints = (xp, counts)
+
+    def intersection_points(self) -> tuple[np.ndarray, np.ndarray]:
+        """Per-particle boundary-crossing points of the LAST trace call —
+        the tracer's getIntersectionPoints() surface (reference
+        test_pumi_tally_impl_methods.cpp:403-479, 561-587).
+
+        Requires TallyConfig.record_xpoints=K. Returns
+        (xpoints [n, K, 3], counts [n]) in host particle order; counts may
+        exceed K when a walk crossed more boundaries than the buffer
+        holds (only the first K points are kept).
+        """
+        if self.config.record_xpoints is None:
+            raise ValueError(
+                "set TallyConfig.record_xpoints=K to record intersection "
+                "points (off by default: the hot path pays nothing)"
+            )
+        if self._last_xpoints is None:
+            raise RuntimeError(
+                "no trace has run yet: call initialize_particle_location "
+                "(and move_to_next_location) before intersection_points"
+            )
+        return self._last_xpoints
+
     def normalized_flux(self) -> np.ndarray:
         """[ntet, n_groups, 3] (mean, second moment, sd) — normalizeFlux
         parity (cpp:648-683), with the sd NaN guard fix."""

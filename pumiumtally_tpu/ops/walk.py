@@ -125,6 +125,12 @@ class TraceResult(NamedTuple):
     done: [n] bool — False where the walk was truncated by max_crossings
       (the analog of the reference's "Not all particles are found" error,
       cpp:765-768, but reported per particle instead of printed).
+    xpoints: [n, K, 3] per-particle boundary-crossing points, only when
+      record_xpoints=K was requested (tracer getIntersectionPoints()
+      parity, reference test_pumi_tally_impl_methods.cpp:403-479);
+      None otherwise — the hot path pays nothing.
+    n_xpoints: [n] recorded-crossing count per particle (may exceed K,
+      in which case only the first K points were kept), or None.
     """
 
     position: jax.Array
@@ -134,6 +140,8 @@ class TraceResult(NamedTuple):
     n_segments: jax.Array
     n_crossings: jax.Array
     done: jax.Array
+    xpoints: jax.Array | None = None
+    n_xpoints: jax.Array | None = None
 
 
 def trace_impl(
@@ -156,6 +164,7 @@ def trace_impl(
     compact_stages: tuple | None = None,
     unroll: int = 1,
     debug_checks: bool = False,
+    record_xpoints: int | None = None,
 ) -> TraceResult:
     """Advance all particles from origin to dest through the mesh.
 
@@ -199,6 +208,12 @@ def trace_impl(
         (the measured cost driver — the loop is launch-bound, not
         bandwidth-bound) at the price of at most ``unroll - 1`` wasted
         body evaluations at the tail.
+      record_xpoints: when set to K, record each particle's first K
+        boundary-crossing points into an [n, K, 3] buffer (the tracer's
+        getIntersectionPoints() surface, reference test:403-479,
+        561-587). Debug/analysis only: mutually exclusive with the
+        compaction options so the recording never complicates the hot
+        path, which pays nothing when the flag is off.
       debug_checks: thread `checkify` device assertions through the walk
         body — the functional analog of the reference's
         OMEGA_H_CHECK_PRINTF kernel asserts (finite intersection points
@@ -269,6 +284,15 @@ def trace_impl(
     # f32 rounding (1 - 1e-8 == 1 in f32). See the tolerance docstring.
     tol_floor = 8 * float(jnp.finfo(dtype).eps)
 
+    if record_xpoints is not None and (
+        compact_after is not None or compact_stages is not None
+    ):
+        raise ValueError(
+            "record_xpoints is mutually exclusive with straggler "
+            "compaction (it is a debug/analysis surface; disable "
+            "compaction to record intersection points)"
+        )
+
     def make_body(dest_a, in_flight_a, weight_a, group_a):
         """One element-boundary crossing for every lane of a (sub)batch.
 
@@ -279,7 +303,10 @@ def trace_impl(
         good_group = (group_a >= 0) & (group_a < n_groups)
 
         def body(carry):
-            cur, elem, done, mat, flux, nseg, it = carry
+            if record_xpoints is None:
+                cur, elem, done, mat, flux, nseg, it = carry
+            else:
+                cur, elem, done, mat, flux, nseg, xp, kx, it = carry
             active = jnp.logical_not(done)
 
             dirv = dest_a - cur
@@ -306,6 +333,17 @@ def trace_impl(
             xpoint = cur + t_step[:, None] * dirv
 
             crossed = active & ~reached & has_exit
+            if record_xpoints is not None:
+                # Genuine boundary crossings only (a lane that reaches its
+                # destination inside the current element records nothing).
+                # Non-crossing lanes row-index OOB (dropped); lanes past K
+                # crossings column-index OOB (dropped).
+                rows = jnp.where(
+                    crossed, jnp.arange(xp.shape[0], dtype=jnp.int32),
+                    jnp.int32(xp.shape[0]),
+                )
+                xp = xp.at[rows, kx].set(xpoint, mode="drop")
+                kx = kx + crossed.astype(kx.dtype)
             if packed:
                 # Topology came along in the geo20 row: select the exit
                 # face's code locally (no second table gather) and bitcast
@@ -399,7 +437,9 @@ def trace_impl(
             elem = jnp.where(crossed & (next_elem != -1), next_elem, elem)
             cur = jnp.where(active[:, None], xpoint, cur)
             done = done | newly_done
-            return cur, elem, done, mat, flux, nseg, it + 1
+            if record_xpoints is None:
+                return cur, elem, done, mat, flux, nseg, it + 1
+            return cur, elem, done, mat, flux, nseg, xp, kx, it + 1
 
         return body
 
@@ -443,9 +483,18 @@ def trace_impl(
         else min(compact_stages[0][0], max_crossings)
     )
     carry = (origin, elem, done0, mat0, flux, nseg0, jnp.int32(0))
-    cur, elem, done, mat, flux, nseg, it = run_phase(
-        full_body, carry, phase1_bound
-    )
+    xp = kx = None
+    if record_xpoints is not None:
+        xp0 = jnp.zeros((n, int(record_xpoints), 3), dtype)
+        kx0 = elem * 0  # per-lane zero (device-varying under shard_map)
+        carry = carry[:-1] + (xp0, kx0, jnp.int32(0))
+        cur, elem, done, mat, flux, nseg, xp, kx, it = run_phase(
+            full_body, carry, phase1_bound
+        )
+    else:
+        cur, elem, done, mat, flux, nseg, it = run_phase(
+            full_body, carry, phase1_bound
+        )
 
     def compact_round(state, S, bound):
         """One compaction round: gather the first S active lanes, advance
@@ -542,6 +591,8 @@ def trace_impl(
         n_segments=nseg,
         n_crossings=it,
         done=done,
+        xpoints=xp,
+        n_xpoints=kx,
     )
 
 
@@ -578,6 +629,7 @@ trace = jax.jit(
         "compact_stages",
         "unroll",
         "debug_checks",
+        "record_xpoints",
     ),
     donate_argnames=("flux",),
 )

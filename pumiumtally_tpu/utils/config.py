@@ -59,6 +59,12 @@ class TallyConfig:
         (finite positions/weights) on every call, the analog of the
         reference's OMEGA_H_CHECK_PRINTF device asserts (cpp:605-608).
         Group-bounds violations (cpp:634-638) are always rejected.
+      record_xpoints: when set to K, every trace records each particle's
+        first K boundary-crossing points, retrievable via
+        PumiTally.intersection_points() (tracer getIntersectionPoints()
+        parity, reference test:403-479). Debug/analysis only: it
+        disables straggler compaction for the run and costs one extra
+        [n,3] store per crossing; the default (None) pays nothing.
     """
 
     n_groups: int = 2
@@ -75,6 +81,7 @@ class TallyConfig:
     score_squares: bool = True
     measure_time: bool = False
     checkify_invariants: bool = False
+    record_xpoints: int | None = None
 
     def resolve_max_crossings(self, ntet: int) -> int:
         if self.max_crossings is not None:
@@ -87,8 +94,13 @@ class TallyConfig:
 
     def resolve_compaction(self, n_particles: int) -> tuple[int | None, int | None]:
         """Compaction kicks in only where the straggler tail matters; tiny
-        batches stay on the flat loop."""
-        if self.compact_after is None or n_particles < 1024:
+        batches stay on the flat loop. Recording intersection points
+        forces the flat loop (walk.py: mutually exclusive)."""
+        if (
+            self.compact_after is None
+            or n_particles < 1024
+            or self.record_xpoints is not None
+        ):
             return None, None
         size = self.compact_size
         if size is None:
@@ -98,7 +110,11 @@ class TallyConfig:
     def resolve_compact_stages(self, n_particles: int) -> tuple | None:
         """Clamp a configured stage schedule to the batch size (None when
         unset — the single-stage knobs apply)."""
-        if self.compact_stages is None or n_particles < 1024:
+        if (
+            self.compact_stages is None
+            or n_particles < 1024
+            or self.record_xpoints is not None
+        ):
             return None
         return tuple(
             (int(start), min(max(int(size), 1), n_particles))

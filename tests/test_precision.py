@@ -92,3 +92,68 @@ def test_f64_stable_across_scheduling():
     np.testing.assert_array_equal(
         np.asarray(r_a.material_id), np.asarray(r_b.material_id)
     )
+
+
+def test_f32_grazing_ray_tolerance_semantics():
+    """Round-1 task 3's acceptance test (VERDICT round-2 item 3c): an f32
+    destination within the geometric tolerance band of an interior face
+    must count as INSIDE the current element (reached, no hop), while a
+    destination past the band crosses — here onto a material boundary, so
+    it stops clipped on the plane with the far side's class id. Both
+    semantics asserted in float32 with the geometric tolerance 1e-6.
+    """
+    coords, tets = build_box_arrays(1.0, 1.0, 1.0, 2, 1, 1)
+    # Left cell (x<0.5) class 3, right cell class 9: the x=0.5 plane is
+    # both an interior face and a material boundary.
+    cid = np.where(
+        coords[tets].mean(axis=1)[:, 0] < 0.5, 3, 9
+    ).astype(np.int32)
+    mesh = TetMesh.from_numpy(coords, tets, cid, dtype=jnp.float32)
+    cents = np.asarray(mesh.centroids())
+    e0 = int(np.argmin(np.abs(cents[:, 0] - 0.25)))  # a left-cell element
+    origin = cents[e0:e0 + 1]
+    tol = 1e-6
+
+    def run(d_beyond):
+        dest = origin.copy()
+        dest[0, 0] = 0.5 + d_beyond  # graze the x=0.5 plane by d_beyond
+        r = trace_impl(
+            mesh,
+            jnp.asarray(origin, jnp.float32),
+            jnp.asarray(dest, jnp.float32),
+            jnp.asarray([e0], jnp.int32),
+            jnp.ones(1, bool),
+            jnp.ones(1, jnp.float32),
+            jnp.zeros(1, jnp.int32),
+            jnp.full(1, -1, jnp.int32),
+            make_flux(mesh.ntet, 1, jnp.float32),
+            initial=False,
+            max_crossings=mesh.ntet + 8,
+            tolerance=tol,
+        )
+        assert bool(np.asarray(r.done).all())
+        return (
+            int(np.asarray(r.elem)[0]),
+            int(np.asarray(r.material_id)[0]),
+            np.asarray(r.position)[0],
+        )
+
+    # Inside the band (1e-8..1e-6 of the face): reached-at-destination
+    # semantics — no hop, no material stop, position = destination.
+    for d in (1e-8, 1e-7, 5e-7):
+        elem, mat, pos = run(d)
+        assert int(np.asarray(mesh.class_id)[elem]) == 3, (
+            f"d={d}: grazing destination must stay in the near element"
+        )
+        assert mat == -1  # plain reached, not a material stop
+        # The reached position is the tolerance-band intersection point,
+        # within the geometric tolerance of the true destination.
+        assert abs(pos[0] - np.float32(0.5 + d)) <= tol + 2e-7
+
+    # Past the band: a genuine crossing -> material stop ON the plane
+    # with the far side's class id, parent element hopped across
+    # (reference cpp:452-515 semantics).
+    elem, mat, pos = run(1e-3)
+    assert int(np.asarray(mesh.class_id)[elem]) == 9
+    assert mat == 9
+    assert abs(pos[0] - 0.5) < 1e-6  # clipped to the intersection point
