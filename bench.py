@@ -128,6 +128,30 @@ def run(
     elapsed = time.perf_counter() - t0
 
     segments_per_sec = total_segments / elapsed
+
+    # ---- event-loop benchmark (reference §3.3 per-event pattern) -------
+    # Drives PumiTally.move_to_next_location with per-event HOST arrays:
+    # H2D staging, fused walk, D2H position/material write-back and a
+    # device sync per call — the reference's per-advance-event contract
+    # (cpp:221-264) — plus the double-buffered StreamingTallyPipeline
+    # variant, which keeps `depth` walks in flight and defers readbacks.
+    event = {}
+    if os.environ.get("BENCH_EVENT", "1") == "1":
+        event = run_event_loop(
+            mesh,
+            n_particles=int(
+                os.environ.get(
+                    "BENCH_EVENT_PARTICLES",
+                    str(min(262144, n_particles)),
+                )
+            ),
+            moves=int(os.environ.get("BENCH_EVENT_MOVES", "4")),
+            n_groups=n_groups,
+            dtype=dtype,
+            mean_path=mean_path,
+            seed=seed,
+        )
+
     per_chip_baseline = 1e9 / 64.0
     return {
         "metric": "particle_segments_per_sec_per_chip",
@@ -146,7 +170,142 @@ def run(
             "compile_s": round(compile_s, 2),
             "device": str(jax.devices()[0]),
             "last_step_crossing_iters": int(np.asarray(ncross)),
+            **event,
         },
+    }
+
+
+def run_event_loop(
+    mesh, n_particles, moves, n_groups, dtype, mean_path, seed
+) -> dict:
+    """Measure the full per-event host loop and the streaming pipeline.
+
+    Returns dict entries merged into the bench detail:
+      event_loop_segments_per_sec — move_to_next_location with host
+        arrays in and clipped positions/materials out, one sync per call.
+      event_call_overhead_ms — per-call cost above a device-resident run
+        of the SAME walk configuration and batch size (so the delta is
+        purely H2D+D2H staging, host prep, and the per-call sync —
+        SURVEY §7 hard part 6), measured here rather than derived from
+        the differently-configured headline number.
+      pipeline_segments_per_sec — StreamingTallyPipeline (depth 2).
+    """
+    from pumiumtally_tpu.api import PumiTally, TallyConfig
+    from pumiumtally_tpu.models.pipeline import StreamingTallyPipeline
+
+    rng = np.random.default_rng(seed + 1)
+    cfg = TallyConfig(
+        dtype=dtype, n_groups=n_groups, tolerance=1e-6, unroll=8
+    )
+    tally = PumiTally(mesh, n_particles, cfg)
+    cents = np.asarray(mesh.centroids())
+    elem = rng.integers(0, mesh.ntet, n_particles).astype(np.int32)
+    pos0 = cents[elem].astype(np.float64)
+    tally.initialize_particle_location(pos0.reshape(-1).copy())
+
+    def new_dest(prev):
+        d = rng.normal(0, 1, (n_particles, 3))
+        d /= np.linalg.norm(d, axis=1, keepdims=True)
+        ln = rng.exponential(mean_path, (n_particles, 1))
+        return np.clip(prev + d * ln, 0.01, 0.99)
+
+    weights = np.ones(n_particles)
+    groups = rng.integers(0, n_groups, n_particles).astype(np.int32)
+    mats = np.full(n_particles, -1, np.int32)
+
+    # Warm the move signature (compile) outside the clock.
+    prev = pos0
+    buf = new_dest(prev).reshape(-1).copy()
+    tally.move_to_next_location(
+        buf, np.ones(n_particles, np.int8), weights, groups, mats
+    )
+    prev = buf.reshape(n_particles, 3)
+    dests = [new_dest(prev)]
+    for _ in range(moves - 1):
+        # Pre-generate a plausible destination chain so host RNG cost
+        # stays outside the comparison where possible (the true chain
+        # depends on clipped positions; the first hop uses the real one).
+        dests.append(new_dest(dests[-1]))
+
+    seg0 = tally.total_segments
+    t0 = time.perf_counter()
+    for i in range(moves):
+        buf = dests[i].reshape(-1).copy()
+        tally.move_to_next_location(
+            buf, np.ones(n_particles, np.int8), weights, groups, mats
+        )
+    dt = time.perf_counter() - t0
+    segs = tally.total_segments - seg0
+    event_rate = segs / dt
+    t_call = dt / moves
+
+    # Device-resident comparator: the SAME trace configuration and batch
+    # size with inputs already on device and no per-call readback — the
+    # honest kernel-only baseline for the overhead number.
+    import jax.numpy as jnp
+
+    from pumiumtally_tpu.core.tally import make_flux
+    from pumiumtally_tpu.ops.walk import trace
+
+    kw = dict(
+        initial=False,
+        max_crossings=cfg.resolve_max_crossings(mesh.ntet),
+        score_squares=cfg.score_squares,
+        tolerance=cfg.tolerance,
+        unroll=cfg.unroll,
+        compact_stages=cfg.resolve_compact_stages(n_particles),
+    )
+    ca, cs = cfg.resolve_compaction(n_particles)
+    kw.update(compact_after=ca, compact_size=cs)
+    dev_origin = jnp.asarray(prev, cfg.dtype)
+    dev_dests = [jnp.asarray(d, cfg.dtype) for d in dests]
+    dev_elem = jnp.asarray(np.asarray(tally.state.elem))
+    dev_if = jnp.ones(n_particles, bool)
+    dev_w = jnp.asarray(weights, cfg.dtype)
+    dev_g = jnp.asarray(groups)
+    dev_m = jnp.full(n_particles, -1, jnp.int32)
+    kflux = make_flux(mesh.ntet, n_groups, cfg.dtype)
+    r = trace(mesh, dev_origin, dev_dests[0], dev_elem, dev_if, dev_w,
+              dev_g, dev_m, kflux, **kw)  # warm (already compiled shape)
+    int(np.asarray(r.n_segments))  # fence
+    cur, cure, kflux = r.position, r.elem, r.flux
+    ksegs = 0
+    t0 = time.perf_counter()
+    for i in range(moves):
+        r = trace(mesh, cur, dev_dests[i % len(dev_dests)], cure, dev_if,
+                  dev_w, dev_g, dev_m, kflux, **kw)
+        cur, cure, kflux = r.position, r.elem, r.flux
+        ksegs += r.n_segments
+    ksegs = int(np.asarray(ksegs))  # readback fence
+    dt_k = time.perf_counter() - t0
+    overhead_ms = (t_call - dt_k / moves) * 1e3
+
+    # Streaming pipeline variant: independent batches, depth-2 overlap.
+    pipe = StreamingTallyPipeline(mesh, cfg, depth=2, want_outputs=True)
+    batches = []
+    for _ in range(moves + 1):
+        e = rng.integers(0, mesh.ntet, n_particles).astype(np.int32)
+        o = cents[e]
+        batches.append((o, new_dest(o), e))
+    o, d, e = batches[0]
+    pipe.submit(o, d, e, weight=weights, group=groups)  # warm/compile
+    pipe.finish()
+    t0 = time.perf_counter()
+    for o, d, e in batches[1:]:
+        pipe.submit(o, d, e, weight=weights, group=groups)
+    flux = pipe.finish()
+    dt_p = time.perf_counter() - t0
+    del flux
+    # Exclude the warm/compile batch (index 0) drained before the clock.
+    psegs = sum(r.n_segments for r in pipe.results() if r.index > 0)
+    pipe_rate = psegs / dt_p
+
+    return {
+        "event_loop_segments_per_sec": round(event_rate, 1),
+        "event_call_overhead_ms": round(overhead_ms, 2),
+        "event_particles": n_particles,
+        "event_moves": moves,
+        "pipeline_segments_per_sec": round(pipe_rate, 1),
     }
 
 

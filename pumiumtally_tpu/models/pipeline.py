@@ -31,7 +31,11 @@ from ..utils.config import TallyConfig
 
 
 class BatchResult(NamedTuple):
-    """Host-side outputs for one streamed batch."""
+    """Host-side outputs for one streamed batch.
+
+    xpoints/n_xpoints carry the per-particle boundary-crossing points
+    when the config sets record_xpoints=K (None otherwise — the surface
+    is config-uniform with PumiTally.intersection_points)."""
 
     index: int
     position: np.ndarray
@@ -39,6 +43,8 @@ class BatchResult(NamedTuple):
     material_id: np.ndarray
     n_segments: int
     all_done: bool
+    xpoints: np.ndarray | None = None
+    n_xpoints: np.ndarray | None = None
 
 
 class StreamingTallyPipeline:
@@ -117,6 +123,7 @@ class StreamingTallyPipeline:
             ),
             compact_stages=cfg.resolve_compact_stages(n),
             unroll=cfg.unroll,
+            record_xpoints=cfg.record_xpoints,
         )
         # The flux chain threads through every batch (donated each step);
         # per-batch outputs wait in the in-flight queue.
@@ -137,6 +144,14 @@ class StreamingTallyPipeline:
                     material_id=np.asarray(r.material_id),
                     n_segments=int(r.n_segments),
                     all_done=bool(np.asarray(r.done).all()),
+                    xpoints=(
+                        None if r.xpoints is None else np.asarray(r.xpoints)
+                    ),
+                    n_xpoints=(
+                        None
+                        if r.n_xpoints is None
+                        else np.asarray(r.n_xpoints)
+                    ),
                 )
             )
 

@@ -72,12 +72,28 @@ def main():
         return total / dt / 1e6, dt / steps * 1e3, int(np.asarray(ncross)), compile_s
 
     M = n
+    # Round-3 candidates: the round-1 sweep that settled on the r2
+    # default used ARGSORT compaction (expensive rounds); the cumsum
+    # partition made rounds ~free, so denser/earlier/longer ladders are
+    # back on the table. Active lanes ≈ n·exp(-k/16.6) at crossing k, so
+    # the slot waste lives in (a) phase 1 running all lanes to 16 ≈ the
+    # mean, and (b) the final stage running n/8 lanes for the whole tail.
     variants = [
-        ("s16h_32e", dict(compact_stages=((16, M // 2), (32, M // 8)))),
-        ("s16q_32e", dict(compact_stages=((16, M // 4), (32, M // 8)))),
-        ("s16h_24q_40e", dict(
+        ("default_r2", dict(
             compact_stages=((16, M // 2), (24, M // 4), (40, M // 8)))),
-        ("s24h_40e", dict(compact_stages=((24, M // 2), (40, M // 8)))),
+        ("tail64", dict(
+            compact_stages=((16, M // 2), (24, M // 4), (40, M // 8),
+                            (64, M // 32)))),
+        ("tail64_96", dict(
+            compact_stages=((16, M // 2), (24, M // 4), (40, M // 8),
+                            (64, M // 32), (96, M // 64)))),
+        ("early8", dict(
+            compact_stages=((8, 5 * M // 8), (16, 3 * M // 8), (24, M // 4),
+                            (40, M // 8), (64, M // 32)))),
+        ("dense", dict(
+            compact_stages=((8, 5 * M // 8), (16, 3 * M // 8), (24, M // 4),
+                            (32, M // 8), (48, M // 16), (64, M // 32),
+                            (96, M // 64)))),
     ]
     for name, kw in variants:
         mseg, ms, iters, cs = run(**kw)

@@ -74,3 +74,31 @@ def test_pipeline_no_outputs_mode():
     flux = pipe.finish()
     assert flux[..., 0].sum() > 0
     assert list(pipe.results()) == []
+
+
+def test_pipeline_records_xpoints_when_configured():
+    """TallyConfig.record_xpoints must apply on the pipeline path too —
+    BatchResult carries the crossing points (None when the flag is off)."""
+    mesh = build_box(1.0, 1.0, 1.0, 3, 3, 3)
+    pipe = StreamingTallyPipeline(
+        mesh, TallyConfig(n_groups=2, tolerance=1e-6, record_xpoints=8),
+        depth=2,
+    )
+    for origin, dest, elem, weight, group in _batches(mesh, 24, 2, seed=5):
+        pipe.submit(origin, dest, elem, weight, group)
+    pipe.finish()
+    got = list(pipe.results())
+    assert got and all(b.xpoints is not None for b in got)
+    for b in got:
+        assert b.xpoints.shape == (24, 8, 3)
+        assert b.n_xpoints.shape == (24,)
+        # Crossing counts are genuine: some particles cross, and each
+        # recorded point differs from the one before it.
+        assert (b.n_xpoints > 0).any()
+    off = StreamingTallyPipeline(
+        mesh, TallyConfig(n_groups=2, tolerance=1e-6), depth=2
+    )
+    for origin, dest, elem, weight, group in _batches(mesh, 24, 1, seed=6):
+        off.submit(origin, dest, elem, weight, group)
+    off.finish()
+    assert all(b.xpoints is None for b in off.results())
