@@ -99,3 +99,38 @@ def test_osh2npz_emitter_roundtrip(tmp_path):
     np.testing.assert_array_equal(z["class_id"], [7, 9])
     # The stub's coords row 1 is the unit-x vertex.
     np.testing.assert_array_equal(z["coords"][1], [1.0, 0.0, 0.0])
+
+
+def test_osh_multipart_concatenates(tmp_path):
+    """A multi-part directory (one stream per rank) concatenates parts
+    with per-part vertex offsets."""
+    import struct
+
+    from pumiumtally_tpu.mesh.osh import MAGIC
+
+    coords, tets = build_box_arrays(1.0, 1.0, 1.0, 2, 2, 2)
+    cid = np.arange(tets.shape[0], dtype=np.int32) % 3
+    path = str(tmp_path / "two.osh")
+    # Write part 0 via write_osh, then append a second part by hand and
+    # bump nparts.
+    write_osh(path, coords, tets, cid)
+    coords2 = coords + 10.0
+    with open(os.path.join(path, "1.osh"), "wb") as f:
+        f.write(MAGIC)
+        f.write(struct.pack("<i", 3))
+        f.write(struct.pack("<q", coords2.shape[0]))
+        f.write(struct.pack("<q", tets.shape[0]))
+        f.write(coords2.astype("<f8").tobytes())
+        f.write(tets.astype("<i4").tobytes())
+        f.write((cid + 100).astype("<i4").tobytes())
+    with open(os.path.join(path, "nparts"), "w") as f:
+        f.write("2\n")
+
+    rc, rt, rcid = read_osh(path)
+    nv, nt = coords.shape[0], tets.shape[0]
+    assert rc.shape == (2 * nv, 3) and rt.shape == (2 * nt, 4)
+    np.testing.assert_array_equal(rc[:nv], coords)
+    np.testing.assert_array_equal(rc[nv:], coords2)
+    np.testing.assert_array_equal(rt[:nt], tets)
+    np.testing.assert_array_equal(rt[nt:], tets + nv)  # offset applied
+    np.testing.assert_array_equal(rcid[nt:], cid + 100)
