@@ -332,6 +332,31 @@ def trace_impl(
             t_step = jnp.minimum(t_exit, 1.0)
             xpoint = cur + t_step[:, None] * dirv
 
+            if debug_checks:
+                from jax.experimental import checkify
+
+                # Walk-consistency analog of the reference's
+                # tracklength device print (cpp:618-629): every active
+                # particle must actually be inside (within tolerance +
+                # rounding of) its claimed parent element — a wrong
+                # parent id, a broken hop, or degenerate geometry shows
+                # up here as an off-element position. Uses the already
+                # gathered face planes, so the debug cost is a couple of
+                # reductions. Also guards the tally-free initial search.
+                sd = (
+                    jnp.einsum("pfc,pc->pf", normals, cur) - dplane
+                )  # signed distance to own faces; positive = outside
+                scale = jnp.max(jnp.abs(cur), axis=-1) + 1.0
+                bound = 10.0 * tolerance + 64.0 * tol_floor * scale
+                checkify.check(
+                    jnp.all(
+                        jnp.where(active, jnp.max(sd, axis=-1), 0.0)
+                        <= bound
+                    ),
+                    "particle position outside its parent element "
+                    "(corrupted walk state or degenerate geometry)",
+                )
+
             crossed = active & ~reached & has_exit
             if record_xpoints is not None:
                 # Genuine boundary crossings only (a lane that reaches its

@@ -50,3 +50,34 @@ def test_nan_destination_trips_check():
     )
     with pytest.raises(Exception, match="non-finite|contribution"):
         err.throw()
+
+
+def test_wrong_parent_element_trips_consistency_check():
+    """The walk-consistency assert (the reference's tracklength print
+    analog, cpp:618-629) must fire when a particle's claimed parent
+    element does not contain its position."""
+    mesh = build_box(1.0, 1.0, 1.0, 3, 3, 3)
+    rng = np.random.default_rng(2)
+    n = 8
+    elem = jnp.asarray(rng.integers(0, mesh.ntet, n).astype(np.int32))
+    cents = np.asarray(mesh.centroids())
+    origin = np.asarray(cents)[np.asarray(elem)]
+    # Corrupt one parent id: the particle sits at elem[0]'s centroid but
+    # claims the element farthest from it.
+    far = int(
+        np.argmax(np.linalg.norm(cents - origin[0], axis=1))
+    )
+    elem = elem.at[0].set(far)
+    dest = rng.uniform(0.1, 0.9, (n, 3))
+    err, _ = checked_trace(
+        mesh,
+        jnp.asarray(origin, jnp.float32),
+        jnp.asarray(dest, jnp.float32),
+        elem,
+        jnp.ones(n, bool), jnp.ones(n, jnp.float32),
+        jnp.zeros(n, jnp.int32), jnp.full(n, -1, jnp.int32),
+        make_flux(mesh.ntet, 1, jnp.float32),
+        initial=False, max_crossings=mesh.ntet + 8, tolerance=1e-6,
+    )
+    with pytest.raises(Exception, match="outside its parent element"):
+        err.throw()
