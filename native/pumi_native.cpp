@@ -196,10 +196,10 @@ void pn_derive_geometry(const double* coords, int64_t* tet2vert, int64_t ntet,
 }
 
 // ---------------------------------------------------------------------------
-// Gmsh ASCII reader (v2.2; keeps only 4-node tetrahedra, element type 4).
-// Two-call protocol: pn_gmsh_open parses the whole file into an opaque
-// handle and reports sizes; pn_gmsh_fill copies into caller buffers.
-// Replaces the reference's Omega_h binary mesh reader call site
+// Gmsh ASCII reader (v2.2 and v4.1; keeps only 4-node tetrahedra, element
+// type 4). Two-call protocol: pn_gmsh_open parses the whole file into an
+// opaque handle and reports sizes; pn_gmsh_fill copies into caller
+// buffers. Replaces the reference's Omega_h binary mesh reader call site
 // (read_pumipic_lib_and_full_mesh, .cpp:891-909) with the standard
 // unstructured-tet interchange format.
 // ---------------------------------------------------------------------------
@@ -255,6 +255,107 @@ struct Cursor {
   }
 };
 
+// Node counts per Gmsh element type 1..15 (same codes in v2 and v4; type
+// 15 points appear in most real exports with physical points and must be
+// skippable, not fatal).
+const int kNvertsForType[16] = {0, 2, 3, 4, 4,  8,  6,  5,
+                                3, 6, 9, 10, 27, 18, 14, 1};
+
+// Dense remap is only sensible for near-dense id spaces; sparse/huge ids
+// (legal in Gmsh) fall back to the Python dict-based renumbering rather
+// than attempting a max_id-sized allocation.
+bool build_remap(const std::vector<int64_t>& node_ids, int64_t max_id,
+                 std::vector<int64_t>& remap) {
+  int64_t nn = static_cast<int64_t>(node_ids.size());
+  if (max_id < 0 || max_id > nn * 8 + (1 << 20)) return false;
+  remap.assign(static_cast<size_t>(max_id) + 1, -1);
+  for (int64_t i = 0; i < nn; ++i) remap[node_ids[i]] = i;
+  return true;
+}
+
+GmshData* parse_gmsh_v41(Cursor cur, int64_t* n_nodes, int64_t* n_tets) {
+  // v4.1 ASCII layout: block-structured $Nodes (header
+  // `numBlocks numNodes minTag maxTag`, each block `dim tag parametric
+  // numInBlock` followed by numInBlock node tags then numInBlock xyz
+  // lines) and $Elements (header `numBlocks numElems minTag maxTag`,
+  // each block `dim entityTag elemType numInBlock` followed by
+  // `elemTag node...` rows). class_id = the block's entity tag,
+  // matching the Python parser and the reference's region tag use.
+  if (!cur.seek_line("$Nodes")) return nullptr;
+  int64_t nblocks = cur.next_i64();
+  int64_t nn = cur.next_i64();
+  cur.next_i64();  // minNodeTag
+  cur.next_i64();  // maxNodeTag
+  if (!cur.ok || nn <= 0 || nblocks < 0) return nullptr;
+  std::vector<int64_t> node_ids(nn);
+  std::vector<double> raw_coords(nn * 3);
+  int64_t k = 0, max_id = 0;
+  for (int64_t b = 0; b < nblocks && cur.ok; ++b) {
+    cur.next_i64();  // entityDim
+    cur.next_i64();  // entityTag
+    int64_t parametric = cur.next_i64();
+    int64_t nb = cur.next_i64();
+    // nb > nn - k (not k + nb > nn): the latter can wrap negative on a
+    // corrupt header claiming ~INT64_MAX nodes and bypass the bound.
+    if (!cur.ok || parametric != 0 || nb < 0 || nb > nn - k) return nullptr;
+    for (int64_t i = 0; i < nb; ++i) {
+      node_ids[k + i] = cur.next_i64();
+      if (node_ids[k + i] > max_id) max_id = node_ids[k + i];
+    }
+    for (int64_t i = 0; i < nb; ++i) {
+      raw_coords[(k + i) * 3 + 0] = cur.next_f64();
+      raw_coords[(k + i) * 3 + 1] = cur.next_f64();
+      raw_coords[(k + i) * 3 + 2] = cur.next_f64();
+    }
+    k += nb;
+  }
+  if (!cur.ok || k != nn) return nullptr;
+  std::vector<int64_t> remap;
+  if (!build_remap(node_ids, max_id, remap)) return nullptr;
+
+  if (!cur.seek_line("$Elements")) return nullptr;
+  int64_t eblocks = cur.next_i64();
+  int64_t ne = cur.next_i64();
+  cur.next_i64();  // minElementTag
+  cur.next_i64();  // maxElementTag
+  if (!cur.ok || eblocks < 0 || ne < 0) return nullptr;
+  auto data = std::make_unique<GmshData>();
+  data->coords = std::move(raw_coords);
+  // Avoid push_back reallocation churn on multi-million-tet meshes (the
+  // workload this fast path exists for); cap against a corrupt header.
+  int64_t reserve_n = ne < (1 << 28) ? ne : (1 << 28);
+  data->tet2vert.reserve(static_cast<size_t>(reserve_n) * 4);
+  data->class_id.reserve(static_cast<size_t>(reserve_n));
+  for (int64_t b = 0; b < eblocks && cur.ok; ++b) {
+    cur.next_i64();  // entityDim
+    int64_t etag = cur.next_i64();
+    int64_t etype = cur.next_i64();
+    int64_t nb = cur.next_i64();
+    if (!cur.ok || nb < 0) return nullptr;
+    int nv = (etype >= 1 && etype <= 15)
+                 ? kNvertsForType[etype]
+                 : -1;
+    if (nv < 0) return nullptr;  // unknown element type — cannot skip
+    for (int64_t e = 0; e < nb && cur.ok; ++e) {
+      cur.next_i64();  // element tag
+      if (etype == 4) {
+        for (int v = 0; v < 4; ++v) {
+          int64_t nid = cur.next_i64();
+          if (nid < 0 || nid > max_id || remap[nid] < 0) return nullptr;
+          data->tet2vert.push_back(remap[nid]);
+        }
+        data->class_id.push_back(static_cast<int32_t>(etag));
+      } else {
+        for (int v = 0; v < nv; ++v) cur.next_i64();
+      }
+    }
+  }
+  if (!cur.ok || data->tet2vert.empty()) return nullptr;
+  *n_nodes = nn;
+  *n_tets = static_cast<int64_t>(data->class_id.size());
+  return data.release();
+}
+
 }  // namespace
 
 // Returns handle (or nullptr). Sets *n_nodes, *n_tets.
@@ -272,7 +373,11 @@ void* pn_gmsh_open(const char* path, int64_t* n_nodes, int64_t* n_tets) try {
   Cursor cur{buf.data(), buf.data() + rd};
   if (!cur.seek_line("$MeshFormat")) return nullptr;
   double version = cur.next_f64();
-  if (!cur.ok || version >= 4.0) return nullptr;  // v4 handled in Python
+  int64_t is_binary = cur.next_i64();
+  if (!cur.ok || is_binary != 0) return nullptr;  // binary → Python/error
+  if (version >= 4.0 && version < 5.0)
+    return parse_gmsh_v41(cur, n_nodes, n_tets);
+  if (version >= 4.0) return nullptr;  // unknown major → Python fallback
 
   if (!cur.seek_line("$Nodes")) return nullptr;
   int64_t nn = cur.next_i64();
@@ -288,12 +393,8 @@ void* pn_gmsh_open(const char* path, int64_t* n_nodes, int64_t* n_tets) try {
     if (node_ids[i] > max_id) max_id = node_ids[i];
   }
   if (!cur.ok) return nullptr;
-  // Dense remap is only sensible for near-dense id spaces; sparse/huge ids
-  // (legal in Gmsh) fall back to the Python dict-based renumbering rather
-  // than attempting a max_id-sized allocation.
-  if (max_id < 0 || max_id > nn * 8 + (1 << 20)) return nullptr;
-  std::vector<int64_t> remap(static_cast<size_t>(max_id) + 1, -1);
-  for (int64_t i = 0; i < nn; ++i) remap[node_ids[i]] = i;
+  std::vector<int64_t> remap;
+  if (!build_remap(node_ids, max_id, remap)) return nullptr;
 
   if (!cur.seek_line("$Elements")) return nullptr;
   int64_t ne = cur.next_i64();
@@ -312,12 +413,7 @@ void* pn_gmsh_open(const char* path, int64_t* n_nodes, int64_t* n_tets) try {
       int64_t tag = cur.next_i64();
       if (t == 0) first_tag = tag;
     }
-    // Node counts per Gmsh v2 element type 1..15 (lines through point
-    // elements; type 15 points appear in most real exports with physical
-    // points and must be skippable, not fatal).
-    static const int nverts_for[16] = {0, 2,  3,  4, 4,  8,  6, 5,
-                                       3, 6,  9,  10, 27, 18, 14, 1};
-    int nv = (etype >= 1 && etype <= 15) ? nverts_for[etype] : -1;
+    int nv = (etype >= 1 && etype <= 15) ? kNvertsForType[etype] : -1;
     if (nv < 0) return nullptr;  // unknown element type — cannot skip safely
     if (etype == 4) {
       for (int k = 0; k < 4; ++k) {

@@ -35,9 +35,9 @@ def parse_gmsh(filename: str):
     4-node tetrahedra (element type 4). Returns (coords, tet2vert, class_id)
     with class_id from the first element tag (physical group).
 
-    v2.2 files go through the native C++ tokenizer when available
-    (pumiumtally_tpu.native.parse_gmsh); v4 and fallback parsing stay in
-    Python."""
+    v2.2 and v4.1 ASCII files go through the native C++ tokenizer when
+    available (pumiumtally_tpu.native.parse_gmsh); binary files, sparse
+    node-id spaces, and parse failures fall back to Python."""
     from .. import native
 
     fast = native.parse_gmsh(filename)

@@ -150,8 +150,9 @@ def derive_geometry(coords: np.ndarray, tet2vert: np.ndarray):
 
 
 def parse_gmsh(filename: str):
-    """Native Gmsh v2.2 ASCII reader → (coords, tet2vert, class_id), or None
-    (v4 files and parse failures fall back to the Python reader)."""
+    """Native Gmsh ASCII reader (v2.2 and v4.1) → (coords, tet2vert,
+    class_id), or None (binary files, sparse node-id spaces, and parse
+    failures fall back to the Python reader)."""
     lib = load()
     if lib is None:
         return None

@@ -1,0 +1,28 @@
+#!/bin/bash
+# Round-3 TPU measurement capture — run when the tunnel is live.
+# Captures, in priority order (cheapest-first so partial runs still pay):
+#   1. headline bench (walk v3, default schedule)
+#   2. compaction-ladder sweep (denser round-3 candidates)
+#   3. 64-group contention guard (VERDICT task 1 done-criterion)
+#   4. 10M-tet single-chip rung (VERDICT task 2)
+#   5. full benchmark ladder refresh
+# Outputs land in bench_out/ (one file per measurement, stderr kept).
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p bench_out
+
+run() {
+  name="$1"; shift
+  echo "=== $name: $* ==="
+  timeout 1800 "$@" >"bench_out/$name.out" 2>"bench_out/$name.err"
+  echo "rc=$? ($name)"
+  tail -2 "bench_out/$name.out" 2>/dev/null
+}
+
+run bench_v3_default env BENCH_EVENT=1 python bench.py
+run sweep_stages python scripts/sweep_stages.py 55 3
+run bench_v3_64g env BENCH_GROUPS=64 BENCH_EVENT=0 python bench.py
+run bench_v3_10m env BENCH_CELLS=119 BENCH_PARTICLES=2097152 \
+    BENCH_STEPS=5 BENCH_EVENT=0 python bench.py
+run ladder_v3 python scripts/bench_ladder.py --configs 1,2,3,4
+echo "=== capture complete ==="

@@ -174,3 +174,52 @@ def test_gmsh_skips_point_elements(tmp_path):
     coords, tets, cids = got
     assert tets.shape == (1, 4)
     assert list(cids) == [9]
+
+
+def test_gmsh_v41_native_matches_python(tmp_path):
+    # Two node blocks, a triangle block (skipped) and two tet blocks with
+    # distinct entity tags, Gmsh v4.1 ASCII.
+    msh = textwrap.dedent(
+        """\
+        $MeshFormat
+        4.1 0 8
+        $EndMeshFormat
+        $Nodes
+        2 5 1 7
+        3 1 0 3
+        1
+        2
+        3
+        0 0 0
+        1 0 0
+        0 1 0
+        3 2 0 2
+        4
+        7
+        0 0 1
+        1 1 1
+        $EndNodes
+        $Elements
+        3 3 1 3
+        2 5 2 1
+        1 1 2 3
+        3 9 4 1
+        2 1 2 3 4
+        3 11 4 1
+        3 2 3 4 7
+        $EndElements
+        """
+    )
+    p = tmp_path / "two_tets_v41.msh"
+    p.write_text(msh)
+    got = native.parse_gmsh(str(p))
+    assert got is not None, "native v4.1 tokenizer should handle this file"
+    coords, tets, cids = got
+
+    ref_coords, ref_tets, ref_cids = mesh_io._parse_gmsh_v4(
+        p.read_text().split("\n")
+    )
+    np.testing.assert_allclose(coords, ref_coords)
+    np.testing.assert_array_equal(tets, ref_tets)
+    np.testing.assert_array_equal(cids, ref_cids)
+    assert list(cids) == [9, 11]
