@@ -151,6 +151,7 @@ class TetMesh:
             volumes = _tet_volumes(coords, tet2vert)
             normals, d = _face_planes(coords, tet2vert)
         tet2tet = build_tet2tet(tet2vert)
+        _check_not_tangled(normals, tet2tet)
 
         nbr_safe = np.maximum(tet2tet, 0)
         nbr_class = np.where(
@@ -252,6 +253,39 @@ def _face_planes(coords: np.ndarray, tet2vert: np.ndarray):
         normals[:, f] = n
         d[:, f] = np.einsum("ij,ij->i", n, a)
     return normals, d
+
+
+def _check_not_tangled(normals: np.ndarray, tet2tet: np.ndarray) -> None:
+    """Reject tangled (overlapping) meshes at load time.
+
+    On a valid mesh, an interior face's two outward unit normals (one per
+    adjacent element, each oriented away from its own opposite vertex)
+    are exact opposites — the elements sit on opposite sides. If both
+    elements end up on the SAME side (positive volumes but spatially
+    overlapping, e.g. a vertex pushed through a face by bad smoothing or
+    deformation), the normals come out PARALLEL instead, and no
+    face-adjacency walk can terminate on such geometry (the position and
+    element assignment cannot agree). Fail loudly here instead — the
+    tangle analog of the non-manifold check in build_tet2tet.
+    """
+    ntet = tet2tet.shape[0]
+    e = np.repeat(np.arange(ntet, dtype=np.int64), 4)
+    f = np.tile(np.arange(4, dtype=np.int64), ntet)
+    nbr = tet2tet.reshape(-1)
+    interior = nbr >= 0
+    e, f, nbr = e[interior], f[interior], nbr[interior]
+    # The back-face index on the neighbor: the face whose neighbor is e.
+    back = np.argmax(tet2tet[nbr] == e[:, None], axis=1)
+    dots = np.einsum("ic,ic->i", normals[e, f], normals[nbr, back])
+    tangled = dots > 0  # valid meshes give exactly ~-1
+    if tangled.any():
+        bad = np.unique(e[tangled])
+        raise ValueError(
+            f"tangled mesh: {bad.size} element(s) overlap a neighbor "
+            f"across a shared face (first few: {bad[:8].tolist()}); "
+            "face-adjacency walks cannot terminate on overlapping "
+            "geometry — fix the mesh (inverted/pushed-through vertices)"
+        )
 
 
 def build_tet2tet(tet2vert: np.ndarray) -> np.ndarray:

@@ -46,15 +46,21 @@ def locate_points(mesh, x, tol):
     return jnp.where(best_val <= tol, best_elem, -1)
 
 
-def exit_face(normals, d, cur, dirv):
+def exit_face(normals, d, cur, dirv, exclude=None):
     """Exit crossing of rays r(t) = cur + t*dirv, t ∈ [0, 1], out of tets
     described by face planes (normals [n,4,3], d [n,4]).
 
     Haines' ray/convex-polyhedron clipping specialized to tets: among faces
     with dot(n_f, dirv) > 0 (the ray is heading out through them), the exit is
     the one with minimal plane parameter t_f. Entry faces (negative
-    denominator) and grazing-parallel faces never qualify, which makes the
-    walk immune to re-crossing the face it just entered through.
+    denominator) and grazing-parallel faces never qualify — but for a ray
+    nearly PARALLEL to a face, the two adjacent elements' independently
+    rounded unit normals can disagree about the sign of dot(n, dirv), which
+    lets the walk bounce A→B→A forever at t≈0 on irregular meshes. The
+    caller breaks those cycles with ``exclude`` [n,4]: faces marked True
+    (typically the face leading back to the element the particle just
+    left — a straight ray can never legitimately re-enter a convex element
+    it exited) are removed from consideration.
 
     Returns (t_exit [n], face [n], has_exit [n] bool). t_exit is clamped to
     [0, inf); has_exit is False when no face is exited (destination inside,
@@ -63,9 +69,26 @@ def exit_face(normals, d, cur, dirv):
     denom = jnp.einsum("pfc,pc->pf", normals, dirv)  # [n,4]
     num = d - jnp.einsum("pfc,pc->pf", normals, cur)  # [n,4]
     inf = jnp.asarray(jnp.inf, dtype=cur.dtype)
-    t = jnp.where(denom > 0, num / jnp.where(denom > 0, denom, 1), inf)
-    t = jnp.maximum(t, 0.0)
+    qualifies = denom > 0
+    t_all = jnp.where(qualifies, num / jnp.where(qualifies, denom, 1), inf)
+    t_all = jnp.maximum(t_all, 0.0)
+    if exclude is not None:
+        t = jnp.where(exclude, inf, t_all)
+    else:
+        t = t_all
     t_exit = jnp.min(t, axis=-1)
     face = jnp.argmin(t, axis=-1).astype(jnp.int32)
     has_exit = jnp.isfinite(t_exit)
+    if exclude is not None:
+        # If the exclusion removed the ONLY qualifying face, fall back to
+        # the unmasked choice rather than stranding the lane (the caller
+        # would otherwise misread "no exit" as destination-reached and
+        # teleport the particle to dest, mis-tallying the remainder).
+        t_exit0 = jnp.min(t_all, axis=-1)
+        stranded = jnp.logical_not(has_exit) & jnp.isfinite(t_exit0)
+        t_exit = jnp.where(stranded, t_exit0, t_exit)
+        face = jnp.where(
+            stranded, jnp.argmin(t_all, axis=-1).astype(jnp.int32), face
+        )
+        has_exit = has_exit | stranded
     return t_exit, face, has_exit

@@ -111,6 +111,50 @@ def first_k_active(active: jax.Array, k: int):
     return idx, n_active
 
 
+def chase_face_choice(sd, elem, it, dtype, interior):
+    """Stochastic visibility-walk face choice for the relocation chase,
+    shared by the single-chip and partitioned walk bodies.
+
+    Picks the face the point violates most, scaled by pseudo-random
+    per-face weights derived from (elem, iteration) so deterministic
+    hop cycles break. Boundary faces are excluded while any interior
+    candidate exists — a mislocated but in-domain particle must not be
+    terminated as a domain exit by a chase hop (boundary planes extend
+    infinitely, so an interior point can violate one numerically).
+    """
+    h = elem * jnp.int32(-1640531527) + it * jnp.int32(40503)
+    wf = 1.0 + (
+        (jnp.right_shift(h[:, None], 2 * jnp.arange(4)) & 3)
+    ).astype(dtype) * 0.125
+    big = jnp.asarray(jnp.finfo(dtype).max, dtype)
+    any_interior = jnp.any(interior, axis=-1, keepdims=True)
+    score = jnp.where(interior | ~any_interior, sd * wf, -big)
+    return jnp.argmax(score, axis=-1).astype(jnp.int32)
+
+
+def escalated_bump(stuck, contained, continuing, t_step, tol_floor,
+                   tol_eff, cur, dnorm, dtype):
+    """Doubling forward bump for zero-progress crossings, shared by both
+    walk bodies: a continuing particle advances at least ~32 ulps of the
+    coordinate per crossing, doubling per consecutive zero-progress
+    crossing (capped at the walk tolerance) so crack/edge degeneracies
+    are escaped in logarithmically many steps. The counter resets as
+    soon as the particle is genuinely contained or makes a real step.
+    Returns (extra_t, stuck_next)."""
+    scale1 = 1.0 + jnp.max(jnp.abs(cur), axis=-1)
+    nudge0 = 4.0 * tol_floor * scale1 / jnp.where(dnorm > 0, dnorm, 1.0)
+    nudge_t = jnp.minimum(
+        nudge0 * jnp.exp2(stuck.astype(dtype)),
+        jnp.maximum(tol_eff, nudge0),
+    )
+    zero_step = continuing & (t_step < nudge0) & ~contained
+    stuck_next = jnp.where(
+        zero_step, jnp.minimum(stuck + 1, 48), jnp.int32(0)
+    )
+    extra = jnp.maximum(nudge_t - t_step, 0.0)
+    return extra, stuck_next
+
+
 class TraceResult(NamedTuple):
     """Outputs of one fused trace step.
 
@@ -304,21 +348,59 @@ def trace_impl(
 
         def body(carry):
             if record_xpoints is None:
-                cur, elem, done, mat, flux, nseg, it = carry
+                cur, elem, done, mat, flux, nseg, prev, stuck, it = carry
             else:
-                cur, elem, done, mat, flux, nseg, xp, kx, it = carry
+                (cur, elem, done, mat, flux, nseg, prev, stuck, xp, kx,
+                 it) = carry
             active = jnp.logical_not(done)
 
-            dirv = dest_a - cur
             if packed:
                 # ONE gather: normals + plane offsets + bitcast topo codes.
                 geo = mesh.geo20[elem]  # [m, 20]
                 normals = geo[:, :12].reshape(-1, 4, 3)
                 dplane = geo[:, 12:16]
+                codes = jax.lax.bitcast_convert_type(
+                    geo[:, 16:20], code_int
+                ).astype(jnp.int32)  # [m, 4]
+                nbrs_all = (codes & 0xFFFFFF) - 1
             else:
                 normals = mesh.face_normals[elem]
                 dplane = mesh.face_d[elem]
-            t_exit, face, has_exit = exit_face(normals, dplane, cur, dirv)
+                nbrs_all = mesh.tet2tet[elem]  # [m, 4]
+
+            dirv = dest_a - cur
+            # Never step back through the face we just entered: a straight
+            # ray cannot re-enter a convex element it exited, and masking
+            # that face breaks the t≈0 two-element cycles grazing rays
+            # otherwise fall into on irregular meshes (see exit_face).
+            backward = (prev[:, None] >= 0) & (nbrs_all == prev[:, None])
+            t_exit, face, has_exit = exit_face(
+                normals, dplane, cur, dirv, exclude=backward
+            )
+
+            # Relocation chase for stuck lanes. Near a grazing corner the
+            # rounded min-t exit choice can hop the particle into an
+            # element that does NOT contain the onward ray; the resulting
+            # t=0 ejection cascade can cycle instead of converging, with
+            # the position and the element assignment macroscopically
+            # diverged. After 4 consecutive zero-progress crossings in a
+            # NON-containing element, switch the lane to a stochastic
+            # visibility walk (chase_face_choice): hop toward the point
+            # without moving or scoring anything until containment is
+            # restored, then resume the normal walk (the stuck counter
+            # resets on containment). The same recovery class the
+            # reference's tracer leaves to "not all particles found"
+            # printf truncation (cpp:765-768) — here it repairs instead
+            # of giving up.
+            sd = jnp.einsum("pfc,pc->pf", normals, cur) - dplane
+            contained = jnp.max(sd, axis=-1) <= 0.0
+            chase = active & (stuck >= 4) & ~contained
+            chase_face = chase_face_choice(
+                sd, elem, it, dtype, nbrs_all >= 0
+            )
+            face = jnp.where(chase, chase_face, face)
+            t_exit = jnp.where(chase, 0.0, t_exit)
+            has_exit = has_exit | chase
 
             # Geometric tolerance → ray-parameter space (normals are unit,
             # so geometric distance = t × |dirv|), floored at a few ulps.
@@ -360,27 +442,24 @@ def trace_impl(
             crossed = active & ~reached & has_exit
             if record_xpoints is not None:
                 # Genuine boundary crossings only (a lane that reaches its
-                # destination inside the current element records nothing).
-                # Non-crossing lanes row-index OOB (dropped); lanes past K
-                # crossings column-index OOB (dropped).
+                # destination inside the current element records nothing,
+                # and relocation-chase hops are bookkeeping, not
+                # crossings). Non-crossing lanes row-index OOB (dropped);
+                # lanes past K crossings column-index OOB (dropped).
+                real_cross = crossed & ~chase
                 rows = jnp.where(
-                    crossed, jnp.arange(xp.shape[0], dtype=jnp.int32),
+                    real_cross, jnp.arange(xp.shape[0], dtype=jnp.int32),
                     jnp.int32(xp.shape[0]),
                 )
                 xp = xp.at[rows, kx].set(xpoint, mode="drop")
-                kx = kx + crossed.astype(kx.dtype)
+                kx = kx + real_cross.astype(kx.dtype)
             if packed:
                 # Topology came along in the geo20 row: select the exit
-                # face's code locally (no second table gather) and bitcast
-                # the float bits back to int.
-                code_f = jnp.take_along_axis(
-                    geo[:, 16:20], face[:, None], axis=1
+                # face's code locally (no second table gather).
+                code = jnp.take_along_axis(
+                    codes, face[:, None], axis=1
                 )[:, 0]
-                code = jax.lax.bitcast_convert_type(code_f, code_int)
-                code = code.astype(jnp.int32)
-                nbr = (code & 0xFFFFFF) - 1
-            else:
-                nbr = mesh.tet2tet[elem, face]
+            nbr = jnp.take_along_axis(nbrs_all, face[:, None], axis=1)[:, 0]
             next_elem = jnp.where(crossed, nbr, jnp.int32(-1))
 
             if debug_checks:
@@ -398,7 +477,9 @@ def trace_impl(
             # --- tally (skipped on the initial location search) -----------
             if not initial:
                 seg = t_step * dnorm  # |xpoint - cur|
-                score = active & in_flight_a
+                # Chase hops are bookkeeping (zero length): keep them out
+                # of the segment count the benchmarks report.
+                score = active & in_flight_a & ~chase
                 contrib = jnp.where(score, seg * weight_a, 0.0).astype(dtype)
                 # Flat (elem, group) key; non-scoring rows get the OOB
                 # sentinel and drop — the functional analog of the
@@ -444,6 +525,9 @@ def trace_impl(
                         & (next_elem >= 0)
                         & (nbr_class != mesh.class_id[elem])
                     )
+                # A relocation-chase hop is bookkeeping, not a physical
+                # crossing: it must not trigger a material stop.
+                material_stop = material_stop & ~chase
             newly_done = (active & reached) | domain_exit | material_stop
 
             if not initial:
@@ -459,12 +543,26 @@ def trace_impl(
 
             # --- hop (move_to_next_element hops even freshly-done
             # material-stop particles, cpp:440-450) -------------------------
-            elem = jnp.where(crossed & (next_elem != -1), next_elem, elem)
+            hopped = crossed & (next_elem != -1)
+            prev = jnp.where(hopped, elem, prev)
+            elem = jnp.where(hopped, next_elem, elem)
             cur = jnp.where(active[:, None], xpoint, cur)
+            # Degeneracy bump (escalated_bump): crack/edge t≈0 cycles the
+            # entry-face mask cannot break are escaped by guaranteed
+            # forward progress per crossing.
+            continuing = crossed & ~newly_done
+            extra, stuck = escalated_bump(
+                stuck, contained, continuing, t_step, tol_floor, tol_eff,
+                cur, dnorm, dtype,
+            )
+            cur = jnp.where(
+                continuing[:, None], cur + extra[:, None] * dirv, cur
+            )
             done = done | newly_done
             if record_xpoints is None:
-                return cur, elem, done, mat, flux, nseg, it + 1
-            return cur, elem, done, mat, flux, nseg, xp, kx, it + 1
+                return cur, elem, done, mat, flux, nseg, prev, stuck, it + 1
+            return (cur, elem, done, mat, flux, nseg, prev, stuck, xp, kx,
+                    it + 1)
 
         return body
 
@@ -507,17 +605,20 @@ def trace_impl(
         max_crossings if compact_stages is None
         else min(compact_stages[0][0], max_crossings)
     )
-    carry = (origin, elem, done0, mat0, flux, nseg0, jnp.int32(0))
+    prev0 = elem * 0 - 1  # device-varying -1: no entry face yet
+    stuck0 = elem * 0  # consecutive zero-progress crossings per lane
+    carry = (
+        origin, elem, done0, mat0, flux, nseg0, prev0, stuck0, jnp.int32(0)
+    )
     xp = kx = None
     if record_xpoints is not None:
         xp0 = jnp.zeros((n, int(record_xpoints), 3), dtype)
         kx0 = elem * 0  # per-lane zero (device-varying under shard_map)
         carry = carry[:-1] + (xp0, kx0, jnp.int32(0))
-        cur, elem, done, mat, flux, nseg, xp, kx, it = run_phase(
-            full_body, carry, phase1_bound
-        )
+        (cur, elem, done, mat, flux, nseg, prev, stuck, xp, kx,
+         it) = run_phase(full_body, carry, phase1_bound)
     else:
-        cur, elem, done, mat, flux, nseg, it = run_phase(
+        cur, elem, done, mat, flux, nseg, prev, stuck, it = run_phase(
             full_body, carry, phase1_bound
         )
 
@@ -530,7 +631,7 @@ def trace_impl(
         selection, far cheaper than a 1M-lane sort. Slots past the number
         of active lanes gather clamped garbage; they are neutralized by
         forcing their done flag and dropping their write-back rows."""
-        cur, elem, done, mat, flux, nseg, it = state
+        cur, elem, done, mat, flux, nseg, prev, stuck, it = state
         active = jnp.logical_not(done)
         idx, n_active = first_k_active(active, S)
         valid = jnp.arange(S) < n_active
@@ -542,9 +643,9 @@ def trace_impl(
         )
         sub_carry = (
             cur[idx], elem[idx], jnp.logical_not(valid), mat[idx],
-            flux, nseg, jnp.int32(0),
+            flux, nseg, prev[idx], stuck[idx], jnp.int32(0),
         )
-        scur, selem, sdone, smat, flux, nseg, sit = run_phase(
+        scur, selem, sdone, smat, flux, nseg, sprev, sstuck, sit = run_phase(
             sub_body, sub_carry, bound
         )
         idx_sb = jnp.where(valid, idx, n)
@@ -552,10 +653,12 @@ def trace_impl(
         elem = elem.at[idx_sb].set(selem, mode="drop")
         done = done.at[idx_sb].set(sdone, mode="drop")
         mat = mat.at[idx_sb].set(smat, mode="drop")
-        return cur, elem, done, mat, flux, nseg, it + sit
+        prev = prev.at[idx_sb].set(sprev, mode="drop")
+        stuck = stuck.at[idx_sb].set(sstuck, mode="drop")
+        return cur, elem, done, mat, flux, nseg, prev, stuck, it + sit
 
     if compact_stages is not None and phase1_bound < max_crossings:
-        state = (cur, elem, done, mat, flux, nseg, it)
+        state = (cur, elem, done, mat, flux, nseg, prev, stuck, it)
         for i, (start, size) in enumerate(compact_stages):
             S = min(n, max(int(size), 1))
             if i + 1 < len(compact_stages):
@@ -590,7 +693,7 @@ def trace_impl(
                     outer_cond, outer_body, (*state, jnp.int32(0))
                 )
                 state = tuple(state)
-        cur, elem, done, mat, flux, nseg, it = state
+        cur, elem, done, mat, flux, nseg, prev, stuck, it = state
 
     if packed:
         # Resolve material codes to real class_id values (one tiny-table

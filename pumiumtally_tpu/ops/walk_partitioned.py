@@ -65,7 +65,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..parallel.mesh_partition import MeshPartition
 from ..parallel.particle_sharding import PARTICLE_AXIS as AXIS
 from .geometry import exit_face
-from .walk import first_k_active
+from .walk import chase_face_choice, escalated_bump, first_k_active
 
 
 class PartitionedTraceResult(NamedTuple):
@@ -114,16 +114,40 @@ def _walk_phase(
     dtype = cur.dtype
     n_groups = flux.shape[1]
     cap = cur.shape[0]
+    tol_floor = 8 * float(jnp.finfo(dtype).eps)
 
     def make_body(dest_a, weight_a, group_a, valid_a):
         def body(carry):
-            cur, elem, done, target, target_elem, material_id, flux, nseg, it = carry
+            (cur, elem, done, target, target_elem, material_id, flux,
+             nseg, prev, stuck, it) = carry
             active = valid_a & ~done & (target < 0)
 
             dirv = dest_a - cur
             normals = normals_t[elem]
             dplane = faced_t[elem]
-            t_exit, face, has_exit = exit_face(normals, dplane, cur, dirv)
+            enc_row = enc_t[elem]  # [m, 4] encoded neighbors
+            # Robustness trio shared with ops/walk.py (see its comments):
+            # (1) never step back through the entry face — a straight ray
+            # cannot re-enter a convex element it exited;
+            backward = (prev[:, None] >= 0) & (enc_row == prev[:, None])
+            t_exit, face, has_exit = exit_face(
+                normals, dplane, cur, dirv, exclude=backward
+            )
+            # (2) relocation chase after 4 zero-progress crossings in a
+            # non-containing element (chase_face_choice, shared with
+            # walk.py): hop toward the point; resumes the normal walk
+            # once contained. Remote faces count as interior candidates —
+            # chasing across a partition cut correctly migrates the lane
+            # to the neighbor chip.
+            sd = jnp.einsum("pfc,pc->pf", normals, cur) - dplane
+            contained = jnp.max(sd, axis=-1) <= 0.0
+            chase = active & (stuck >= 4) & ~contained
+            chase_face = chase_face_choice(
+                sd, elem, it, dtype, enc_row != -1
+            )
+            face = jnp.where(chase, chase_face, face)
+            t_exit = jnp.where(chase, 0.0, t_exit)
+            has_exit = has_exit | chase
 
             # Geometric tolerance → ray-parameter space with an ulp floor,
             # matching ops/walk.py exactly so the partitioned and
@@ -131,7 +155,7 @@ def _walk_phase(
             dnorm = jnp.linalg.norm(dirv, axis=-1)
             tol_eff = jnp.maximum(
                 tolerance / jnp.where(dnorm > 0, dnorm, 1.0),
-                8 * float(jnp.finfo(dtype).eps),
+                tol_floor,
             ).astype(dtype)
             reached = jnp.logical_or(
                 t_exit >= 1.0 - tol_eff, jnp.logical_not(has_exit)
@@ -140,15 +164,22 @@ def _walk_phase(
             xpoint = cur + t_step[:, None] * dirv
 
             crossed = active & ~reached & has_exit
-            enc = jnp.where(crossed, enc_t[elem, face], jnp.int32(-1))
+            enc = jnp.where(
+                crossed,
+                jnp.take_along_axis(enc_row, face[:, None], axis=1)[:, 0],
+                jnp.int32(-1),
+            )
             domain_exit = crossed & (enc == -1)
             remote = crossed & (enc < -1)
             local_hop = crossed & (enc >= 0)
 
             if not initial:
                 seg = jnp.linalg.norm(xpoint - cur, axis=-1)
-                contrib = jnp.where(active, seg * weight_a, 0.0).astype(dtype)
-                scat_elem = jnp.where(active, elem, max_local)
+                # Chase hops are bookkeeping (zero length): keep them out
+                # of the tally rows and the segment count.
+                score = active & ~chase
+                contrib = jnp.where(score, seg * weight_a, 0.0).astype(dtype)
+                scat_elem = jnp.where(score, elem, max_local)
                 scat_group = jnp.where(group_a < 0, n_groups, group_a)
                 flux = flux.at[scat_elem, scat_group, 0].add(
                     contrib, mode="drop"
@@ -157,7 +188,7 @@ def _walk_phase(
                     flux = flux.at[scat_elem, scat_group, 1].add(
                         contrib * contrib, mode="drop"
                     )
-                nseg = nseg + jnp.sum(active).astype(nseg.dtype)
+                nseg = nseg + jnp.sum(score).astype(nseg.dtype)
 
             nclass = nbrclass_t[elem, face]
             if initial:
@@ -166,6 +197,9 @@ def _walk_phase(
                 material_stop = (
                     crossed & (enc != -1) & (nclass != class_t[elem])
                 )
+                # A relocation-chase hop is bookkeeping, not a physical
+                # crossing: it must not trigger a material stop.
+                material_stop = material_stop & ~chase
             newly_done = (active & reached) | domain_exit | material_stop
             if not initial:
                 material_id = jnp.where(
@@ -185,11 +219,22 @@ def _walk_phase(
             target = jnp.where(remote, code // max_local, target)
             target_elem = jnp.where(remote, code % max_local, target_elem)
 
+            prev = jnp.where(local_hop, elem, prev)
             elem = jnp.where(local_hop, enc, elem)
             cur = jnp.where(active[:, None], xpoint, cur)
+            # (3) degeneracy bump (escalated_bump, shared with walk.py):
+            # guaranteed forward progress per continuing crossing.
+            continuing = local_hop & ~newly_done
+            extra, stuck = escalated_bump(
+                stuck, contained, continuing, t_step, tol_floor, tol_eff,
+                cur, dnorm, dtype,
+            )
+            cur = jnp.where(
+                continuing[:, None], cur + extra[:, None] * dirv, cur
+            )
             done = done | newly_done
             return (cur, elem, done, target, target_elem, material_id,
-                    flux, nseg, it + 1)
+                    flux, nseg, prev, stuck, it + 1)
 
         return body
 
@@ -214,9 +259,11 @@ def _walk_phase(
         max_crossings if compact_after is None
         else min(compact_after, max_crossings)
     )
+    # prev/stuck are phase-local: every active lane at phase start is
+    # either fresh (immigrant) or resuming after the bound; -1/0 is safe.
     carry = (
         cur, elem, done, target, target_elem, material_id, flux, nseg,
-        jnp.int32(0),
+        elem * 0 - 1, elem * 0, jnp.int32(0),
     )
     carry = run(full_body, valid, carry, phase1_bound)
 
@@ -229,7 +276,7 @@ def _walk_phase(
             """Gather the first S active lanes, advance them until done or
             pending, scatter back (first_k_active, shared with walk.py)."""
             (cur, elem, done, target, target_elem, material_id, flux,
-             nseg, it) = state
+             nseg, prev, stuck, it) = state
             active = valid & ~done & (target < 0)
             idx, n_active = first_k_active(active, S)
             sub_ok = jnp.arange(S) < n_active
@@ -239,11 +286,10 @@ def _walk_phase(
             sub_carry = (
                 cur[idx], elem[idx], jnp.logical_not(sub_ok), target[idx],
                 target_elem[idx], material_id[idx], flux, nseg,
-                jnp.int32(0),
+                prev[idx], stuck[idx], jnp.int32(0),
             )
-            (scur, selem, sdone, star, stare, smat, flux, nseg, sit) = run(
-                sub_body, sub_ok, sub_carry, max_crossings
-            )
+            (scur, selem, sdone, star, stare, smat, flux, nseg, sprev,
+             sstuck, sit) = run(sub_body, sub_ok, sub_carry, max_crossings)
             idx_sb = jnp.where(sub_ok, idx, cap)
             cur = cur.at[idx_sb].set(scur, mode="drop")
             elem = elem.at[idx_sb].set(selem, mode="drop")
@@ -251,8 +297,10 @@ def _walk_phase(
             target = target.at[idx_sb].set(star, mode="drop")
             target_elem = target_elem.at[idx_sb].set(stare, mode="drop")
             material_id = material_id.at[idx_sb].set(smat, mode="drop")
+            prev = prev.at[idx_sb].set(sprev, mode="drop")
+            stuck = stuck.at[idx_sb].set(sstuck, mode="drop")
             return (cur, elem, done, target, target_elem, material_id,
-                    flux, nseg, it + sit)
+                    flux, nseg, prev, stuck, it + sit)
 
         # Each round retires >= S active lanes (to done or pending) or all
         # of them, so ceil(cap/S)+1 rounds always suffice.
@@ -273,7 +321,8 @@ def _walk_phase(
         )
         carry = tuple(carry)
 
-    return carry[:-1]
+    # Strip the phase-local (prev, stuck, it) tail.
+    return carry[:-3]
 
 
 def make_partitioned_step(
