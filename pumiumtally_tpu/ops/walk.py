@@ -544,7 +544,13 @@ def trace_impl(
             # --- hop (move_to_next_element hops even freshly-done
             # material-stop particles, cpp:440-450) -------------------------
             hopped = crossed & (next_elem != -1)
-            prev = jnp.where(hopped, elem, prev)
+            # The entry-face mask rests on ray convexity, which only
+            # holds for REAL crossings: a chase hop must clear prev, not
+            # set it, or it could mask the ray's true exit from the new
+            # element.
+            prev = jnp.where(
+                hopped, jnp.where(chase, jnp.int32(-1), elem), prev
+            )
             elem = jnp.where(hopped, next_elem, elem)
             cur = jnp.where(active[:, None], xpoint, cur)
             # Degeneracy bump (escalated_bump): crack/edge t≈0 cycles the

@@ -219,7 +219,11 @@ def _walk_phase(
             target = jnp.where(remote, code // max_local, target)
             target_elem = jnp.where(remote, code % max_local, target_elem)
 
-            prev = jnp.where(local_hop, elem, prev)
+            # Chase hops clear prev (the convexity argument behind the
+            # entry-face mask applies to real crossings only, walk.py).
+            prev = jnp.where(
+                local_hop, jnp.where(chase, jnp.int32(-1), elem), prev
+            )
             elem = jnp.where(local_hop, enc, elem)
             cur = jnp.where(active[:, None], xpoint, cur)
             # (3) degeneracy bump (escalated_bump, shared with walk.py):

@@ -110,3 +110,46 @@ def test_tangled_mesh_rejected_at_build():
     walked forever: no face-adjacency walk can terminate on it."""
     with pytest.raises(ValueError, match="tangled"):
         _jittered_mesh(6, 0.35, seed=11, dtype=jnp.float64)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_fuzz_walk_termination_and_conservation(seed):
+    """Fuzz: random jittered meshes × random + adversarial rays
+    (axis-aligned, face-grazing, corner-aimed) must always terminate and
+    conserve track length in f32 — the dtype where degeneracies bite."""
+    rng = np.random.default_rng(100 + seed)
+    nx = int(rng.integers(3, 7))
+    jitter = float(rng.uniform(0.05, 0.25))
+    mesh = _jittered_mesh(nx, jitter, seed=200 + seed, dtype=jnp.float32)
+    n = 384
+    elem = jnp.asarray(rng.integers(0, mesh.ntet, n).astype(np.int32))
+    origin = np.asarray(mesh.centroids())[np.asarray(elem)]
+    dest = rng.uniform(0.02, 0.98, (n, 3))
+    # Adversarial destinations: axis-aligned rays (graze structured
+    # faces), rays aimed at mesh vertices (corner crossings), and
+    # destinations just outside the domain (boundary clips).
+    dest[:96, 1:] = origin[:96, 1:]          # pure-x rays
+    verts = np.asarray(mesh.coords)
+    vidx = rng.integers(0, verts.shape[0], 96)
+    dest[96:192] = verts[vidx] + rng.normal(0, 1e-7, (96, 3))
+    dest[192:288] = rng.uniform(1.0, 1.1, (96, 3))  # outside
+    r = trace_impl(
+        mesh,
+        jnp.asarray(origin, jnp.float32),
+        jnp.asarray(dest, jnp.float32),
+        elem,
+        jnp.ones(n, bool),
+        jnp.ones(n, jnp.float32),
+        jnp.zeros(n, jnp.int32),
+        jnp.full(n, -1, jnp.int32),
+        make_flux(mesh.ntet, 1, jnp.float32),
+        initial=False, max_crossings=mesh.ntet + 8, tolerance=1e-6,
+    )
+    assert bool(np.asarray(r.done).all()), (
+        f"walk truncated (nx={nx}, jitter={jitter:.3f})"
+    )
+    path = np.linalg.norm(
+        np.asarray(r.position) - origin, axis=1
+    ).sum()
+    tallied = float(np.asarray(r.flux)[..., 0].sum())
+    assert tallied == pytest.approx(path, abs=max(5e-4, 1e-5 * path))
