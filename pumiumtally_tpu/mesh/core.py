@@ -282,7 +282,9 @@ def _check_not_tangled(normals: np.ndarray, tet2tet: np.ndarray) -> None:
     dots = np.einsum("ic,ic->i", normals[e, f], normals[nbr, back])
     tangled = dots > 0  # valid meshes give exactly ~-1
     if tangled.any():
-        bad = np.unique(e[tangled])
+        # Each face was visited once (nbr > e); report BOTH elements of
+        # every overlapping pair in the diagnostic.
+        bad = np.unique(np.concatenate([e[tangled], nbr[tangled]]))
         raise ValueError(
             f"tangled mesh: {bad.size} element(s) overlap a neighbor "
             f"across a shared face (first few: {bad[:8].tolist()}); "

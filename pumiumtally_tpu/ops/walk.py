@@ -59,6 +59,32 @@ two separate scatters and 3.6× cheaper than a 2-wide window scatter
 (scripts/microbench_complex_scatter.py; complex64 packing is
 unimplemented on this TPU backend).
 
+Degeneracy robustness
+---------------------
+Grazing rays on irregular meshes hit three numerical failure modes that
+per-thread CUDA walkers usually paper over with ad-hoc epsilons (and the
+reference's tracer reports as "Not all particles are found",
+cpp:765-768). This walk handles them structurally, at ~zero hot-path
+cost (all elementwise, no extra gathers):
+
+  * entry-face mask — a straight ray can never re-enter a convex element
+    it exited, so the face leading back to `prev` is excluded from exit
+    candidates (kills A↔B t=0 ping-pong where the two elements' rounded
+    planes disagree about a near-parallel ray), with a fallback when the
+    mask would strand the lane (exit_face);
+  * relocation chase — when an element stops containing its particle
+    (corner mis-hop) for 4 consecutive zero-progress crossings, the lane
+    switches to a stochastic visibility walk toward the point
+    (chase_face_choice), scoring and recording nothing, until
+    containment is restored;
+  * escalated bump — continuing lanes always advance by >= ~32 ulps,
+    doubling per consecutive zero-progress crossing up to the walk
+    tolerance, so crack/edge t=0 stalls terminate in logarithmically
+    many steps (escalated_bump).
+
+Meshes with genuinely overlapping elements are impossible to walk and
+are rejected at build time (mesh/core.py:_check_not_tangled).
+
 Straggler compaction
 --------------------
 Crossing counts are long-tailed (a few particles cross 10x more elements
@@ -148,8 +174,15 @@ def escalated_bump(stuck, contained, continuing, t_step, tol_floor,
         jnp.maximum(tol_eff, nudge0),
     )
     zero_step = continuing & (t_step < nudge0) & ~contained
+    # Reset only on REAL progress; lanes that did not continue this
+    # iteration (done, reached, or frozen for migration) keep their
+    # count — the partitioned exchange reads stuck>=4 to know a lane
+    # froze mid-chase and must not carry an entry-face mask across the
+    # cut (the convexity argument covers real crossings only).
     stuck_next = jnp.where(
-        zero_step, jnp.minimum(stuck + 1, 48), jnp.int32(0)
+        zero_step,
+        jnp.minimum(stuck + 1, 48),
+        jnp.where(continuing, jnp.int32(0), stuck),
     )
     extra = jnp.maximum(nudge_t - t_step, 0.0)
     return extra, stuck_next

@@ -99,11 +99,17 @@ class PartitionedTraceResult(NamedTuple):
 
 def _walk_phase(
     tables, cur, dest, elem, done, target, target_elem, material_id,
-    weight, group, flux, nseg, valid,
+    weight, group, flux, nseg, valid, prev, stuck,
     *, initial, tolerance, score_squares, max_crossings, max_local,
     unroll=1, compact_after=None, compact_size=None,
 ):
     """Advance every resident particle until done or pending-migration.
+
+    ``prev`` holds the ENC-encoded element the particle last hopped out
+    of (local id >= 0, remote code < -1 set by the exchange for
+    immigrants, or -1 for none) so the entry-face mask works across
+    partition cuts too; ``stuck`` is the zero-progress counter driving
+    the chase/bump recovery (ops/walk.py).
 
     With ``compact_after`` set, lanes still active after that many
     crossings are compacted into ``compact_size``-lane subsets which loop
@@ -128,8 +134,11 @@ def _walk_phase(
             enc_row = enc_t[elem]  # [m, 4] encoded neighbors
             # Robustness trio shared with ops/walk.py (see its comments):
             # (1) never step back through the entry face — a straight ray
-            # cannot re-enter a convex element it exited;
-            backward = (prev[:, None] >= 0) & (enc_row == prev[:, None])
+            # cannot re-enter a convex element it exited. prev is
+            # ENC-encoded (local id >= 0 or remote code < -1), so the
+            # equality also masks the face back across a partition cut
+            # for freshly migrated particles.
+            backward = (prev[:, None] != -1) & (enc_row == prev[:, None])
             t_exit, face, has_exit = exit_face(
                 normals, dplane, cur, dirv, exclude=backward
             )
@@ -263,11 +272,9 @@ def _walk_phase(
         max_crossings if compact_after is None
         else min(compact_after, max_crossings)
     )
-    # prev/stuck are phase-local: every active lane at phase start is
-    # either fresh (immigrant) or resuming after the bound; -1/0 is safe.
     carry = (
         cur, elem, done, target, target_elem, material_id, flux, nseg,
-        elem * 0 - 1, elem * 0, jnp.int32(0),
+        prev, stuck, jnp.int32(0),
     )
     carry = run(full_body, valid, carry, phase1_bound)
 
@@ -325,8 +332,8 @@ def _walk_phase(
         )
         carry = tuple(carry)
 
-    # Strip the phase-local (prev, stuck, it) tail.
-    return carry[:-3]
+    # Strip the loop counter; prev/stuck return to the caller's carry.
+    return carry[:-1]
 
 
 def make_partitioned_step(
@@ -419,9 +426,12 @@ def make_partitioned_step(
             compact_size=compact_size,
         )
 
+        me = jax.lax.axis_index(AXIS)
+
         def exchange(carry):
             (cur, dest, elem, done, target, target_elem, material_id,
-             weight, group, pid, valid, flux_l, nseg, dropped) = carry
+             weight, group, pid, valid, prev, stuck, flux_l, nseg,
+             dropped) = carry
             emig = valid & (target >= 0)
 
             # Bucket emigrants by destination chip: a stable sort on the
@@ -447,6 +457,18 @@ def make_partitioned_step(
             pay_f = fill(
                 jnp.concatenate([cur, dest, weight[:, None]], axis=1)
             )  # [n_parts*E, 7]
+            # Entry-face identity for the receiver: the face by which
+            # the migrated particle enters its new element points back at
+            # (this chip, this element), which the receiver's adjacency
+            # encodes as -2 - (me*max_local + elem) — send it so the
+            # entry-face mask keeps working across the partition cut.
+            # EXCEPT for lanes that froze mid-chase (stuck >= 4): a chase
+            # hop is a relocation, not a real crossing, so the convexity
+            # mask must not apply — send "no entry face" instead,
+            # mirroring the chase prev-clear in the local bodies.
+            back_code = jnp.where(
+                stuck >= 4, jnp.int32(-1), -2 - (me * max_local + elem)
+            )
             pay_i = fill(
                 jnp.stack(
                     [
@@ -456,10 +478,11 @@ def make_partitioned_step(
                         target_elem,
                         valid.astype(jnp.int32),  # occupied marker
                         done.astype(jnp.int32),
+                        back_code,
                     ],
                     axis=1,
                 )
-            )  # [n_parts*E, 6]
+            )  # [n_parts*E, 7]
 
             # Sent slots free up.
             sent_src = jnp.where(sendable, order, cap)
@@ -472,8 +495,8 @@ def make_partitioned_step(
                 pay_f.reshape(n_parts, E, 7), AXIS, 0, 0, tiled=False
             ).reshape(n_parts * E, 7)
             g_i = jax.lax.all_to_all(
-                pay_i.reshape(n_parts, E, 6), AXIS, 0, 0, tiled=False
-            ).reshape(n_parts * E, 6)
+                pay_i.reshape(n_parts, E, 7), AXIS, 0, 0, tiled=False
+            ).reshape(n_parts * E, 7)
             mine = g_i[:, 4] == 1  # occupied rows (all addressed to me)
 
             # Place my immigrants into free slots: immigrants first among
@@ -509,26 +532,31 @@ def make_partitioned_step(
             material_id = place(material_id, g_i[src, 2])
             elem = place(elem, g_i[src, 3])
             done = place(done, g_i[src, 5].astype(bool))
+            prev = place(prev, g_i[src, 6])
+            stuck = place(stuck, jnp.zeros_like(stuck[dst]))
             valid = place(valid, take)
             return (cur, dest, elem, done, target, target_elem, material_id,
-                    weight, group, pid, valid, flux_l, nseg, dropped)
+                    weight, group, pid, valid, prev, stuck, flux_l, nseg,
+                    dropped)
 
         def run_walk(carry):
             (cur, dest, elem, done, target, target_elem, material_id,
-             weight, group, pid, valid, flux_l, nseg, dropped) = carry
-            cur, elem, done, target, target_elem, material_id, flux_l, nseg = (
-                walk(
-                    tables_l, cur, dest, elem, done, target, target_elem,
-                    material_id, weight, group, flux_l, nseg, valid,
-                )
+             weight, group, pid, valid, prev, stuck, flux_l, nseg,
+             dropped) = carry
+            (cur, elem, done, target, target_elem, material_id, flux_l,
+             nseg, prev, stuck) = walk(
+                tables_l, cur, dest, elem, done, target, target_elem,
+                material_id, weight, group, flux_l, nseg, valid, prev,
+                stuck,
             )
             return (cur, dest, elem, done, target, target_elem, material_id,
-                    weight, group, pid, valid, flux_l, nseg, dropped)
+                    weight, group, pid, valid, prev, stuck, flux_l, nseg,
+                    dropped)
 
         carry = (
             cur, dest, elem, done, target0, vzero * 0,
-            material_id, weight, group, pid, valid, flux_l, nseg0,
-            nseg0 * 0,
+            material_id, weight, group, pid, valid, target0 + 0, vzero * 0,
+            flux_l, nseg0, nseg0 * 0,
         )
         carry = run_walk(carry)
 
@@ -550,7 +578,8 @@ def make_partitioned_step(
             round_cond, round_body, (carry, nseg0 * 0)
         )
         (cur, dest, elem, done, target, target_elem, material_id,
-         weight, group, pid, valid, flux_l, nseg, dropped) = carry
+         weight, group, pid, valid, prev, stuck, flux_l, nseg,
+         dropped) = carry
 
         return PartitionedTraceResult(
             position=cur,

