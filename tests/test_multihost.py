@@ -27,7 +27,7 @@ WORKER = textwrap.dedent(
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_enable_x64", True)
 
-    coord, pid = sys.argv[1], int(sys.argv[2])
+    coord, pid, outdir = sys.argv[1], int(sys.argv[2]), sys.argv[3]
     from pumiumtally_tpu.parallel.multihost import init_distributed
     assert init_distributed(coord, 2, pid)
     import numpy as np
@@ -70,6 +70,16 @@ WORKER = textwrap.dedent(
     assert np.allclose(total, total_host, rtol=0, atol=1e-12), (
         "in-program all-reduce disagrees with host-gather fallback"
     )
+    # Parallel VTK: each process writes its own piece; rank 0 the index
+    # (the Omega_h vtk::write_parallel analog).
+    from pumiumtally_tpu.core.tally import normalize_flux
+    from pumiumtally_tpu.parallel.multihost import write_parallel_vtk
+    norm = np.asarray(
+        normalize_flux(jnp.asarray(total), mesh.volumes, N, 1)
+    )
+    import os
+    piece = write_parallel_vtk(os.path.join(outdir, "flux"), mesh, norm)
+    assert os.path.getsize(piece) > 100
     print("RESULT", pid, float(np.asarray(total)[..., 0].sum()), count)
     """
 )
@@ -86,7 +96,7 @@ def test_two_process_allreduce(tmp_path):
     coord = f"127.0.0.1:{port}"
     procs = [
         subprocess.Popen(
-            [sys.executable, "-c", WORKER, coord, str(i)],
+            [sys.executable, "-c", WORKER, coord, str(i), str(tmp_path)],
             stdout=subprocess.PIPE,
             stderr=subprocess.PIPE,
             text=True,
@@ -128,6 +138,12 @@ def test_two_process_allreduce(tmp_path):
     # Both processes computed disjoint halves; the allreduced total must
     # agree across processes.
     assert results[0] == pytest.approx(results[1], rel=1e-10)
+    # Parallel VTK: one piece per process plus the rank-0 PVTU index.
+    import os
+    assert (tmp_path / "flux_p0000.vtu").exists()
+    assert (tmp_path / "flux_p0001.vtu").exists()
+    index = (tmp_path / "flux.pvtu").read_text()
+    assert "flux_p0000.vtu" in index and "flux_p0001.vtu" in index
 
     # And equal the single-process full-batch walk.
     import jax.numpy as jnp
