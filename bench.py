@@ -36,6 +36,9 @@ def run(
     compact_size: int | None = None,
     compact_stages: tuple | str | None = "default",
     unroll: int = 8,
+    robust: bool = True,
+    tally_scatter: str = "interleaved",
+    gathers: str = "merged",
 ) -> dict:
     import jax
 
@@ -102,6 +105,9 @@ def run(
             compact_size=compact_size,
             compact_stages=compact_stages,
             unroll=unroll,
+            robust=robust,
+            tally_scatter=tally_scatter,
+            gathers=gathers,
         )
         return r.position, r.elem, r.flux, r.n_segments, r.n_crossings
 
@@ -169,6 +175,9 @@ def run(
             "mesh_build_s": round(build_s, 2),
             "compile_s": round(compile_s, 2),
             "device": str(jax.devices()[0]),
+            "robust": robust,
+            "tally_scatter": tally_scatter,
+            "gathers": gathers,
             "last_step_crossing_iters": int(np.asarray(ncross)),
             **event,
         },
@@ -405,6 +414,9 @@ def main() -> None:
         ),
         compact_stages=_stages_from_env(),
         unroll=int(os.environ.get("BENCH_UNROLL", "8")),
+        robust=os.environ.get("BENCH_ROBUST", "1") == "1",
+        tally_scatter=os.environ.get("BENCH_SCATTER", "interleaved"),
+        gathers=os.environ.get("BENCH_GATHERS", "merged"),
     )
     print(
         f"[bench] {result['detail']}", file=sys.stderr

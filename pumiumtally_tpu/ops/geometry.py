@@ -46,7 +46,7 @@ def locate_points(mesh, x, tol):
     return jnp.where(best_val <= tol, best_elem, -1)
 
 
-def exit_face(normals, d, cur, dirv, exclude=None):
+def exit_face(normals, d, cur, dirv, exclude=None, return_num=False):
     """Exit crossing of rays r(t) = cur + t*dirv, t ∈ [0, 1], out of tets
     described by face planes (normals [n,4,3], d [n,4]).
 
@@ -64,7 +64,11 @@ def exit_face(normals, d, cur, dirv, exclude=None):
 
     Returns (t_exit [n], face [n], has_exit [n] bool). t_exit is clamped to
     [0, inf); has_exit is False when no face is exited (destination inside,
-    or zero-length ray).
+    or zero-length ray). With ``return_num`` the plane-equation numerators
+    ``d - n·cur`` [n,4] are appended — that is the NEGATED signed distance
+    of ``cur`` to each face, so callers needing containment (the walk's
+    relocation chase and debug checks) reuse it instead of paying the
+    einsum again per crossing.
     """
     denom = jnp.einsum("pfc,pc->pf", normals, dirv)  # [n,4]
     num = d - jnp.einsum("pfc,pc->pf", normals, cur)  # [n,4]
@@ -91,4 +95,6 @@ def exit_face(normals, d, cur, dirv, exclude=None):
             stranded, jnp.argmin(t_all, axis=-1).astype(jnp.int32), face
         )
         has_exit = has_exit | stranded
+    if return_num:
+        return t_exit, face, has_exit, num
     return t_exit, face, has_exit

@@ -158,6 +158,22 @@ def chase_face_choice(sd, elem, it, dtype, interior):
     return jnp.argmax(score, axis=-1).astype(jnp.int32)
 
 
+def _exp2i(k, dtype):
+    """2**k as ``dtype`` for small non-negative integer k (the bump's
+    stuck counter, clamped <= 48): assemble the float's exponent bits
+    directly instead of paying a transcendental per lane per crossing."""
+    if dtype == jnp.float32:
+        return jax.lax.bitcast_convert_type(
+            ((k + 127) << 23).astype(jnp.int32), jnp.float32
+        )
+    if dtype == jnp.float64:
+        # f64 meshes only exist under x64, where int64 is available.
+        return jax.lax.bitcast_convert_type(
+            (k.astype(jnp.int64) + 1023) << 52, jnp.float64
+        )
+    return jnp.exp2(k.astype(dtype))
+
+
 def escalated_bump(stuck, contained, continuing, t_step, tol_floor,
                    tol_eff, cur, dnorm, dtype):
     """Doubling forward bump for zero-progress crossings, shared by both
@@ -170,7 +186,7 @@ def escalated_bump(stuck, contained, continuing, t_step, tol_floor,
     scale1 = 1.0 + jnp.max(jnp.abs(cur), axis=-1)
     nudge0 = 4.0 * tol_floor * scale1 / jnp.where(dnorm > 0, dnorm, 1.0)
     nudge_t = jnp.minimum(
-        nudge0 * jnp.exp2(stuck.astype(dtype)),
+        nudge0 * _exp2i(stuck, dtype),
         jnp.maximum(tol_eff, nudge0),
     )
     zero_step = continuing & (t_step < nudge0) & ~contained
@@ -240,6 +256,9 @@ def trace_impl(
     compact_size: int | None = None,
     compact_stages: tuple | None = None,
     unroll: int = 1,
+    robust: bool = True,
+    tally_scatter: str = "interleaved",
+    gathers: str = "merged",
     debug_checks: bool = False,
     record_xpoints: int | None = None,
 ) -> TraceResult:
@@ -285,6 +304,29 @@ def trace_impl(
         (the measured cost driver — the loop is launch-bound, not
         bandwidth-bound) at the price of at most ``unroll - 1`` wasted
         body evaluations at the tail.
+      robust: enable the degeneracy-recovery machinery (entry-face mask,
+        relocation chase, escalated bump — module docstring "Degeneracy
+        robustness"). With False the walk has exactly the reference
+        tracer's semantics: a lane a numerical degeneracy traps never
+        repairs, it just fails to finish within max_crossings and is
+        reported per-particle via ``done`` (the reference's "Not all
+        particles are found" printf, cpp:765-768, as data instead of a
+        message). On clean meshes results are identical; keep the
+        default True except for A/B cost attribution or strict
+        reference-parity runs.
+      tally_scatter: per-crossing (Σc, Σc²) accumulation strategy.
+        "interleaved" (default) concatenates both rows into ONE 2m-row
+        scalar scatter (c at flat slot 2k, c² at 2k+1); "pair" issues two
+        m-row scatters. Numerically identical (disjoint slots). The
+        strategies trade a concatenate for a second scatter dispatch and
+        measure differently per backend — keep both benchable; ignored
+        when score_squares=False.
+      gathers: packed-body table-read strategy. "merged" (default) reads
+        the whole geo20 row in one 20-wide gather; "split" reads the
+        geometry [.. :16] and bitcast topology [16:20] columns as two
+        narrower gathers (the round-2 two-gather pattern, expressed as
+        gathers from slices of the same table). Ignored by the unpacked
+        fallback body.
       record_xpoints: when set to K, record each particle's first K
         boundary-crossing points into an [n, K, 3] buffer (the tracer's
         getIntersectionPoints() surface, reference test:403-479,
@@ -361,6 +403,12 @@ def trace_impl(
     # f32 rounding (1 - 1e-8 == 1 in f32). See the tolerance docstring.
     tol_floor = 8 * float(jnp.finfo(dtype).eps)
 
+    if tally_scatter not in ("interleaved", "pair"):
+        raise ValueError(
+            f"tally_scatter must be 'interleaved' or 'pair': {tally_scatter!r}"
+        )
+    if gathers not in ("merged", "split"):
+        raise ValueError(f"gathers must be 'merged' or 'split': {gathers!r}")
     if record_xpoints is not None and (
         compact_after is not None or compact_stages is not None
     ):
@@ -388,12 +436,19 @@ def trace_impl(
             active = jnp.logical_not(done)
 
             if packed:
-                # ONE gather: normals + plane offsets + bitcast topo codes.
-                geo = mesh.geo20[elem]  # [m, 20]
-                normals = geo[:, :12].reshape(-1, 4, 3)
-                dplane = geo[:, 12:16]
+                if gathers == "merged":
+                    # ONE gather: normals + offsets + bitcast topo codes.
+                    geo = mesh.geo20[elem]  # [m, 20]
+                    geo_g, codes_f = geo[:, :16], geo[:, 16:20]
+                else:
+                    # Two narrower gathers from slices of the same table
+                    # (round-2 pattern): 16-wide geometry + 4-wide topo.
+                    geo_g = mesh.geo20[:, :16][elem]
+                    codes_f = mesh.geo20[:, 16:20][elem]
+                normals = geo_g[:, :12].reshape(-1, 4, 3)
+                dplane = geo_g[:, 12:16]
                 codes = jax.lax.bitcast_convert_type(
-                    geo[:, 16:20], code_int
+                    codes_f, code_int
                 ).astype(jnp.int32)  # [m, 4]
                 nbrs_all = (codes & 0xFFFFFF) - 1
             else:
@@ -402,38 +457,54 @@ def trace_impl(
                 nbrs_all = mesh.tet2tet[elem]  # [m, 4]
 
             dirv = dest_a - cur
-            # Never step back through the face we just entered: a straight
-            # ray cannot re-enter a convex element it exited, and masking
-            # that face breaks the t≈0 two-element cycles grazing rays
-            # otherwise fall into on irregular meshes (see exit_face).
-            backward = (prev[:, None] >= 0) & (nbrs_all == prev[:, None])
-            t_exit, face, has_exit = exit_face(
-                normals, dplane, cur, dirv, exclude=backward
-            )
+            if robust:
+                # Never step back through the face we just entered: a
+                # straight ray cannot re-enter a convex element it exited,
+                # and masking that face breaks the t≈0 two-element cycles
+                # grazing rays otherwise fall into on irregular meshes
+                # (see exit_face).
+                backward = (prev[:, None] >= 0) & (
+                    nbrs_all == prev[:, None]
+                )
+                t_exit, face, has_exit, plane_num = exit_face(
+                    normals, dplane, cur, dirv, exclude=backward,
+                    return_num=True,
+                )
 
-            # Relocation chase for stuck lanes. Near a grazing corner the
-            # rounded min-t exit choice can hop the particle into an
-            # element that does NOT contain the onward ray; the resulting
-            # t=0 ejection cascade can cycle instead of converging, with
-            # the position and the element assignment macroscopically
-            # diverged. After 4 consecutive zero-progress crossings in a
-            # NON-containing element, switch the lane to a stochastic
-            # visibility walk (chase_face_choice): hop toward the point
-            # without moving or scoring anything until containment is
-            # restored, then resume the normal walk (the stuck counter
-            # resets on containment). The same recovery class the
-            # reference's tracer leaves to "not all particles found"
-            # printf truncation (cpp:765-768) — here it repairs instead
-            # of giving up.
-            sd = jnp.einsum("pfc,pc->pf", normals, cur) - dplane
-            contained = jnp.max(sd, axis=-1) <= 0.0
-            chase = active & (stuck >= 4) & ~contained
-            chase_face = chase_face_choice(
-                sd, elem, it, dtype, nbrs_all >= 0
-            )
-            face = jnp.where(chase, chase_face, face)
-            t_exit = jnp.where(chase, 0.0, t_exit)
-            has_exit = has_exit | chase
+                # Relocation chase for stuck lanes. Near a grazing corner
+                # the rounded min-t exit choice can hop the particle into
+                # an element that does NOT contain the onward ray; the
+                # resulting t=0 ejection cascade can cycle instead of
+                # converging, with the position and the element assignment
+                # macroscopically diverged. After 4 consecutive
+                # zero-progress crossings in a NON-containing element,
+                # switch the lane to a stochastic visibility walk
+                # (chase_face_choice): hop toward the point without moving
+                # or scoring anything until containment is restored, then
+                # resume the normal walk (the stuck counter resets on
+                # containment). The same recovery class the reference's
+                # tracer leaves to "not all particles found" printf
+                # truncation (cpp:765-768) — here it repairs instead of
+                # giving up.
+                sd = -plane_num  # signed distance to own faces; reuse
+                # the exit test's plane numerators, not a second einsum.
+                contained = jnp.max(sd, axis=-1) <= 0.0
+                chase = active & (stuck >= 4) & ~contained
+                chase_face = chase_face_choice(
+                    sd, elem, it, dtype, nbrs_all >= 0
+                )
+                face = jnp.where(chase, chase_face, face)
+                t_exit = jnp.where(chase, 0.0, t_exit)
+                has_exit = has_exit | chase
+            elif debug_checks:
+                t_exit, face, has_exit, plane_num = exit_face(
+                    normals, dplane, cur, dirv, return_num=True
+                )
+                sd = -plane_num
+            else:
+                t_exit, face, has_exit = exit_face(
+                    normals, dplane, cur, dirv
+                )
 
             # Geometric tolerance → ray-parameter space (normals are unit,
             # so geometric distance = t × |dirv|), floored at a few ulps.
@@ -455,12 +526,9 @@ def trace_impl(
                 # particle must actually be inside (within tolerance +
                 # rounding of) its claimed parent element — a wrong
                 # parent id, a broken hop, or degenerate geometry shows
-                # up here as an off-element position. Uses the already
-                # gathered face planes, so the debug cost is a couple of
-                # reductions. Also guards the tally-free initial search.
-                sd = (
-                    jnp.einsum("pfc,pc->pf", normals, cur) - dplane
-                )  # signed distance to own faces; positive = outside
+                # up here as an off-element position. Reuses the exit
+                # test's signed distances, so the debug cost is a couple
+                # of reductions. Also guards the tally-free initial search.
                 scale = jnp.max(jnp.abs(cur), axis=-1) + 1.0
                 bound = 10.0 * tolerance + 64.0 * tol_floor * scale
                 checkify.check(
@@ -479,7 +547,7 @@ def trace_impl(
                 # and relocation-chase hops are bookkeeping, not
                 # crossings). Non-crossing lanes row-index OOB (dropped);
                 # lanes past K crossings column-index OOB (dropped).
-                real_cross = crossed & ~chase
+                real_cross = crossed & ~chase if robust else crossed
                 rows = jnp.where(
                     real_cross, jnp.arange(xp.shape[0], dtype=jnp.int32),
                     jnp.int32(xp.shape[0]),
@@ -512,7 +580,9 @@ def trace_impl(
                 seg = t_step * dnorm  # |xpoint - cur|
                 # Chase hops are bookkeeping (zero length): keep them out
                 # of the segment count the benchmarks report.
-                score = active & in_flight_a & ~chase
+                score = active & in_flight_a
+                if robust:
+                    score = score & ~chase
                 contrib = jnp.where(score, seg * weight_a, 0.0).astype(dtype)
                 # Flat (elem, group) key; non-scoring rows get the OOB
                 # sentinel and drop — the functional analog of the
@@ -530,7 +600,9 @@ def trace_impl(
                         & jnp.all(jnp.isfinite(contrib)),
                         "negative or non-finite tally contribution",
                     )
-                if score_squares:
+                if not score_squares:
+                    flux = flux.at[key * 2].add(contrib, mode="drop")
+                elif tally_scatter == "interleaved":
                     # Both tally rows in ONE interleaved scalar scatter:
                     # c at flat slot 2k, c² at 2k+1.
                     kk = jnp.concatenate([key * 2, key * 2 + 1])
@@ -538,6 +610,9 @@ def trace_impl(
                     flux = flux.at[kk].add(vv, mode="drop")
                 else:
                     flux = flux.at[key * 2].add(contrib, mode="drop")
+                    flux = flux.at[key * 2 + 1].add(
+                        contrib * contrib, mode="drop"
+                    )
                 nseg = nseg + jnp.sum(score).astype(nseg.dtype)
 
             # --- boundary conditions (apply_boundary_condition,
@@ -560,7 +635,8 @@ def trace_impl(
                     )
                 # A relocation-chase hop is bookkeeping, not a physical
                 # crossing: it must not trigger a material stop.
-                material_stop = material_stop & ~chase
+                if robust:
+                    material_stop = material_stop & ~chase
             newly_done = (active & reached) | domain_exit | material_stop
 
             if not initial:
@@ -577,26 +653,28 @@ def trace_impl(
             # --- hop (move_to_next_element hops even freshly-done
             # material-stop particles, cpp:440-450) -------------------------
             hopped = crossed & (next_elem != -1)
-            # The entry-face mask rests on ray convexity, which only
-            # holds for REAL crossings: a chase hop must clear prev, not
-            # set it, or it could mask the ray's true exit from the new
-            # element.
-            prev = jnp.where(
-                hopped, jnp.where(chase, jnp.int32(-1), elem), prev
-            )
+            if robust:
+                # The entry-face mask rests on ray convexity, which only
+                # holds for REAL crossings: a chase hop must clear prev,
+                # not set it, or it could mask the ray's true exit from
+                # the new element.
+                prev = jnp.where(
+                    hopped, jnp.where(chase, jnp.int32(-1), elem), prev
+                )
             elem = jnp.where(hopped, next_elem, elem)
             cur = jnp.where(active[:, None], xpoint, cur)
-            # Degeneracy bump (escalated_bump): crack/edge t≈0 cycles the
-            # entry-face mask cannot break are escaped by guaranteed
-            # forward progress per crossing.
-            continuing = crossed & ~newly_done
-            extra, stuck = escalated_bump(
-                stuck, contained, continuing, t_step, tol_floor, tol_eff,
-                cur, dnorm, dtype,
-            )
-            cur = jnp.where(
-                continuing[:, None], cur + extra[:, None] * dirv, cur
-            )
+            if robust:
+                # Degeneracy bump (escalated_bump): crack/edge t≈0 cycles
+                # the entry-face mask cannot break are escaped by
+                # guaranteed forward progress per crossing.
+                continuing = crossed & ~newly_done
+                extra, stuck = escalated_bump(
+                    stuck, contained, continuing, t_step, tol_floor,
+                    tol_eff, cur, dnorm, dtype,
+                )
+                cur = jnp.where(
+                    continuing[:, None], cur + extra[:, None] * dirv, cur
+                )
             done = done | newly_done
             if record_xpoints is None:
                 return cur, elem, done, mat, flux, nseg, prev, stuck, it + 1
@@ -795,6 +873,9 @@ trace = jax.jit(
         "compact_size",
         "compact_stages",
         "unroll",
+        "robust",
+        "tally_scatter",
+        "gathers",
         "debug_checks",
         "record_xpoints",
     ),

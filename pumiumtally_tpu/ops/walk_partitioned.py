@@ -156,8 +156,9 @@ def _walk_phase(
             # equality also masks the face back across a partition cut
             # for freshly migrated particles.
             backward = (prev[:, None] != -1) & (enc_row == prev[:, None])
-            t_exit, face, has_exit = exit_face(
-                normals, dplane, cur, dirv, exclude=backward
+            t_exit, face, has_exit, plane_num = exit_face(
+                normals, dplane, cur, dirv, exclude=backward,
+                return_num=True,
             )
             # (2) relocation chase after 4 zero-progress crossings in a
             # non-containing element (chase_face_choice, shared with
@@ -165,7 +166,7 @@ def _walk_phase(
             # once contained. Remote faces count as interior candidates —
             # chasing across a partition cut correctly migrates the lane
             # to the neighbor chip.
-            sd = jnp.einsum("pfc,pc->pf", normals, cur) - dplane
+            sd = -plane_num  # reuse the exit test's plane numerators
             contained = jnp.max(sd, axis=-1) <= 0.0
             chase = active & (stuck >= 4) & ~contained
             chase_face = chase_face_choice(

@@ -1,0 +1,48 @@
+"""Tunnel/backend health probe: compile latency of a trivial program,
+per-dispatch round-trip, and a 4 MB readback — the numbers that separate
+"the chip is slow" from "the tunnel is slow" when the headline bench
+moves (the remote axon service has shown 2-3x compile-time swings and
+can go down entirely mid-round).
+
+Usage: python scripts/probe_dispatch.py
+"""
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda x: x + 1.0)
+    x = jnp.zeros(8, jnp.float32)
+    t0 = time.perf_counter()
+    y = f(x)
+    float(np.asarray(y)[0])
+    print(f"trivial compile+first: {time.perf_counter()-t0:.3f}s",
+          flush=True)
+
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(20):
+            x = f(x)
+        float(np.asarray(x)[0])
+        dt = time.perf_counter() - t0
+        print(f"20 chained dispatches: {dt:.3f}s -> "
+              f"{dt/20*1e3:.1f} ms/dispatch", flush=True)
+
+    big = jnp.zeros(1_048_576, jnp.float32)
+    g = jax.jit(lambda x: x * 2.0)
+    np.asarray(g(big))
+    t0 = time.perf_counter()
+    for _ in range(5):
+        np.asarray(g(big))
+    print(f"4MB-readback dispatch: {(time.perf_counter()-t0)/5*1e3:.1f} ms",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

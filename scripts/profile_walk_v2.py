@@ -3,9 +3,12 @@ compaction): how much of a step is the tally scatter now that the gather
 side was halved in round 2?
 
 Variants:
+  full    — bench default (interleaved (c, c²) scatter per crossing)
+  fast    — full tally, robust=False (degeneracy-recovery machinery off:
+            no entry-face mask / chase / bump — isolates the hardening
+            cost, which never fires on this box mesh)
   notally — initial=True (no scatter at all; walk lower bound)
   nosq    — one scatter-add per crossing
-  full    — bench default (two scatter-adds per crossing)
 
 Usage: python scripts/profile_walk_v2.py [cells] [n_particles] [steps]
 """
@@ -65,9 +68,10 @@ def main():
         return step
 
     variants = {
+        "full": dict(initial=False),
+        "fast": dict(initial=False, robust=False),
         "notally": dict(initial=True),
         "nosq": dict(initial=False, score_squares=False),
-        "full": dict(initial=False),
     }
     key = jax.random.key(0)
     for name, kw in variants.items():

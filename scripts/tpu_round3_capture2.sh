@@ -1,0 +1,55 @@
+#!/bin/bash
+# Round-3 second-wave TPU capture — run when the tunnel revives.
+# ONE job at a time (a JAX TPU process holds the device exclusively;
+# a second process just blocks on acquisition), cheapest-first so a
+# tunnel death mid-run still leaves evidence. Outputs in bench_out/.
+#
+# Attribution question this wave answers: the v3+hardening walk measured
+# 5.43 Mseg/s vs v2's 8.53 with 2-3x slower compiles — is the regression
+# (a) tunnel/backend slowdown, (b) the hardening added after v3's
+# microbenches, or (c) the merged geo20 layout itself?
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p bench_out
+
+run() {
+  name="$1"; shift
+  echo "=== $name: $* ==="
+  timeout "${CAPTURE_TIMEOUT:-2400}" "$@" \
+    >"bench_out/$name.out" 2>"bench_out/$name.err"
+  echo "rc=$? ($name)"
+  tail -3 "bench_out/$name.out" 2>/dev/null
+}
+
+# 0. tunnel health + dispatch latency (seconds, no big compile)
+run probe_dispatch python scripts/probe_dispatch.py
+# 1. headline, current default (einsum-reuse landed since the 5.43 runs)
+run bench_v3b env BENCH_EVENT=0 BENCH_PROBE=0 python bench.py
+# 2. headline, robust=False (hardening cost at full scale)
+run bench_v3b_fast env BENCH_ROBUST=0 BENCH_EVENT=0 BENCH_PROBE=0 \
+    python bench.py
+# 3. scatter strategy A/B (CPU says "pair" is 40% cheaper; the in-loop
+#    TPU microbench said interleaved is 11% cheaper — settle it in the
+#    real body)
+run bench_v3b_pair env BENCH_SCATTER=pair BENCH_EVENT=0 BENCH_PROBE=0 \
+    python bench.py
+# 4. gather strategy A/B (merged geo20 vs split 16+4, CPU prefers split)
+run bench_v3b_splitg env BENCH_GATHERS=split BENCH_EVENT=0 BENCH_PROBE=0 \
+    python bench.py
+# 5. combined fast candidate (no hardening, pair scatter, split gathers)
+run bench_v3b_allfast env BENCH_ROBUST=0 BENCH_SCATTER=pair \
+    BENCH_GATHERS=split BENCH_EVENT=0 BENCH_PROBE=0 python bench.py
+# 6. walk cost split (full/fast/notally/nosq)
+run profile_v3b python scripts/profile_walk_v2.py 55 1048576 5
+# 7. compaction-ladder candidates
+run sweep_stages python scripts/sweep_stages.py 55 3
+# 8. 64-group contention guard
+run bench_v3b_64g env BENCH_GROUPS=64 BENCH_EVENT=0 BENCH_PROBE=0 \
+    python bench.py
+# 9. 10M-tet rung
+run bench_v3b_10m env BENCH_CELLS=119 BENCH_PARTICLES=2097152 \
+    BENCH_STEPS=5 BENCH_EVENT=0 BENCH_PROBE=0 python bench.py
+# 10. event-loop + pipeline numbers
+run bench_v3b_event env BENCH_EVENT=1 BENCH_PROBE=0 BENCH_STEPS=3 \
+    python bench.py
+echo "=== capture2 complete ==="

@@ -83,6 +83,41 @@ def test_unpacked_fallback_matches_packed(setup):
 
 
 @pytest.mark.parametrize("body", ["packed", "unpacked"])
+def test_robust_off_matches_on_clean_mesh(setup, body):
+    """robust=False (reference-parity truncate mode) drops the recovery
+    machinery but must be BIT-IDENTICAL on a well-behaved mesh: the
+    entry-face mask / chase / bump only ever fire on degeneracies, which a
+    regular box has none of. Pins that the fast path's arithmetic is the
+    same, not merely close."""
+    mesh, mesh_unpacked, args, kw, base = setup
+    m = mesh if body == "packed" else mesh_unpacked
+    got = trace_impl(
+        m, *args[1:], make_flux(mesh.ntet, 2, jnp.float32), **kw,
+        robust=False,
+    )
+    _assert_same(got, base, flux_exact=True)
+
+
+@pytest.mark.parametrize(
+    "knob",
+    [dict(tally_scatter="pair"), dict(gathers="split"),
+     dict(tally_scatter="pair", gathers="split")],
+    ids=["pair-scatter", "split-gathers", "both"],
+)
+def test_scatter_gather_strategies_bit_identical(setup, knob):
+    """The tally-scatter strategy (one interleaved 2m-row scatter vs a
+    pair of m-row scatters — disjoint flat slots, so no accumulation
+    reorder) and the packed-table read strategy (one 20-wide gather vs
+    split 16+4) are pure scheduling choices: results must be
+    BIT-identical to the default."""
+    mesh, mesh_unpacked, args, kw, base = setup
+    got = trace_impl(
+        mesh, *args[1:], make_flux(mesh.ntet, 2, jnp.float32), **kw, **knob
+    )
+    _assert_same(got, base, flux_exact=True)
+
+
+@pytest.mark.parametrize("body", ["packed", "unpacked"])
 def test_score_squares_off_drops_only_squares(setup, body):
     """score_squares=False (public config knob) must leave the Σc column
     identical and the Σc² column zero, in both walk bodies."""
