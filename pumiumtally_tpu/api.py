@@ -203,6 +203,9 @@ class PumiTally:
                 compact_size=self._compact[1],
                 compact_stages=self._compact_stages,
                 unroll=self.config.unroll,
+                robust=self.config.robust,
+                tally_scatter=self.config.tally_scatter,
+                gathers=self.config.gathers,
                 record_xpoints=self.config.record_xpoints,
             )
             self.flux = result.flux
@@ -279,6 +282,9 @@ class PumiTally:
                 compact_size=self._compact[1],
                 compact_stages=self._compact_stages,
                 unroll=cfg.unroll,
+                robust=cfg.robust,
+                tally_scatter=cfg.tally_scatter,
+                gathers=cfg.gathers,
                 record_xpoints=cfg.record_xpoints,
             )
             self.flux = result.flux

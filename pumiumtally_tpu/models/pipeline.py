@@ -123,6 +123,9 @@ class StreamingTallyPipeline:
             ),
             compact_stages=cfg.resolve_compact_stages(n),
             unroll=cfg.unroll,
+            robust=cfg.robust,
+            tally_scatter=cfg.tally_scatter,
+            gathers=cfg.gathers,
             record_xpoints=cfg.record_xpoints,
         )
         # The flux chain threads through every batch (donated each step);

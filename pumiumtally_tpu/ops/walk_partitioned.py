@@ -101,7 +101,8 @@ def _walk_phase(
     tables, cur, dest, elem, done, target, target_elem, material_id,
     weight, group, flux, nseg, valid, prev, stuck,
     *, initial, tolerance, score_squares, max_crossings, max_local,
-    unroll=1, compact_after=None, compact_size=None,
+    unroll=1, compact_after=None, compact_size=None, robust=True,
+    tally_scatter="interleaved",
 ):
     """Advance every resident particle until done or pending-migration.
 
@@ -155,26 +156,33 @@ def _walk_phase(
             # ENC-encoded (local id >= 0 or remote code < -1), so the
             # equality also masks the face back across a partition cut
             # for freshly migrated particles.
-            backward = (prev[:, None] != -1) & (enc_row == prev[:, None])
-            t_exit, face, has_exit, plane_num = exit_face(
-                normals, dplane, cur, dirv, exclude=backward,
-                return_num=True,
-            )
-            # (2) relocation chase after 4 zero-progress crossings in a
-            # non-containing element (chase_face_choice, shared with
-            # walk.py): hop toward the point; resumes the normal walk
-            # once contained. Remote faces count as interior candidates —
-            # chasing across a partition cut correctly migrates the lane
-            # to the neighbor chip.
-            sd = -plane_num  # reuse the exit test's plane numerators
-            contained = jnp.max(sd, axis=-1) <= 0.0
-            chase = active & (stuck >= 4) & ~contained
-            chase_face = chase_face_choice(
-                sd, elem, it, dtype, enc_row != -1
-            )
-            face = jnp.where(chase, chase_face, face)
-            t_exit = jnp.where(chase, 0.0, t_exit)
-            has_exit = has_exit | chase
+            if robust:
+                backward = (prev[:, None] != -1) & (
+                    enc_row == prev[:, None]
+                )
+                t_exit, face, has_exit, plane_num = exit_face(
+                    normals, dplane, cur, dirv, exclude=backward,
+                    return_num=True,
+                )
+                # (2) relocation chase after 4 zero-progress crossings in
+                # a non-containing element (chase_face_choice, shared
+                # with walk.py): hop toward the point; resumes the normal
+                # walk once contained. Remote faces count as interior
+                # candidates — chasing across a partition cut correctly
+                # migrates the lane to the neighbor chip.
+                sd = -plane_num  # reuse the exit test's plane numerators
+                contained = jnp.max(sd, axis=-1) <= 0.0
+                chase = active & (stuck >= 4) & ~contained
+                chase_face = chase_face_choice(
+                    sd, elem, it, dtype, enc_row != -1
+                )
+                face = jnp.where(chase, chase_face, face)
+                t_exit = jnp.where(chase, 0.0, t_exit)
+                has_exit = has_exit | chase
+            else:
+                t_exit, face, has_exit = exit_face(
+                    normals, dplane, cur, dirv
+                )
 
             # Geometric tolerance → ray-parameter space with an ulp floor,
             # matching ops/walk.py exactly so the partitioned and
@@ -204,19 +212,24 @@ def _walk_phase(
                 seg = jnp.linalg.norm(xpoint - cur, axis=-1)
                 # Chase hops are bookkeeping (zero length): keep them out
                 # of the tally rows and the segment count.
-                score = active & ~chase
+                score = active & ~chase if robust else active
                 contrib = jnp.where(score, seg * weight_a, 0.0).astype(dtype)
                 key = jnp.where(
                     score & (group_a >= 0) & (group_a < n_groups),
                     elem * n_groups + group_a,
                     nbins,
                 )
-                if score_squares:
+                if not score_squares:
+                    flux = flux.at[key * 2].add(contrib, mode="drop")
+                elif tally_scatter == "interleaved":
                     kk = jnp.concatenate([key * 2, key * 2 + 1])
                     vv = jnp.concatenate([contrib, contrib * contrib])
                     flux = flux.at[kk].add(vv, mode="drop")
                 else:
                     flux = flux.at[key * 2].add(contrib, mode="drop")
+                    flux = flux.at[key * 2 + 1].add(
+                        contrib * contrib, mode="drop"
+                    )
                 nseg = nseg + jnp.sum(score).astype(nseg.dtype)
 
             nclass = nbrclass_t[elem, face]
@@ -228,7 +241,8 @@ def _walk_phase(
                 )
                 # A relocation-chase hop is bookkeeping, not a physical
                 # crossing: it must not trigger a material stop.
-                material_stop = material_stop & ~chase
+                if robust:
+                    material_stop = material_stop & ~chase
             newly_done = (active & reached) | domain_exit | material_stop
             if not initial:
                 material_id = jnp.where(
@@ -248,23 +262,26 @@ def _walk_phase(
             target = jnp.where(remote, code // max_local, target)
             target_elem = jnp.where(remote, code % max_local, target_elem)
 
-            # Chase hops clear prev (the convexity argument behind the
-            # entry-face mask applies to real crossings only, walk.py).
-            prev = jnp.where(
-                local_hop, jnp.where(chase, jnp.int32(-1), elem), prev
-            )
+            if robust:
+                # Chase hops clear prev (the convexity argument behind
+                # the entry-face mask applies to real crossings only,
+                # walk.py).
+                prev = jnp.where(
+                    local_hop, jnp.where(chase, jnp.int32(-1), elem), prev
+                )
             elem = jnp.where(local_hop, enc, elem)
             cur = jnp.where(active[:, None], xpoint, cur)
-            # (3) degeneracy bump (escalated_bump, shared with walk.py):
-            # guaranteed forward progress per continuing crossing.
-            continuing = local_hop & ~newly_done
-            extra, stuck = escalated_bump(
-                stuck, contained, continuing, t_step, tol_floor, tol_eff,
-                cur, dnorm, dtype,
-            )
-            cur = jnp.where(
-                continuing[:, None], cur + extra[:, None] * dirv, cur
-            )
+            if robust:
+                # (3) degeneracy bump (escalated_bump, shared with
+                # walk.py): guaranteed forward progress per crossing.
+                continuing = local_hop & ~newly_done
+                extra, stuck = escalated_bump(
+                    stuck, contained, continuing, t_step, tol_floor,
+                    tol_eff, cur, dnorm, dtype,
+                )
+                cur = jnp.where(
+                    continuing[:, None], cur + extra[:, None] * dirv, cur
+                )
             done = done | newly_done
             return (cur, elem, done, target, target_elem, material_id,
                     flux, nseg, prev, stuck, it + 1)
@@ -372,6 +389,8 @@ def make_partitioned_step(
     unroll: int = 1,
     compact_after: int | None = None,
     compact_size: int | None = None,
+    robust: bool = True,
+    tally_scatter: str = "interleaved",
 ):
     """Build the jitted distributed trace step for one mesh partition.
 
@@ -387,12 +406,19 @@ def make_partitioned_step(
         few passes suffice; truncation shows up as done=False).
       compact_after/compact_size: straggler compaction for each walk
         phase, as in ops/walk.py (default off).
+      robust/tally_scatter: the degeneracy-recovery and tally-scatter
+        strategy knobs of ops/walk.py, applied to the partitioned body
+        (same semantics, same defaults).
 
     Returns step(cur, dest, elem, done, material, weight, group, pid, valid,
     flux) -> PartitionedTraceResult, where per-particle arrays are
     [n_parts * cap] sharded over the device axis and flux is
     [n_parts, max_local, n_groups, 2] sharded on its leading axis.
     """
+    if tally_scatter not in ("interleaved", "pair"):
+        raise ValueError(
+            f"tally_scatter must be 'interleaved' or 'pair': {tally_scatter!r}"
+        )
     n_parts = partition.n_parts
     if device_mesh.shape[AXIS] != n_parts:
         raise ValueError(
@@ -446,6 +472,8 @@ def make_partitioned_step(
             unroll=unroll,
             compact_after=compact_after,
             compact_size=compact_size,
+            robust=robust,
+            tally_scatter=tally_scatter,
         )
 
         me = jax.lax.axis_index(AXIS)

@@ -65,6 +65,13 @@ class TallyConfig:
         parity, reference test:403-479). Debug/analysis only: it
         disables straggler compaction for the run and costs one extra
         [n,3] store per crossing; the default (None) pays nothing.
+      robust: the walk's degeneracy-recovery machinery (ops/walk.py,
+        "Degeneracy robustness"). False gives the reference tracer's
+        truncate-on-degeneracy semantics (identical results on clean
+        meshes, cheaper body); keep True unless the mesh is known
+        well-behaved.
+      tally_scatter / gathers: walk scheduling strategies (ops/walk.py
+        docstring) — benchmark-tunable, numerically identical.
     """
 
     n_groups: int = 2
@@ -82,6 +89,9 @@ class TallyConfig:
     measure_time: bool = False
     checkify_invariants: bool = False
     record_xpoints: int | None = None
+    robust: bool = True
+    tally_scatter: str = "interleaved"
+    gathers: str = "merged"
 
     def resolve_max_crossings(self, ntet: int) -> int:
         if self.max_crossings is not None:
