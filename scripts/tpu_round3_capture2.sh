@@ -11,6 +11,9 @@
 set -u
 cd "$(dirname "$0")/.."
 mkdir -p bench_out
+# Persistent compile cache: identical program shapes skip the remote
+# compile service entirely (observed 233MB/entry, ~5min saved per hit).
+export JAX_COMPILATION_CACHE_DIR="$PWD/.jax_cache"
 
 run() {
   name="$1"; shift
