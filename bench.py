@@ -39,6 +39,7 @@ def run(
     robust: bool = True,
     tally_scatter: str = "interleaved",
     gathers: str = "merged",
+    ledger: bool = True,
 ) -> dict:
     import jax
 
@@ -108,6 +109,7 @@ def run(
             robust=robust,
             tally_scatter=tally_scatter,
             gathers=gathers,
+            ledger=ledger,
         )
         return r.position, r.elem, r.flux, r.n_segments, r.n_crossings
 
@@ -178,6 +180,14 @@ def run(
             "robust": robust,
             "tally_scatter": tally_scatter,
             "gathers": gathers,
+            "ledger": ledger,
+            # Whether a persistent compile cache was ENABLED (not whether
+            # this compile hit it — a cold first run still pays the real
+            # remote compile). compile_s under an enabled+warm cache
+            # measures deserialization, not compilation.
+            "compile_cache_enabled": bool(
+                os.environ.get("JAX_COMPILATION_CACHE_DIR")
+            ),
             "last_step_crossing_iters": int(np.asarray(ncross)),
             **event,
         },
@@ -417,6 +427,7 @@ def main() -> None:
         robust=os.environ.get("BENCH_ROBUST", "1") == "1",
         tally_scatter=os.environ.get("BENCH_SCATTER", "interleaved"),
         gathers=os.environ.get("BENCH_GATHERS", "merged"),
+        ledger=os.environ.get("BENCH_LEDGER", "1") == "1",
     )
     print(
         f"[bench] {result['detail']}", file=sys.stderr

@@ -224,6 +224,11 @@ class TraceResult(NamedTuple):
       None otherwise — the hot path pays nothing.
     n_xpoints: [n] recorded-crossing count per particle (may exceed K,
       in which case only the first K points were kept), or None.
+    track_length: [n] per-particle scored track length (Σ segment
+      lengths, unweighted) — the walk's conservation ledger: equals
+      |position − origin| to fp accumulation (asserted under
+      debug_checks, the reference's cpp:618-629 consistency print);
+      zeros on initial-search traces (nothing is scored).
     """
 
     position: jax.Array
@@ -235,6 +240,7 @@ class TraceResult(NamedTuple):
     done: jax.Array
     xpoints: jax.Array | None = None
     n_xpoints: jax.Array | None = None
+    track_length: jax.Array | None = None
 
 
 def trace_impl(
@@ -259,6 +265,7 @@ def trace_impl(
     robust: bool = True,
     tally_scatter: str = "interleaved",
     gathers: str = "merged",
+    ledger: bool = True,
     debug_checks: bool = False,
     record_xpoints: int | None = None,
 ) -> TraceResult:
@@ -327,6 +334,12 @@ def trace_impl(
         narrower gathers (the round-2 two-gather pattern, expressed as
         gathers from slices of the same table). Ignored by the unpacked
         fallback body.
+      ledger: accumulate the per-particle scored track length
+        (TraceResult.track_length — one elementwise select+add per
+        crossing plus one [S] lane in compaction rounds). False skips
+        the in-loop update and returns track_length=None; the
+        debug_checks consistency assert requires it. Kept as a knob so
+        the hardware A/B grid can price it.
       record_xpoints: when set to K, record each particle's first K
         boundary-crossing points into an [n, K, 3] buffer (the tracer's
         getIntersectionPoints() surface, reference test:403-479,
@@ -429,10 +442,11 @@ def trace_impl(
 
         def body(carry):
             if record_xpoints is None:
-                cur, elem, done, mat, flux, nseg, prev, stuck, it = carry
-            else:
-                (cur, elem, done, mat, flux, nseg, prev, stuck, xp, kx,
+                (cur, elem, done, mat, flux, nseg, prev, stuck, pseg,
                  it) = carry
+            else:
+                (cur, elem, done, mat, flux, nseg, prev, stuck, pseg, xp,
+                 kx, it) = carry
             active = jnp.logical_not(done)
 
             if packed:
@@ -614,6 +628,13 @@ def trace_impl(
                         contrib * contrib, mode="drop"
                     )
                 nseg = nseg + jnp.sum(score).astype(nseg.dtype)
+                if ledger:
+                    # Per-particle scored track length: one elementwise
+                    # FMA — the walk's own conservation ledger (Σ over
+                    # crossings of the scored segment = |final − origin|
+                    # along the ray; checked under debug_checks,
+                    # surfaced as TraceResult.track_length).
+                    pseg = pseg + jnp.where(score, seg, 0.0).astype(dtype)
 
             # --- boundary conditions (apply_boundary_condition,
             # cpp:452-515) -------------------------------------------------
@@ -677,9 +698,10 @@ def trace_impl(
                 )
             done = done | newly_done
             if record_xpoints is None:
-                return cur, elem, done, mat, flux, nseg, prev, stuck, it + 1
-            return (cur, elem, done, mat, flux, nseg, prev, stuck, xp, kx,
-                    it + 1)
+                return (cur, elem, done, mat, flux, nseg, prev, stuck,
+                        pseg, it + 1)
+            return (cur, elem, done, mat, flux, nseg, prev, stuck, pseg,
+                    xp, kx, it + 1)
 
         return body
 
@@ -724,20 +746,21 @@ def trace_impl(
     )
     prev0 = elem * 0 - 1  # device-varying -1: no entry face yet
     stuck0 = elem * 0  # consecutive zero-progress crossings per lane
+    pseg0 = weight * 0  # per-lane scored track length (device-varying)
     carry = (
-        origin, elem, done0, mat0, flux, nseg0, prev0, stuck0, jnp.int32(0)
+        origin, elem, done0, mat0, flux, nseg0, prev0, stuck0, pseg0,
+        jnp.int32(0),
     )
     xp = kx = None
     if record_xpoints is not None:
         xp0 = jnp.zeros((n, int(record_xpoints), 3), dtype)
         kx0 = elem * 0  # per-lane zero (device-varying under shard_map)
         carry = carry[:-1] + (xp0, kx0, jnp.int32(0))
-        (cur, elem, done, mat, flux, nseg, prev, stuck, xp, kx,
+        (cur, elem, done, mat, flux, nseg, prev, stuck, pseg, xp, kx,
          it) = run_phase(full_body, carry, phase1_bound)
     else:
-        cur, elem, done, mat, flux, nseg, prev, stuck, it = run_phase(
-            full_body, carry, phase1_bound
-        )
+        (cur, elem, done, mat, flux, nseg, prev, stuck, pseg,
+         it) = run_phase(full_body, carry, phase1_bound)
 
     def compact_round(state, S, bound):
         """One compaction round: gather the first S active lanes, advance
@@ -748,7 +771,7 @@ def trace_impl(
         selection, far cheaper than a 1M-lane sort. Slots past the number
         of active lanes gather clamped garbage; they are neutralized by
         forcing their done flag and dropping their write-back rows."""
-        cur, elem, done, mat, flux, nseg, prev, stuck, it = state
+        cur, elem, done, mat, flux, nseg, prev, stuck, pseg, it = state
         active = jnp.logical_not(done)
         idx, n_active = first_k_active(active, S)
         valid = jnp.arange(S) < n_active
@@ -760,11 +783,10 @@ def trace_impl(
         )
         sub_carry = (
             cur[idx], elem[idx], jnp.logical_not(valid), mat[idx],
-            flux, nseg, prev[idx], stuck[idx], jnp.int32(0),
+            flux, nseg, prev[idx], stuck[idx], pseg[idx], jnp.int32(0),
         )
-        scur, selem, sdone, smat, flux, nseg, sprev, sstuck, sit = run_phase(
-            sub_body, sub_carry, bound
-        )
+        (scur, selem, sdone, smat, flux, nseg, sprev, sstuck, spseg,
+         sit) = run_phase(sub_body, sub_carry, bound)
         idx_sb = jnp.where(valid, idx, n)
         cur = cur.at[idx_sb].set(scur, mode="drop")
         elem = elem.at[idx_sb].set(selem, mode="drop")
@@ -772,10 +794,12 @@ def trace_impl(
         mat = mat.at[idx_sb].set(smat, mode="drop")
         prev = prev.at[idx_sb].set(sprev, mode="drop")
         stuck = stuck.at[idx_sb].set(sstuck, mode="drop")
-        return cur, elem, done, mat, flux, nseg, prev, stuck, it + sit
+        pseg = pseg.at[idx_sb].set(spseg, mode="drop")
+        return (cur, elem, done, mat, flux, nseg, prev, stuck, pseg,
+                it + sit)
 
     if compact_stages is not None and phase1_bound < max_crossings:
-        state = (cur, elem, done, mat, flux, nseg, prev, stuck, it)
+        state = (cur, elem, done, mat, flux, nseg, prev, stuck, pseg, it)
         for i, (start, size) in enumerate(compact_stages):
             S = min(n, max(int(size), 1))
             if i + 1 < len(compact_stages):
@@ -810,7 +834,36 @@ def trace_impl(
                     outer_cond, outer_body, (*state, jnp.int32(0))
                 )
                 state = tuple(state)
-        cur, elem, done, mat, flux, nseg, prev, stuck, it = state
+        cur, elem, done, mat, flux, nseg, prev, stuck, pseg, it = state
+
+    if debug_checks and not initial and ledger:
+        from jax.experimental import checkify
+
+        # The literal analog of the reference's segment-vs-tracklength
+        # consistency print (cpp:618-629): every particle's scored
+        # track length must equal its net straight-line displacement —
+        # all movement is along the origin→dest ray, so a mismatch means
+        # a missed or double-scored segment. The bound covers fp
+        # accumulation plus the robust mode's unscored ulp-scale bump
+        # hops (one per crossing at worst).
+        dist = jnp.linalg.norm(cur - origin, axis=-1)
+        # The robust bump's unscored hop is capped per crossing at
+        # tol_eff·|ray| = max(tolerance, tol_floor·|dest − cur|), and
+        # |dest − cur| ≤ |dest − origin| (movement is toward dest), so
+        # the allowance must carry the RAY length as well as the
+        # coordinate magnitude.
+        raylen = jnp.linalg.norm(dest - origin, axis=-1)
+        scale_d = 1.0 + jnp.maximum(
+            jnp.linalg.norm(origin, axis=-1), dist
+        )
+        bound = (it.astype(dtype) + 1.0) * (
+            tolerance + 64.0 * tol_floor * (scale_d + raylen)
+        )
+        checkify.check(
+            jnp.all(jnp.abs(pseg - dist) <= bound),
+            "scored track length disagrees with net displacement "
+            "(missed or double-scored segment)",
+        )
 
     if packed:
         # Resolve material codes to real class_id values (one tiny-table
@@ -838,6 +891,7 @@ def trace_impl(
         done=done,
         xpoints=xp,
         n_xpoints=kx,
+        track_length=pseg if ledger else None,
     )
 
 
@@ -876,6 +930,7 @@ trace = jax.jit(
         "robust",
         "tally_scatter",
         "gathers",
+        "ledger",
         "debug_checks",
         "record_xpoints",
     ),

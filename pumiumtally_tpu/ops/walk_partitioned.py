@@ -95,11 +95,15 @@ class PartitionedTraceResult(NamedTuple):
     n_segments: jax.Array
     n_rounds: jax.Array
     n_dropped: jax.Array
+    # [n_parts*cap] per-particle scored track length (walk.py
+    # track_length), migrating with its particle across cuts — the
+    # conservation ledger that makes cut-boundary double-scoring visible.
+    track_length: jax.Array | None = None
 
 
 def _walk_phase(
     tables, cur, dest, elem, done, target, target_elem, material_id,
-    weight, group, flux, nseg, valid, prev, stuck,
+    weight, group, flux, nseg, valid, prev, stuck, pseg,
     *, initial, tolerance, score_squares, max_crossings, max_local,
     unroll=1, compact_after=None, compact_size=None, robust=True,
     tally_scatter="interleaved",
@@ -143,7 +147,7 @@ def _walk_phase(
     def make_body(dest_a, weight_a, group_a, valid_a):
         def body(carry):
             (cur, elem, done, target, target_elem, material_id, flux,
-             nseg, prev, stuck, it) = carry
+             nseg, prev, stuck, pseg, it) = carry
             active = valid_a & ~done & (target < 0)
 
             dirv = dest_a - cur
@@ -231,6 +235,10 @@ def _walk_phase(
                         contrib * contrib, mode="drop"
                     )
                 nseg = nseg + jnp.sum(score).astype(nseg.dtype)
+                # Per-particle conservation ledger (walk.py
+                # track_length); migrates with the particle so a
+                # double-scored cut segment is visible in the total.
+                pseg = pseg + jnp.where(score, seg, 0.0).astype(dtype)
 
             nclass = nbrclass_t[elem, face]
             if initial:
@@ -284,7 +292,7 @@ def _walk_phase(
                 )
             done = done | newly_done
             return (cur, elem, done, target, target_elem, material_id,
-                    flux, nseg, prev, stuck, it + 1)
+                    flux, nseg, prev, stuck, pseg, it + 1)
 
         return body
 
@@ -311,7 +319,7 @@ def _walk_phase(
     )
     carry = (
         cur, elem, done, target, target_elem, material_id, flux, nseg,
-        prev, stuck, jnp.int32(0),
+        prev, stuck, pseg, jnp.int32(0),
     )
     carry = run(full_body, valid, carry, phase1_bound)
 
@@ -324,7 +332,7 @@ def _walk_phase(
             """Gather the first S active lanes, advance them until done or
             pending, scatter back (first_k_active, shared with walk.py)."""
             (cur, elem, done, target, target_elem, material_id, flux,
-             nseg, prev, stuck, it) = state
+             nseg, prev, stuck, pseg, it) = state
             active = valid & ~done & (target < 0)
             idx, n_active = first_k_active(active, S)
             sub_ok = jnp.arange(S) < n_active
@@ -334,10 +342,12 @@ def _walk_phase(
             sub_carry = (
                 cur[idx], elem[idx], jnp.logical_not(sub_ok), target[idx],
                 target_elem[idx], material_id[idx], flux, nseg,
-                prev[idx], stuck[idx], jnp.int32(0),
+                prev[idx], stuck[idx], pseg[idx], jnp.int32(0),
             )
             (scur, selem, sdone, star, stare, smat, flux, nseg, sprev,
-             sstuck, sit) = run(sub_body, sub_ok, sub_carry, max_crossings)
+             sstuck, spseg, sit) = run(
+                sub_body, sub_ok, sub_carry, max_crossings
+            )
             idx_sb = jnp.where(sub_ok, idx, cap)
             cur = cur.at[idx_sb].set(scur, mode="drop")
             elem = elem.at[idx_sb].set(selem, mode="drop")
@@ -347,8 +357,9 @@ def _walk_phase(
             material_id = material_id.at[idx_sb].set(smat, mode="drop")
             prev = prev.at[idx_sb].set(sprev, mode="drop")
             stuck = stuck.at[idx_sb].set(sstuck, mode="drop")
+            pseg = pseg.at[idx_sb].set(spseg, mode="drop")
             return (cur, elem, done, target, target_elem, material_id,
-                    flux, nseg, prev, stuck, it + sit)
+                    flux, nseg, prev, stuck, pseg, it + sit)
 
         # Each round retires >= S active lanes (to done or pending) or all
         # of them, so ceil(cap/S)+1 rounds always suffice.
@@ -480,7 +491,7 @@ def make_partitioned_step(
 
         def exchange(carry):
             (cur, dest, elem, done, target, target_elem, material_id,
-             weight, group, pid, valid, prev, stuck, flux_l, nseg,
+             weight, group, pid, valid, prev, stuck, pseg, flux_l, nseg,
              dropped) = carry
             emig = valid & (target >= 0)
 
@@ -505,8 +516,11 @@ def make_partitioned_step(
                 return buf.at[slot].set(rows[order], mode="drop")
 
             pay_f = fill(
-                jnp.concatenate([cur, dest, weight[:, None]], axis=1)
-            )  # [n_parts*E, 7]
+                jnp.concatenate(
+                    [cur, dest, weight[:, None], pseg[:, None]], axis=1
+                )
+            )  # [n_parts*E, 8] — the track-length ledger migrates with
+            # the particle so cut-boundary double-scoring stays visible
             # Entry-face identity for the receiver: the face by which
             # the migrated particle enters its new element points back at
             # (this chip, this element), which the receiver's adjacency
@@ -542,8 +556,8 @@ def make_partitioned_step(
             # ONE all_to_all: block d of my send buffer goes to chip d;
             # I receive n_parts blocks of rows all addressed to me.
             g_f = jax.lax.all_to_all(
-                pay_f.reshape(n_parts, E, 7), AXIS, 0, 0, tiled=False
-            ).reshape(n_parts * E, 7)
+                pay_f.reshape(n_parts, E, 8), AXIS, 0, 0, tiled=False
+            ).reshape(n_parts * E, 8)
             g_i = jax.lax.all_to_all(
                 pay_i.reshape(n_parts, E, 7), AXIS, 0, 0, tiled=False
             ).reshape(n_parts * E, 7)
@@ -577,6 +591,7 @@ def make_partitioned_step(
             cur = place(cur, g_f[src, 0:3].astype(cur.dtype))
             dest = place(dest, g_f[src, 3:6].astype(dest.dtype))
             weight = place(weight, g_f[src, 6].astype(weight.dtype))
+            pseg = place(pseg, g_f[src, 7].astype(pseg.dtype))
             pid = place(pid, g_i[src, 0])
             group = place(group, g_i[src, 1])
             material_id = place(material_id, g_i[src, 2])
@@ -586,27 +601,27 @@ def make_partitioned_step(
             stuck = place(stuck, jnp.zeros_like(stuck[dst]))
             valid = place(valid, take)
             return (cur, dest, elem, done, target, target_elem, material_id,
-                    weight, group, pid, valid, prev, stuck, flux_l, nseg,
-                    dropped)
+                    weight, group, pid, valid, prev, stuck, pseg, flux_l,
+                    nseg, dropped)
 
         def run_walk(carry):
             (cur, dest, elem, done, target, target_elem, material_id,
-             weight, group, pid, valid, prev, stuck, flux_l, nseg,
+             weight, group, pid, valid, prev, stuck, pseg, flux_l, nseg,
              dropped) = carry
             (cur, elem, done, target, target_elem, material_id, flux_l,
-             nseg, prev, stuck) = walk(
+             nseg, prev, stuck, pseg) = walk(
                 tables_l, cur, dest, elem, done, target, target_elem,
                 material_id, weight, group, flux_l, nseg, valid, prev,
-                stuck,
+                stuck, pseg,
             )
             return (cur, dest, elem, done, target, target_elem, material_id,
-                    weight, group, pid, valid, prev, stuck, flux_l, nseg,
-                    dropped)
+                    weight, group, pid, valid, prev, stuck, pseg, flux_l,
+                    nseg, dropped)
 
         carry = (
             cur, dest, elem, done, target0, vzero * 0,
             material_id, weight, group, pid, valid, target0 + 0, vzero * 0,
-            flux_l, nseg0, nseg0 * 0,
+            weight * 0, flux_l, nseg0, nseg0 * 0,
         )
         carry = run_walk(carry)
 
@@ -628,7 +643,7 @@ def make_partitioned_step(
             round_cond, round_body, (carry, nseg0 * 0)
         )
         (cur, dest, elem, done, target, target_elem, material_id,
-         weight, group, pid, valid, prev, stuck, flux_l, nseg,
+         weight, group, pid, valid, prev, stuck, pseg, flux_l, nseg,
          dropped) = carry
 
         return PartitionedTraceResult(
@@ -645,6 +660,7 @@ def make_partitioned_step(
             n_segments=nseg[None],
             n_rounds=n_rounds[None],
             n_dropped=dropped[None],
+            track_length=pseg,
         )
 
     table_specs = tuple(P(AXIS) for _ in tables)
@@ -667,6 +683,7 @@ def make_partitioned_step(
             n_segments=P(AXIS),
             n_rounds=P(AXIS),
             n_dropped=P(AXIS),
+            track_length=particle_spec,
         ),
     )
     jitted = jax.jit(mapped, donate_argnums=(15,))
@@ -747,7 +764,8 @@ def collect_by_particle_id(result: PartitionedTraceResult, n: int) -> dict:
     sel = valid & (pid >= 0)
     idx = pid[sel]
     out = {}
-    for name in ("position", "material_id", "done", "elem", "weight", "group"):
+    for name in ("position", "material_id", "done", "elem", "weight",
+                 "group", "track_length"):
         arr = np.asarray(getattr(result, name))
         buf = np.zeros((n,) + arr.shape[1:], arr.dtype)
         buf[idx] = arr[sel]

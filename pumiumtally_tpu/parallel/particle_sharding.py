@@ -126,6 +126,7 @@ def make_sharded_trace(
             n_segments=r.n_segments[None],
             n_crossings=r.n_crossings[None],
             done=r.done,
+            track_length=r.track_length,
         )
 
     mapped = jax.shard_map(
@@ -150,6 +151,7 @@ def make_sharded_trace(
             n_segments=P(PARTICLE_AXIS),
             n_crossings=P(PARTICLE_AXIS),
             done=P(PARTICLE_AXIS),
+            track_length=P(PARTICLE_AXIS),
         ),
     )
     return jax.jit(mapped, donate_argnums=(8,))

@@ -137,6 +137,13 @@ def main():
         (got["material_id"] == np.asarray(ref.material_id)).mean() > 0.9999
     )
     max_flux_err = float(np.abs(g_flux - ref_flux).max())
+    # Conservation ledger across cuts (migrates with each particle):
+    # catches double/missed scoring at partition boundaries directly.
+    ledger_close = bool(
+        np.allclose(
+            got["track_length"], np.asarray(ref.track_length), atol=1e-4
+        )
+    )
 
     rec = {
         "metric": "partitioned_1m_dryrun",
@@ -152,12 +159,13 @@ def main():
         "max_flux_abs_err": max_flux_err,
         "positions_match": pos_close,
         "materials_match": mats_equal,
+        "track_length_match": ledger_close,
         "single_chip_s": round(single_s, 1),
         "partitioned_s": round(part_s, 1),
         "virtual_cpu_mesh": True,
         "ok": bool(
             n_dropped == 0 and all_done and flux_close and pos_close
-            and mats_equal and pseg == nseg
+            and mats_equal and ledger_close and pseg == nseg
         ),
     }
     print(json.dumps(rec))

@@ -1,0 +1,82 @@
+"""Summarize a bench_out/ capture directory into a markdown table.
+
+Parses the one-line JSON records bench.py emits (and the free-form
+profile/sweep outputs) from scripts/tpu_round3_capture2.sh runs, so the
+BENCHMARKS.md refresh is a paste, not a transcription.
+
+Usage: python scripts/summarize_capture.py [bench_out]
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def last_json_line(path: str) -> dict | None:
+    try:
+        with open(path) as f:
+            lines = [ln.strip() for ln in f if ln.strip()]
+    except OSError:
+        return None
+    for ln in reversed(lines):
+        if ln.startswith("{"):
+            try:
+                return json.loads(ln)
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
+def main() -> None:
+    d = sys.argv[1] if len(sys.argv) > 1 else "bench_out"
+    rows = []
+    for name in sorted(os.listdir(d)):
+        if not name.endswith(".out"):
+            continue
+        path = os.path.join(d, name)
+        rec = last_json_line(path)
+        base = name[:-4]
+        if rec and "value" in rec:
+            det = rec.get("detail", {})
+            if "error" in det or not det:
+                # failed run: surface the error, never a fake data row
+                print(f"### {base}: FAILED — {det.get('error', rec)}")
+                print()
+                continue
+            mode = (
+                "robust" if det["robust"] else "fast"
+            ) if "robust" in det else "?"
+            compile_note = (
+                "(cache-on)" if det.get("compile_cache_enabled") else ""
+            )
+            rows.append(
+                (
+                    base,
+                    f"{rec['value']/1e6:.2f} Mseg/s",
+                    f"{rec.get('vs_baseline', 0):.3f}",
+                    mode,
+                    det.get("tally_scatter", "?"),
+                    det.get("gathers", "?"),
+                    f"{det.get('elapsed_s', 0)}s/"
+                    f"{det.get('compile_s', 0)}s{compile_note}",
+                )
+            )
+        else:
+            # free-form outputs (profile, sweeps): show their tail lines
+            with open(path) as f:
+                tail = [ln.rstrip() for ln in f if ln.strip()][-8:]
+            print(f"### {base}")
+            for ln in tail:
+                print(f"    {ln}")
+            print()
+    if rows:
+        print("| run | rate | vs_baseline | mode | scatter | gathers "
+              "| run/compile |")
+        print("|---|---|---|---|---|---|---|")
+        for r in rows:
+            print("| " + " | ".join(r) + " |")
+
+
+if __name__ == "__main__":
+    main()

@@ -42,6 +42,9 @@ run bench_v3b_splitg env BENCH_GATHERS=split BENCH_EVENT=0 BENCH_PROBE=0 \
 # 5. combined fast candidate (no hardening, pair scatter, split gathers)
 run bench_v3b_allfast env BENCH_ROBUST=0 BENCH_SCATTER=pair \
     BENCH_GATHERS=split BENCH_EVENT=0 BENCH_PROBE=0 python bench.py
+# 5b. ledger cost (conservation track-length accumulator on/off)
+run bench_v3b_noledger env BENCH_LEDGER=0 BENCH_EVENT=0 BENCH_PROBE=0 \
+    python bench.py
 # 6. walk cost split (full/fast/notally/nosq)
 run profile_v3b python scripts/profile_walk_v2.py 55 1048576 5
 # 7. compaction-ladder candidates

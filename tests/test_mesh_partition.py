@@ -198,6 +198,12 @@ def test_partitioned_matches_single_chip(box):
     got_global[pid[sel]] = part.local2global[chip[sel], elem_l[sel]]
     np.testing.assert_array_equal(got_global, np.asarray(ref.elem))
     assert int(np.sum(np.asarray(res.n_segments))) == int(ref.n_segments)
+    # Conservation ledger across cuts: each particle's scored track
+    # length (which migrates with it) must equal the single-chip
+    # walk's — a double- or missed-scored cut segment shows up here.
+    np.testing.assert_allclose(
+        got["track_length"], np.asarray(ref.track_length), atol=1e-12
+    )
 
 
 def test_partitioned_material_boundaries(two_region_box):

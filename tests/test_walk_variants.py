@@ -71,6 +71,23 @@ def _assert_same(got, base, flux_exact=True):
     assert bool(np.asarray(got.done).all())
 
 
+def test_track_length_ledger(setup):
+    """TraceResult.track_length is the per-particle conservation ledger:
+    it must equal the net straight-line displacement (all movement is
+    along the ray), and weighted by particle weight it must sum to the
+    Σc flux total (every scored segment lands in exactly one bin)."""
+    mesh, _, args, kw, base = setup
+    tl = np.asarray(base.track_length)
+    disp = np.linalg.norm(
+        np.asarray(base.position) - np.asarray(args[1]), axis=1
+    )
+    np.testing.assert_allclose(tl, disp, atol=5e-6)
+    w = np.asarray(args[5])
+    np.testing.assert_allclose(
+        np.asarray(base.flux[..., 0]).sum(), (tl * w).sum(), rtol=1e-5
+    )
+
+
 def test_unpacked_fallback_matches_packed(setup):
     """The four-gather fallback body must produce BIT-IDENTICAL results to
     the packed geo20 body — same floating-point operations, different table
@@ -101,8 +118,8 @@ def test_robust_off_matches_on_clean_mesh(setup, body):
 @pytest.mark.parametrize(
     "knob",
     [dict(tally_scatter="pair"), dict(gathers="split"),
-     dict(tally_scatter="pair", gathers="split")],
-    ids=["pair-scatter", "split-gathers", "both"],
+     dict(tally_scatter="pair", gathers="split"), dict(ledger=False)],
+    ids=["pair-scatter", "split-gathers", "both", "no-ledger"],
 )
 def test_scatter_gather_strategies_bit_identical(setup, knob):
     """The tally-scatter strategy (one interleaved 2m-row scatter vs a
@@ -115,6 +132,12 @@ def test_scatter_gather_strategies_bit_identical(setup, knob):
         mesh, *args[1:], make_flux(mesh.ntet, 2, jnp.float32), **kw, **knob
     )
     _assert_same(got, base, flux_exact=True)
+    if knob.get("ledger", True):
+        np.testing.assert_array_equal(
+            np.asarray(got.track_length), np.asarray(base.track_length)
+        )
+    else:
+        assert got.track_length is None
 
 
 @pytest.mark.parametrize("body", ["packed", "unpacked"])
