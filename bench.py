@@ -11,8 +11,12 @@ walk+scatter kernel (plus one scalar readback per run at the end).
 
 Knobs (env): BENCH_CELLS (default 55 → 6*55^3 = 997,500 tets),
 BENCH_PARTICLES (1048576), BENCH_STEPS (10), BENCH_GROUPS (8),
-BENCH_DTYPE (float32), BENCH_UNROLL (8). Prints exactly ONE JSON line on
-stdout.
+BENCH_DTYPE (float32), BENCH_UNROLL (8), walk strategy A/B knobs
+BENCH_ROBUST/BENCH_SCATTER/BENCH_GATHERS/BENCH_LEDGER, and
+BENCH_FUSED=1 to run all steps in ONE device program (lax.fori_loop) —
+pure device time, immune to per-dispatch tunnel latency; the gap to the
+default per-step mode is the dispatch overhead. Prints exactly ONE JSON
+line on stdout.
 """
 from __future__ import annotations
 
@@ -86,8 +90,7 @@ def run(
 
     import functools
 
-    @functools.partial(jax.jit, donate_argnums=(1, 2, 3))
-    def step(key, origin, elem, flux):
+    def one_step(key, origin, elem, flux):
         kd, kl = jax.random.split(key)
         direction = jax.random.normal(kd, (n_particles, 3), dtype)
         direction = direction / jnp.linalg.norm(
@@ -113,27 +116,73 @@ def run(
         )
         return r.position, r.elem, r.flux, r.n_segments, r.n_crossings
 
+    step = functools.partial(jax.jit, donate_argnums=(1, 2, 3))(one_step)
+
+    # Fused mode: all `steps` advances inside ONE device program
+    # (lax.fori_loop over precomputed keys) — a single dispatch and a
+    # single readback, so the number is pure device time even when the
+    # remote tunnel adds seconds of per-call round-trip. The per-step
+    # mode (default) matches the reference's one-launch-per-move shape;
+    # the gap between the two IS the dispatch overhead.
+    fused = os.environ.get("BENCH_FUSED", "0") == "1"
+
+    @functools.partial(jax.jit, donate_argnums=(1, 2, 3))
+    def run_fused(keys, origin, elem, flux):
+        import jax.lax as lax
+
+        def body(i, c):
+            origin, elem, flux, tot, _ = c
+            pos, el, fl, nseg, ncross = one_step(keys[i], origin, elem, flux)
+            return pos, el, fl, tot + nseg, ncross
+
+        nseg_dtype = (
+            jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+        )  # matches trace_impl's n_segments carry dtype
+        zero_seg = jnp.sum(in_flight).astype(nseg_dtype) * 0
+        return lax.fori_loop(
+            0, keys.shape[0], body,
+            (origin, elem, flux, zero_seg, jnp.int32(0)),
+        )
+
     key = jax.random.key(seed)
     keys = jax.random.split(key, steps + 2)
 
-    # Warmup / compile.
-    t0 = time.perf_counter()
-    pos, elem_c, flux, nseg, _ = step(keys[0], origin, elem, flux)
-    jax.block_until_ready(pos)
-    compile_s = time.perf_counter() - t0
-    pos, elem_c, flux, nseg, _ = step(keys[1], pos, elem_c, flux)
-    jax.block_until_ready(pos)
+    if fused:
+        # Warmup/compile with a 1-step fused program shape? No — the
+        # fused program's shape depends on `steps`, so warm the REAL
+        # shape once (its result is discarded) and time the second call.
+        t0 = time.perf_counter()
+        pos, elem_c, flux, tot, ncross = run_fused(
+            keys[2:], origin, elem, flux
+        )
+        int(np.asarray(tot))
+        compile_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        pos, elem_c, flux, tot, ncross = run_fused(keys[2:], pos, elem_c, flux)
+        total_segments = int(np.asarray(tot))
+        elapsed = time.perf_counter() - t0
+    else:
+        # Warmup / compile.
+        t0 = time.perf_counter()
+        pos, elem_c, flux, nseg, _ = step(keys[0], origin, elem, flux)
+        jax.block_until_ready(pos)
+        compile_s = time.perf_counter() - t0
+        pos, elem_c, flux, nseg, _ = step(keys[1], pos, elem_c, flux)
+        jax.block_until_ready(pos)
 
-    total_segments = 0
-    t0 = time.perf_counter()
-    for i in range(steps):
-        pos, elem_c, flux, nseg, ncross = step(keys[2 + i], pos, elem_c, flux)
-        total_segments += nseg  # device-side accumulate; read once at end
-    # Host readback of a value depending on every step — a stricter fence
-    # than block_until_ready on one output buffer (which proved unreliable
-    # under the remote-TPU runtime; see scripts/sweep_unroll.py).
-    total_segments = int(np.asarray(total_segments))
-    elapsed = time.perf_counter() - t0
+        total_segments = 0
+        t0 = time.perf_counter()
+        for i in range(steps):
+            pos, elem_c, flux, nseg, ncross = step(
+                keys[2 + i], pos, elem_c, flux
+            )
+            total_segments += nseg  # device-side accumulate; read at end
+        # Host readback of a value depending on every step — a stricter
+        # fence than block_until_ready on one output buffer (which proved
+        # unreliable under the remote-TPU runtime; see
+        # scripts/sweep_unroll.py).
+        total_segments = int(np.asarray(total_segments))
+        elapsed = time.perf_counter() - t0
 
     segments_per_sec = total_segments / elapsed
 
@@ -181,6 +230,7 @@ def run(
             "tally_scatter": tally_scatter,
             "gathers": gathers,
             "ledger": ledger,
+            "fused_steps": fused,
             # Whether a persistent compile cache was ENABLED (not whether
             # this compile hit it — a cold first run still pays the real
             # remote compile). compile_s under an enabled+warm cache

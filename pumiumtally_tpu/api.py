@@ -206,6 +206,7 @@ class PumiTally:
                 robust=self.config.robust,
                 tally_scatter=self.config.tally_scatter,
                 gathers=self.config.gathers,
+                ledger=self.config.ledger,
                 record_xpoints=self.config.record_xpoints,
             )
             self.flux = result.flux
@@ -285,6 +286,7 @@ class PumiTally:
                 robust=cfg.robust,
                 tally_scatter=cfg.tally_scatter,
                 gathers=cfg.gathers,
+            ledger=cfg.ledger,
                 record_xpoints=cfg.record_xpoints,
             )
             self.flux = result.flux

@@ -126,6 +126,7 @@ class StreamingTallyPipeline:
             robust=cfg.robust,
             tally_scatter=cfg.tally_scatter,
             gathers=cfg.gathers,
+            ledger=cfg.ledger,
             record_xpoints=cfg.record_xpoints,
         )
         # The flux chain threads through every batch (donated each step);

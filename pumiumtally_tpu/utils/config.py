@@ -72,6 +72,10 @@ class TallyConfig:
         well-behaved.
       tally_scatter / gathers: walk scheduling strategies (ops/walk.py
         docstring) — benchmark-tunable, numerically identical.
+      ledger: accumulate the per-particle track-length conservation
+        ledger (TraceResult.track_length; required by the debug_checks
+        consistency assert). One elementwise op per crossing — off only
+        when squeezing the last percent from the hot loop.
     """
 
     n_groups: int = 2
@@ -92,6 +96,7 @@ class TallyConfig:
     robust: bool = True
     tally_scatter: str = "interleaved"
     gathers: str = "merged"
+    ledger: bool = True
 
     def resolve_max_crossings(self, ntet: int) -> int:
         if self.max_crossings is not None:
