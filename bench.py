@@ -44,6 +44,7 @@ def run(
     tally_scatter: str = "interleaved",
     gathers: str = "merged",
     ledger: bool = True,
+    fused: bool = False,
 ) -> dict:
     import jax
 
@@ -124,8 +125,6 @@ def run(
     # remote tunnel adds seconds of per-call round-trip. The per-step
     # mode (default) matches the reference's one-launch-per-move shape;
     # the gap between the two IS the dispatch overhead.
-    fused = os.environ.get("BENCH_FUSED", "0") == "1"
-
     @functools.partial(jax.jit, donate_argnums=(1, 2, 3))
     def run_fused(keys, origin, elem, flux):
         import jax.lax as lax
@@ -138,10 +137,9 @@ def run(
         nseg_dtype = (
             jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
         )  # matches trace_impl's n_segments carry dtype
-        zero_seg = jnp.sum(in_flight).astype(nseg_dtype) * 0
         return lax.fori_loop(
             0, keys.shape[0], body,
-            (origin, elem, flux, zero_seg, jnp.int32(0)),
+            (origin, elem, flux, jnp.zeros((), nseg_dtype), jnp.int32(0)),
         )
 
     key = jax.random.key(seed)
@@ -478,6 +476,7 @@ def main() -> None:
         tally_scatter=os.environ.get("BENCH_SCATTER", "interleaved"),
         gathers=os.environ.get("BENCH_GATHERS", "merged"),
         ledger=os.environ.get("BENCH_LEDGER", "1") == "1",
+        fused=os.environ.get("BENCH_FUSED", "0") == "1",
     )
     print(
         f"[bench] {result['detail']}", file=sys.stderr

@@ -286,7 +286,7 @@ class PumiTally:
                 robust=cfg.robust,
                 tally_scatter=cfg.tally_scatter,
                 gathers=cfg.gathers,
-            ledger=cfg.ledger,
+                ledger=cfg.ledger,
                 record_xpoints=cfg.record_xpoints,
             )
             self.flux = result.flux

@@ -225,7 +225,10 @@ class TraceResult(NamedTuple):
     n_xpoints: [n] recorded-crossing count per particle (may exceed K,
       in which case only the first K points were kept), or None.
     track_length: [n] per-particle scored track length (Σ segment
-      lengths, unweighted) — the walk's conservation ledger: equals
+      lengths, unweighted) — the reference's per-particle
+      ``total_tracklength_`` surface (compute_total_tracklength,
+      cpp:721-736) kept as a running in-walk ledger instead of a
+      post-hoc reduction. Doubles as the conservation invariant: equals
       |position − origin| to fp accumulation (asserted under
       debug_checks, the reference's cpp:618-629 consistency print);
       zeros on initial-search traces (nothing is scored).

@@ -153,3 +153,48 @@ def test_fuzz_walk_termination_and_conservation(seed):
     ).sum()
     tallied = float(np.asarray(r.flux)[..., 0].sum())
     assert tallied == pytest.approx(path, abs=max(5e-4, 1e-5 * path))
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_fuzz_truncate_mode_fails_safe(seed):
+    """robust=False (reference-parity truncate mode) on adversarial rays:
+    a degeneracy may legitimately truncate the walk (done=False — the
+    reference prints "Not all particles are found"), but it must FAIL
+    SAFE: finite positions inside the domain envelope, in-range parent
+    elements, finite flux, and the conservation ledger still equal to
+    the net displacement (movement never leaves the ray)."""
+    rng = np.random.default_rng(300 + seed)
+    mesh = _jittered_mesh(5, 0.2, seed=400 + seed, dtype=jnp.float32)
+    n = 256
+    elem = jnp.asarray(rng.integers(0, mesh.ntet, n).astype(np.int32))
+    origin = np.asarray(mesh.centroids())[np.asarray(elem)]
+    dest = rng.uniform(0.02, 0.98, (n, 3))
+    dest[:64, 1:] = origin[:64, 1:]  # grazing pure-x rays
+    verts = np.asarray(mesh.coords)
+    dest[64:128] = verts[rng.integers(0, verts.shape[0], 64)] + rng.normal(
+        0, 1e-7, (64, 3)
+    )
+    r = trace_impl(
+        mesh,
+        jnp.asarray(origin, jnp.float32),
+        jnp.asarray(dest, jnp.float32),
+        elem,
+        jnp.ones(n, bool),
+        jnp.ones(n, jnp.float32),
+        jnp.zeros(n, jnp.int32),
+        jnp.full(n, -1, jnp.int32),
+        make_flux(mesh.ntet, 1, jnp.float32),
+        initial=False, max_crossings=192, tolerance=1e-6, robust=False,
+    )
+    pos = np.asarray(r.position)
+    assert np.isfinite(pos).all()
+    assert (pos > -0.01).all() and (pos < 1.01).all()
+    el = np.asarray(r.elem)
+    assert ((el >= 0) & (el < mesh.ntet)).all()
+    flux = np.asarray(r.flux)
+    assert np.isfinite(flux).all() and (flux >= 0).all()
+    # Ledger: scored length == net displacement per particle, truncated
+    # or not (generous f32 envelope for ~200-crossing accumulation).
+    tl = np.asarray(r.track_length)
+    disp = np.linalg.norm(pos - origin, axis=1)
+    np.testing.assert_allclose(tl, disp, atol=2e-4)
