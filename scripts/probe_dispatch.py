@@ -8,6 +8,7 @@ Usage: python scripts/probe_dispatch.py
 """
 from __future__ import annotations
 
+import os
 import time
 
 import numpy as np
@@ -15,6 +16,9 @@ import numpy as np
 
 def main():
     import jax
+
+    if os.environ.get("PUMI_FORCE_CPU") == "1":
+        jax.config.update("jax_platforms", "cpu")  # rehearsal mode
     import jax.numpy as jnp
 
     f = jax.jit(lambda x: x + 1.0)

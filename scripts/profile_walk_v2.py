@@ -26,6 +26,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def main():
     import jax
+
+    if os.environ.get("PUMI_FORCE_CPU") == "1":
+        jax.config.update("jax_platforms", "cpu")  # rehearsal mode
     import jax.numpy as jnp
 
     from pumiumtally_tpu import build_box, make_flux

@@ -5,19 +5,25 @@ Single-stage compaction makes every compacted subset carry the walk's full
 lanes finish (1M → n/2 at 16 → n/8 at 32 → tail), saving the wasted
 full-width crossings between 16 and 32.
 
-Usage: python scripts/sweep_stages.py [cells] [steps]
+Usage: python scripts/sweep_stages.py [cells] [steps] [particles]
 """
 from __future__ import annotations
 
 import functools
+import os
 import sys
 import time
 
 import numpy as np
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 
 def main():
     import jax
+
+    if os.environ.get("PUMI_FORCE_CPU") == "1":
+        jax.config.update("jax_platforms", "cpu")  # rehearsal mode
     import jax.numpy as jnp
 
     from pumiumtally_tpu import build_box, make_flux
@@ -25,7 +31,7 @@ def main():
 
     cells = int(sys.argv[1]) if len(sys.argv) > 1 else 55
     steps = int(sys.argv[2]) if len(sys.argv) > 2 else 3
-    n = 1048576
+    n = int(sys.argv[3]) if len(sys.argv) > 3 else 1048576
     n_groups = 8
     dtype = jnp.float32
 
