@@ -378,7 +378,8 @@ def run_event_loop(
 
 def _stages_from_env() -> tuple | str | None:
     """Resolve the compaction schedule from env:
-      BENCH_STAGES="16:524288,24:262144" → explicit schedule
+      BENCH_STAGES="16:524288,24:262144" → explicit schedule (a third
+        :N on an entry overrides the unroll for that stage)
       BENCH_STAGES=none                  → no staged schedule (the
         single-stage BENCH_COMPACT_AFTER/BENCH_COMPACT_SIZE knobs apply)
       BENCH_COMPACT_AFTER/SIZE set       → same fallthrough to single-stage
@@ -388,10 +389,17 @@ def _stages_from_env() -> tuple | str | None:
     if stages == "none":
         return None
     if stages:
-        return tuple(
-            (int(a), int(b))
-            for a, b in (p.split(":") for p in stages.split(","))
+        entries = tuple(
+            tuple(int(x) for x in p.split(":"))
+            for p in stages.split(",")
         )
+        for e in entries:
+            if len(e) not in (2, 3):
+                raise ValueError(
+                    "BENCH_STAGES entries must be start:size[:unroll], "
+                    f"got {':'.join(map(str, e))!r}"
+                )
+        return entries
     if os.environ.get("BENCH_COMPACT_AFTER") or os.environ.get(
         "BENCH_COMPACT_SIZE"
     ):

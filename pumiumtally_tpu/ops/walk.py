@@ -307,7 +307,10 @@ def trace_impl(
         to completion (identical semantics to compact_after/compact_size,
         which are sugar for a single stage). Lanes that don't fit a
         stage's width simply wait for a later stage — the final stage
-        guarantees completion.
+        guarantees completion. A stage entry may carry an optional third
+        element ``(start, size, unroll)`` overriding the walk unroll for
+        that stage — narrow tail stages are while-iteration-bound, so
+        they often want a larger factor than the full-width phase.
       unroll: crossings advanced per while-loop iteration. The body is a
         no-op for already-done lanes, so semantics are unchanged; unrolling
         amortizes the per-iteration dispatch overhead of a TPU while_loop
@@ -708,7 +711,7 @@ def trace_impl(
 
         return body
 
-    def run_phase(body, carry, bound):
+    def run_phase(body, carry, bound, unroll=unroll):
         if unroll > 1:
             inner = body
 
@@ -736,7 +739,13 @@ def trace_impl(
             raise ValueError(
                 "compact_stages must be None or a non-empty schedule"
             )
-        starts = [s for s, _ in compact_stages]
+        for st in compact_stages:
+            if len(st) not in (2, 3):
+                raise ValueError(
+                    "compact_stages entries must be (start, size) or "
+                    f"(start, size, unroll): {st!r}"
+                )
+        starts = [st[0] for st in compact_stages]
         if starts != sorted(set(starts)):
             raise ValueError(
                 f"compact_stages starts must be strictly increasing: {starts}"
@@ -765,7 +774,7 @@ def trace_impl(
         (cur, elem, done, mat, flux, nseg, prev, stuck, pseg,
          it) = run_phase(full_body, carry, phase1_bound)
 
-    def compact_round(state, S, bound):
+    def compact_round(state, S, bound, stage_unroll=unroll):
         """One compaction round: gather the first S active lanes, advance
         them up to `bound` crossings, scatter results back.
 
@@ -789,7 +798,7 @@ def trace_impl(
             flux, nseg, prev[idx], stuck[idx], pseg[idx], jnp.int32(0),
         )
         (scur, selem, sdone, smat, flux, nseg, sprev, sstuck, spseg,
-         sit) = run_phase(sub_body, sub_carry, bound)
+         sit) = run_phase(sub_body, sub_carry, bound, unroll=stage_unroll)
         idx_sb = jnp.where(valid, idx, n)
         cur = cur.at[idx_sb].set(scur, mode="drop")
         elem = elem.at[idx_sb].set(selem, mode="drop")
@@ -803,8 +812,9 @@ def trace_impl(
 
     if compact_stages is not None and phase1_bound < max_crossings:
         state = (cur, elem, done, mat, flux, nseg, prev, stuck, pseg, it)
-        for i, (start, size) in enumerate(compact_stages):
+        for i, (start, size, *rest) in enumerate(compact_stages):
             S = min(n, max(int(size), 1))
+            s_unroll = int(rest[0]) if rest else unroll
             if i + 1 < len(compact_stages):
                 # Intermediate stage: one bounded round; leftovers wait.
                 # Guarded so an all-done batch skips the argsort +
@@ -815,7 +825,7 @@ def trace_impl(
                     state = jax.lax.cond(
                         jnp.all(state[2]),
                         lambda s: s,
-                        lambda s: compact_round(s, S, span),
+                        lambda s: compact_round(s, S, span, s_unroll),
                         state,
                     )
             else:
@@ -824,7 +834,7 @@ def trace_impl(
 
                 def outer_body(c):
                     *st, rounds = c
-                    st = compact_round(tuple(st), S, max_crossings)
+                    st = compact_round(tuple(st), S, max_crossings, s_unroll)
                     return (*st, rounds + 1)
 
                 def outer_cond(c):

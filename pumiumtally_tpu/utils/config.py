@@ -132,6 +132,7 @@ class TallyConfig:
         ):
             return None
         return tuple(
-            (int(start), min(max(int(size), 1), n_particles))
-            for start, size in self.compact_stages
+            (int(start), min(max(int(size), 1), n_particles),
+             *(int(u) for u in rest))
+            for start, size, *rest in self.compact_stages
         )

@@ -1,0 +1,22 @@
+"""Backend-selection helpers shared by the benchmark/capture scripts."""
+from __future__ import annotations
+
+import os
+
+
+def maybe_force_cpu() -> bool:
+    """Pin JAX to the CPU backend when PUMI_FORCE_CPU=1.
+
+    Env ``JAX_PLATFORMS=cpu`` is overridden by the site's TPU plugin
+    registration; only the config update reliably wins (see
+    tests/conftest.py). Lets benches/sweeps run (as rehearsal, or while
+    the TPU tunnel is down — numbers are then CPU-only, not
+    comparable). Call after ``import jax`` but before any backend use.
+    Returns True when the override was applied.
+    """
+    if os.environ.get("PUMI_FORCE_CPU") == "1":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        return True
+    return False

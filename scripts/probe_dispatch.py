@@ -9,16 +9,20 @@ Usage: python scripts/probe_dispatch.py
 from __future__ import annotations
 
 import os
+import sys
 import time
 
 import numpy as np
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 
 def main():
-    import jax
+    import jax  # noqa: F401 — must import before the backend pin
 
-    if os.environ.get("PUMI_FORCE_CPU") == "1":
-        jax.config.update("jax_platforms", "cpu")  # rehearsal mode
+    from pumiumtally_tpu.utils.platform import maybe_force_cpu
+
+    maybe_force_cpu()
     import jax.numpy as jnp
 
     f = jax.jit(lambda x: x + 1.0)

@@ -25,10 +25,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main():
-    import jax
+    import jax  # noqa: F401 — must import before the backend pin
 
-    if os.environ.get("PUMI_FORCE_CPU") == "1":
-        jax.config.update("jax_platforms", "cpu")  # rehearsal mode
+    from pumiumtally_tpu.utils.platform import maybe_force_cpu
+
+    maybe_force_cpu()
     import jax.numpy as jnp
 
     from pumiumtally_tpu import build_box, make_flux

@@ -20,10 +20,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main():
-    import jax
+    import jax  # noqa: F401 — must import before the backend pin
 
-    if os.environ.get("PUMI_FORCE_CPU") == "1":
-        jax.config.update("jax_platforms", "cpu")  # rehearsal mode
+    from pumiumtally_tpu.utils.platform import maybe_force_cpu
+
+    maybe_force_cpu()
     import jax.numpy as jnp
 
     from pumiumtally_tpu import build_box, make_flux
@@ -100,6 +101,15 @@ def main():
             compact_stages=((8, 5 * M // 8), (16, 3 * M // 8), (24, M // 4),
                             (32, M // 8), (48, M // 16), (64, M // 32),
                             (96, M // 64)))),
+        # Per-stage unroll: narrow tail stages are while-iteration-bound,
+        # so give them a larger factor (third tuple element).
+        ("dense_u32tail", dict(
+            compact_stages=((8, 5 * M // 8), (16, 3 * M // 8), (24, M // 4),
+                            (32, M // 8), (48, M // 16, 16),
+                            (64, M // 32, 16), (96, M // 64, 32)))),
+        ("tail64_96_u32", dict(
+            compact_stages=((16, M // 2), (24, M // 4), (40, M // 8),
+                            (64, M // 32, 16), (96, M // 64, 32)))),
     ]
     for name, kw in variants:
         mseg, ms, iters, cs = run(**kw)

@@ -163,8 +163,9 @@ def test_score_squares_off_drops_only_squares(setup, body):
         dict(unroll=4),
         dict(unroll=8, compact_after=4, compact_size=32),
         dict(compact_stages=((4, 64), (8, 48), (16, 24)), unroll=2),
+        dict(compact_stages=((4, 64), (8, 48, 4), (16, 24, 8)), unroll=2),
     ],
-    ids=["unroll", "compact", "stages"],
+    ids=["unroll", "compact", "stages", "stage-unroll"],
 )
 @pytest.mark.parametrize("body", ["packed", "unpacked"])
 def test_variant_matches_baseline(setup, variant, body):
