@@ -46,14 +46,11 @@ def run(
     ledger: bool = True,
     fused: bool = False,
 ) -> dict:
-    import jax
+    import jax  # noqa: F401 — must import before the backend pin
 
-    if os.environ.get("PUMI_FORCE_CPU") == "1":
-        # Env JAX_PLATFORMS=cpu is overridden by the site's TPU plugin
-        # registration; only the config update reliably wins (see
-        # tests/conftest.py). Lets the bench run while the TPU tunnel is
-        # down (numbers are then CPU-only, not comparable).
-        jax.config.update("jax_platforms", "cpu")
+    from pumiumtally_tpu.utils.platform import maybe_force_cpu
+
+    maybe_force_cpu()
     import jax.numpy as jnp
 
     from pumiumtally_tpu import build_box, make_flux
@@ -80,13 +77,23 @@ def run(
     flux = make_flux(mesh.ntet, n_groups, dtype)
 
     if compact_stages == "default":
-        # Tuned on v5e (scripts/sweep_stages.py): narrow the batch as the
-        # walk's long tail thins — n/2 at 16 crossings, n/4 at 24, n/8
-        # from 40 to completion (+16% over single-stage compaction).
+        # The "dense" ladder: stage widths track the measured active-lane
+        # decay (crossings/move mean ~15, exp tail — scripts/plan_ladder.py
+        # measures the exact curve and scores schedules in executed slots,
+        # which is backend-independent). 26.4 Mslots/step vs the round-2
+        # default's 45.8 at bench scale, a predicted ~1.7x; CPU
+        # measurement agrees (scripts/sweep_stages.py). Supersedes the
+        # round-2 3-stage schedule; re-confirm on hardware via
+        # BENCH_STAGES when the tunnel allows.
+        M = n_particles
         compact_stages = (
-            (16, n_particles // 2),
-            (24, n_particles // 4),
-            (40, max(n_particles // 8, 256)),
+            (8, 5 * M // 8),
+            (16, 3 * M // 8),
+            (24, M // 4),
+            (32, M // 8),
+            (48, max(M // 16, 256)),
+            (64, max(M // 32, 256)),
+            (96, max(M // 64, 256)),
         )
 
     import functools
@@ -389,17 +396,17 @@ def _stages_from_env() -> tuple | str | None:
     if stages == "none":
         return None
     if stages:
-        entries = tuple(
-            tuple(int(x) for x in p.split(":"))
-            for p in stages.split(",")
-        )
-        for e in entries:
-            if len(e) not in (2, 3):
+        entries = []
+        for p in stages.split(","):
+            fields = p.split(":")
+            if len(fields) not in (2, 3) or not all(
+                f.strip().lstrip("-").isdigit() for f in fields
+            ):
                 raise ValueError(
-                    "BENCH_STAGES entries must be start:size[:unroll], "
-                    f"got {':'.join(map(str, e))!r}"
+                    f"BENCH_STAGES entries must be start:size[:unroll], got {p!r}"
                 )
-        return entries
+            entries.append(tuple(int(f) for f in fields))
+        return tuple(entries)
     if os.environ.get("BENCH_COMPACT_AFTER") or os.environ.get(
         "BENCH_COMPACT_SIZE"
     ):

@@ -57,10 +57,11 @@ def run_single_chip(name, cells, n_particles, n_groups, steps=5):
 
 
 def run_partitioned(n_devices=8, cells=32, n_particles=65536, steps=3):
-    import jax
+    import jax  # noqa: F401 — must import before the backend pin
 
-    if os.environ.get("PUMI_FORCE_CPU") == "1":
-        jax.config.update("jax_platforms", "cpu")
+    from pumiumtally_tpu.utils.platform import maybe_force_cpu
+
+    maybe_force_cpu()
 
     virtual = os.environ.get("PUMI_LADDER_VIRTUAL") == "1"
     if virtual:
