@@ -158,6 +158,42 @@ def chase_face_choice(sd, elem, it, dtype, interior):
     return jnp.argmax(score, axis=-1).astype(jnp.int32)
 
 
+def normalize_compact_stages(
+    compact_stages, compact_after, compact_size, n, size_floor
+):
+    """Fold the single-stage knobs into a one-entry schedule and validate.
+
+    Shared by the single-chip and partitioned walks: entries are
+    ``(start, size)`` or ``(start, size, unroll)`` with strictly
+    increasing starts; ``size_floor`` is the default subset size when
+    only ``compact_after`` is given. Returns the normalized schedule (or
+    None when compaction is off)."""
+    if compact_stages is None and compact_after is not None:
+        compact_stages = (
+            (
+                compact_after,
+                compact_size if compact_size is not None else size_floor,
+            ),
+        )
+    if compact_stages is not None:
+        if len(compact_stages) == 0:
+            raise ValueError(
+                "compact_stages must be None or a non-empty schedule"
+            )
+        for st in compact_stages:
+            if len(st) not in (2, 3):
+                raise ValueError(
+                    "compact_stages entries must be (start, size) or "
+                    f"(start, size, unroll): {st!r}"
+                )
+        starts = [st[0] for st in compact_stages]
+        if starts != sorted(set(starts)):
+            raise ValueError(
+                f"compact_stages starts must be strictly increasing: {starts}"
+            )
+    return compact_stages
+
+
 def _exp2i(k, dtype):
     """2**k as ``dtype`` for small non-negative integer k (the bump's
     stuck counter, clamped <= 48): assemble the float's exponent bits
@@ -727,29 +763,9 @@ def trace_impl(
 
         return jax.lax.while_loop(cond, body, carry)
 
-    if compact_stages is None and compact_after is not None:
-        compact_stages = (
-            (
-                compact_after,
-                compact_size if compact_size is not None else max(n // 8, 256),
-            ),
-        )
-    if compact_stages is not None:
-        if len(compact_stages) == 0:
-            raise ValueError(
-                "compact_stages must be None or a non-empty schedule"
-            )
-        for st in compact_stages:
-            if len(st) not in (2, 3):
-                raise ValueError(
-                    "compact_stages entries must be (start, size) or "
-                    f"(start, size, unroll): {st!r}"
-                )
-        starts = [st[0] for st in compact_stages]
-        if starts != sorted(set(starts)):
-            raise ValueError(
-                f"compact_stages starts must be strictly increasing: {starts}"
-            )
+    compact_stages = normalize_compact_stages(
+        compact_stages, compact_after, compact_size, n, max(n // 8, 256)
+    )
 
     full_body = make_body(dest, in_flight, weight, group)
     phase1_bound = (

@@ -65,7 +65,12 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..parallel.mesh_partition import MeshPartition
 from ..parallel.particle_sharding import PARTICLE_AXIS as AXIS
 from .geometry import exit_face
-from .walk import chase_face_choice, escalated_bump, first_k_active
+from .walk import (
+    chase_face_choice,
+    escalated_bump,
+    first_k_active,
+    normalize_compact_stages,
+)
 
 
 class PartitionedTraceResult(NamedTuple):
@@ -105,8 +110,8 @@ def _walk_phase(
     tables, cur, dest, elem, done, target, target_elem, material_id,
     weight, group, flux, nseg, valid, prev, stuck, pseg,
     *, initial, tolerance, score_squares, max_crossings, max_local,
-    unroll=1, compact_after=None, compact_size=None, robust=True,
-    tally_scatter="interleaved",
+    unroll=1, compact_after=None, compact_size=None, compact_stages=None,
+    robust=True, tally_scatter="interleaved",
 ):
     """Advance every resident particle until done or pending-migration.
 
@@ -120,7 +125,11 @@ def _walk_phase(
     crossings are compacted into ``compact_size``-lane subsets which loop
     to completion — the straggler scheme of ops/walk.py applied to the
     partitioned body (lanes that froze pending-migration drop out of
-    "active" either way)."""
+    "active" either way). ``compact_stages`` generalizes to the staged
+    ladder with optional per-stage unroll, exactly as in ops/walk.py
+    (entries ``(start, size[, unroll])``, strictly increasing starts;
+    intermediate stages run one bounded round, the final stage loops to
+    completion)."""
     normals_t, faced_t, enc_t, class_t, nbrclass_t, _ = tables
     dtype = cur.dtype
     n_groups = flux.shape[1]
@@ -296,7 +305,7 @@ def _walk_phase(
 
         return body
 
-    def run(body, valid_a, carry, bound):
+    def run(body, valid_a, carry, bound, unroll=unroll):
         if unroll > 1:
             inner = body
 
@@ -312,10 +321,16 @@ def _walk_phase(
 
         return jax.lax.while_loop(cond, body, carry)
 
+    # Normalize the single-stage knobs into a one-entry schedule and
+    # validate — the exact rules of ops/walk.py (shared helper).
+    compact_stages = normalize_compact_stages(
+        compact_stages, compact_after, compact_size, cap, max(cap // 8, 64)
+    )
+
     full_body = make_body(dest, weight, group, valid)
     phase1_bound = (
-        max_crossings if compact_after is None
-        else min(compact_after, max_crossings)
+        max_crossings if compact_stages is None
+        else min(compact_stages[0][0], max_crossings)
     )
     carry = (
         cur, elem, done, target, target_elem, material_id, flux, nseg,
@@ -323,12 +338,8 @@ def _walk_phase(
     )
     carry = run(full_body, valid, carry, phase1_bound)
 
-    if compact_after is not None and phase1_bound < max_crossings:
-        S = min(cap, max(
-            int(compact_size) if compact_size is not None else max(cap // 8, 64),
-            1,
-        ))
-        def compact_round(state):
+    if compact_stages is not None and phase1_bound < max_crossings:
+        def compact_round(state, S, bound, stage_unroll=unroll):
             """Gather the first S active lanes, advance them until done or
             pending, scatter back (first_k_active, shared with walk.py)."""
             (cur, elem, done, target, target_elem, material_id, flux,
@@ -346,7 +357,7 @@ def _walk_phase(
             )
             (scur, selem, sdone, star, stare, smat, flux, nseg, sprev,
              sstuck, spseg, sit) = run(
-                sub_body, sub_ok, sub_carry, max_crossings
+                sub_body, sub_ok, sub_carry, bound, unroll=stage_unroll
             )
             idx_sb = jnp.where(sub_ok, idx, cap)
             cur = cur.at[idx_sb].set(scur, mode="drop")
@@ -361,24 +372,49 @@ def _walk_phase(
             return (cur, elem, done, target, target_elem, material_id,
                     flux, nseg, prev, stuck, pseg, it + sit)
 
-        # Each round retires >= S active lanes (to done or pending) or all
-        # of them, so ceil(cap/S)+1 rounds always suffice.
-        max_rounds = -(-cap // S) + 1
+        def any_active(c):
+            done, target = c[2], c[3]
+            return jnp.any(valid & ~done & (target < 0))
 
-        def outer_body(c):
-            *st, rounds = c
-            st = compact_round(tuple(st))
-            return (*st, rounds + 1)
+        for i, (start, size, *rest) in enumerate(compact_stages):
+            S = min(cap, max(int(size), 1))
+            s_unroll = int(rest[0]) if rest else unroll
+            if i + 1 < len(compact_stages):
+                # Intermediate stage: one bounded round; leftovers wait
+                # for a later stage (the final one mops up).
+                span = (
+                    min(compact_stages[i + 1][0], max_crossings) - start
+                )
+                if span > 0:
+                    carry = jax.lax.cond(
+                        any_active(carry),
+                        lambda c: compact_round(c, S, span, s_unroll),
+                        lambda c: c,
+                        carry,
+                    )
+            else:
+                # Final stage: loop rounds to completion. Each round
+                # retires >= S active lanes (to done or pending) or all
+                # of them, so ceil(cap/S)+1 rounds always suffice.
+                max_rounds = -(-cap // S) + 1
 
-        def outer_cond(c):
-            (cur, elem, done, target, *_rest), rounds = c[:-1], c[-1]
-            active = valid & ~done & (target < 0)
-            return jnp.logical_and(rounds < max_rounds, jnp.any(active))
+                def outer_body(c):
+                    *st, rounds = c
+                    st = compact_round(
+                        tuple(st), S, max_crossings, s_unroll
+                    )
+                    return (*st, rounds + 1)
 
-        *carry, _ = jax.lax.while_loop(
-            outer_cond, outer_body, (*carry, jnp.int32(0))
-        )
-        carry = tuple(carry)
+                def outer_cond(c):
+                    rounds = c[-1]
+                    return jnp.logical_and(
+                        rounds < max_rounds, any_active(c[:-1])
+                    )
+
+                *carry, _ = jax.lax.while_loop(
+                    outer_cond, outer_body, (*carry, jnp.int32(0))
+                )
+                carry = tuple(carry)
 
     # Strip the loop counter; prev/stuck return to the caller's carry.
     # The flux rides the loop flat — restore the caller's layout.
@@ -400,6 +436,7 @@ def make_partitioned_step(
     unroll: int = 1,
     compact_after: int | None = None,
     compact_size: int | None = None,
+    compact_stages: tuple | None = None,
     robust: bool = True,
     tally_scatter: str = "interleaved",
 ):
@@ -417,6 +454,9 @@ def make_partitioned_step(
         few passes suffice; truncation shows up as done=False).
       compact_after/compact_size: straggler compaction for each walk
         phase, as in ops/walk.py (default off).
+      compact_stages: staged compaction ladder ((start, size[, unroll]),
+        ...) applied to each walk phase, as in ops/walk.py; overrides
+        the two single-stage knobs.
       robust/tally_scatter: the degeneracy-recovery and tally-scatter
         strategy knobs of ops/walk.py, applied to the partitioned body
         (same semantics, same defaults).
@@ -483,6 +523,7 @@ def make_partitioned_step(
             unroll=unroll,
             compact_after=compact_after,
             compact_size=compact_size,
+            compact_stages=compact_stages,
             robust=robust,
             tally_scatter=tally_scatter,
         )
