@@ -77,24 +77,14 @@ def run(
     flux = make_flux(mesh.ntet, n_groups, dtype)
 
     if compact_stages == "default":
-        # The "dense" ladder: stage widths track the measured active-lane
-        # decay (crossings/move mean ~15, exp tail — scripts/plan_ladder.py
-        # measures the exact curve and scores schedules in executed slots,
-        # which is backend-independent). 26.4 Mslots/step vs the round-2
-        # default's 45.8 at bench scale, a predicted ~1.7x; CPU
-        # measurement agrees (scripts/sweep_stages.py). Supersedes the
+        # The slot-planned dense ladder (ONE definition, shared with
+        # TallyConfig's "auto" — see dense_ladder's docstring and
+        # BENCHMARKS.md "Slot-exact ladder planning"). Supersedes the
         # round-2 3-stage schedule; re-confirm on hardware via
         # BENCH_STAGES when the tunnel allows.
-        M = n_particles
-        compact_stages = (
-            (8, 5 * M // 8),
-            (16, 3 * M // 8),
-            (24, M // 4),
-            (32, M // 8),
-            (48, max(M // 16, 256)),
-            (64, max(M // 32, 256)),
-            (96, max(M // 64, 256)),
-        )
+        from pumiumtally_tpu.utils.config import dense_ladder
+
+        compact_stages = dense_ladder(n_particles)
 
     import functools
 
@@ -269,7 +259,10 @@ def run_event_loop(
 
     rng = np.random.default_rng(seed + 1)
     cfg = TallyConfig(
-        dtype=dtype, n_groups=n_groups, tolerance=1e-6, unroll=8
+        dtype=dtype, n_groups=n_groups, tolerance=1e-6, unroll=8,
+        compact_stages="auto",  # same dense ladder as the kernel bench,
+        # so the event-loop vs kernel gap is dispatch overhead, not a
+        # scheduling difference
     )
     tally = PumiTally(mesh, n_particles, cfg)
     cents = np.asarray(mesh.centroids())

@@ -7,6 +7,20 @@ Every hardcoded constant in the reference becomes a config field here
 """
 from __future__ import annotations
 
+
+def dense_ladder(n_particles: int) -> tuple:
+    """The slot-planned dense compaction ladder (``compact_stages="auto"``
+    and the benchmark default — one definition for both): stage widths
+    track an exponential active-lane decay with mean ~15 crossings/move
+    (scripts/plan_ladder.py scores it at 26.4 Mslots/step vs the
+    3-stage schedule's 45.8 at bench statistics)."""
+    M = n_particles
+    return (
+        (8, 5 * M // 8), (16, 3 * M // 8), (24, M // 4),
+        (32, M // 8), (48, max(M // 16, 256)),
+        (64, max(M // 32, 256)), (96, max(M // 64, 256)),
+    )
+
 import dataclasses
 from typing import Any
 
@@ -33,9 +47,12 @@ class TallyConfig:
         facade disables it automatically for small particle counts.
       compact_size: straggler subset lane count (default n_particles // 8).
       compact_stages: multi-stage compaction schedule
-        ((start_crossing, subset_size), ...) overriding the two knobs
-        above (ops/walk.py docstring); the measured-fastest schedule on
-        v5e is n/2@16 → n/4@24 → n/8@40 (BENCHMARKS.md).
+        ((start_crossing, subset_size[, unroll]), ...) overriding the
+        two knobs above (ops/walk.py docstring), or the string
+        ``"auto"`` for the slot-planned dense ladder — the best known
+        schedule for walks with ~10-20 crossings per move
+        (scripts/plan_ladder.py; BENCHMARKS.md "Slot-exact ladder
+        planning").
       unroll: boundary crossings advanced per while-loop iteration
         (ops/walk.py). The TPU while_loop is dispatch-bound, so unrolling
         the body ~2x's throughput (scripts/sweep_unroll.py); done lanes
@@ -83,7 +100,7 @@ class TallyConfig:
     max_crossings: int | None = None
     compact_after: int | None = 32
     compact_size: int | None = None
-    compact_stages: tuple | None = None
+    compact_stages: tuple | str | None = None
     unroll: int = 8
     migration_period: int = 100
     sort_by_element: bool = False
@@ -124,13 +141,26 @@ class TallyConfig:
 
     def resolve_compact_stages(self, n_particles: int) -> tuple | None:
         """Clamp a configured stage schedule to the batch size (None when
-        unset — the single-stage knobs apply)."""
+        unset — the single-stage knobs apply). The string ``"auto"``
+        selects the dense ladder whose widths track an exponential
+        active-lane decay (scripts/plan_ladder.py scores it at ~0.58x
+        the executed slots of a 3-stage schedule at the benchmark's
+        crossing statistics; harmless when walks are shorter, because
+        each emptied stage is one guarded cheap round)."""
         if (
             self.compact_stages is None
             or n_particles < 1024
             or self.record_xpoints is not None
         ):
             return None
+        if isinstance(self.compact_stages, str):
+            if self.compact_stages != "auto":
+                raise ValueError(
+                    "unknown compact_stages string "
+                    f"{self.compact_stages!r}; expected 'auto' or an "
+                    "explicit ((start, size[, unroll]), ...) schedule"
+                )
+            return dense_ladder(n_particles)
         return tuple(
             (int(start), min(max(int(size), 1), n_particles),
              *(int(u) for u in rest))
