@@ -13,10 +13,11 @@ Knobs (env): BENCH_CELLS (default 55 → 6*55^3 = 997,500 tets),
 BENCH_PARTICLES (1048576), BENCH_STEPS (10), BENCH_GROUPS (8),
 BENCH_DTYPE (float32), BENCH_UNROLL (8), walk strategy A/B knobs
 BENCH_ROBUST/BENCH_SCATTER/BENCH_GATHERS/BENCH_LEDGER, and
-BENCH_FUSED=1 to run all steps in ONE device program (lax.fori_loop) —
-pure device time, immune to per-dispatch tunnel latency; the gap to the
-default per-step mode is the dispatch overhead. Prints exactly ONE JSON
-line on stdout.
+BENCH_FUSED (default 1) runs all steps in ONE device program
+(lax.fori_loop) — pure device time, immune to per-dispatch tunnel
+latency; BENCH_FUSED=0 launches one program per step (the gap between
+the modes is the dispatch overhead). Prints exactly ONE JSON line on
+stdout.
 """
 from __future__ import annotations
 
@@ -44,7 +45,7 @@ def run(
     tally_scatter: str = "interleaved",
     gathers: str = "merged",
     ledger: bool = True,
-    fused: bool = False,
+    fused: bool = True,
 ) -> dict:
     import jax  # noqa: F401 — must import before the backend pin
 
@@ -116,12 +117,12 @@ def run(
 
     step = functools.partial(jax.jit, donate_argnums=(1, 2, 3))(one_step)
 
-    # Fused mode: all `steps` advances inside ONE device program
-    # (lax.fori_loop over precomputed keys) — a single dispatch and a
-    # single readback, so the number is pure device time even when the
-    # remote tunnel adds seconds of per-call round-trip. The per-step
-    # mode (default) matches the reference's one-launch-per-move shape;
-    # the gap between the two IS the dispatch overhead.
+    # Fused mode (the default): all `steps` advances inside ONE device
+    # program (lax.fori_loop over precomputed keys) — a single dispatch
+    # and a single readback, so the number is pure device time even when
+    # the remote tunnel adds seconds of per-call round-trip. fused=False
+    # launches one program per step (the reference's one-launch-per-move
+    # shape); the gap between the two IS the dispatch overhead.
     @functools.partial(jax.jit, donate_argnums=(1, 2, 3))
     def run_fused(keys, origin, elem, flux):
         import jax.lax as lax
@@ -484,7 +485,12 @@ def main() -> None:
         tally_scatter=os.environ.get("BENCH_SCATTER", "interleaved"),
         gathers=os.environ.get("BENCH_GATHERS", "merged"),
         ledger=os.environ.get("BENCH_LEDGER", "1") == "1",
-        fused=os.environ.get("BENCH_FUSED", "0") == "1",
+        # Fused is the DEFAULT: the headline is a device-resident kernel
+        # measurement, and one fori_loop dispatch keeps it immune to the
+        # remote tunnel's per-dispatch latency swings (observed ~1 s/call
+        # in degraded windows). BENCH_FUSED=0 restores one-launch-per-step
+        # (the per-move launch shape; its gap to fused IS that overhead).
+        fused=os.environ.get("BENCH_FUSED", "1") == "1",
     )
     print(
         f"[bench] {result['detail']}", file=sys.stderr

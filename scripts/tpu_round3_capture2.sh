@@ -26,12 +26,11 @@ run() {
 
 # 0. tunnel health + dispatch latency (seconds, no big compile)
 run probe_dispatch python scripts/probe_dispatch.py
-# 1. headline, current default (einsum-reuse landed since the 5.43 runs)
+# 1. headline, current default (fused steps, dense ladder, einsum reuse)
 run bench_v3b env BENCH_EVENT=0 BENCH_PROBE=0 python bench.py
-# 1b. fused-steps mode: one dispatch for all steps — pure device time;
-#     the gap to (1) is per-dispatch tunnel overhead, the prime suspect
-#     for the 8.53 -> 5.43 "regression"
-run bench_v3b_fused env BENCH_FUSED=1 BENCH_EVENT=0 BENCH_PROBE=0 \
+# 1b. per-step launch mode: the gap to (1) is per-dispatch tunnel
+#     overhead, the prime suspect for the 8.53 -> 5.43 "regression"
+run bench_v3b_perstep env BENCH_FUSED=0 BENCH_EVENT=0 BENCH_PROBE=0 \
     python bench.py
 # 2. headline, robust=False (hardening cost at full scale)
 run bench_v3b_fast env BENCH_ROBUST=0 BENCH_EVENT=0 BENCH_PROBE=0 \
