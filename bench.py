@@ -80,12 +80,19 @@ def run(
     if compact_stages == "default":
         # The slot-planned dense ladder (ONE definition, shared with
         # TallyConfig's "auto" — see dense_ladder's docstring and
-        # BENCHMARKS.md "Slot-exact ladder planning"). Supersedes the
-        # round-2 3-stage schedule; re-confirm on hardware via
-        # BENCH_STAGES when the tunnel allows.
+        # BENCHMARKS.md "Slot-exact ladder planning"), with stage STARTS
+        # scaled by mesh density: crossings/move ∝ path/element-size, so
+        # the 55-cell curve's stage boundaries stretch by cells/55
+        # (measured: mean 14.9 at 55 cells → 32.6 at 119). Widths keep
+        # their decay-tracking fractions. Supersedes the round-2 3-stage
+        # schedule; re-confirm on hardware via BENCH_STAGES.
         from pumiumtally_tpu.utils.config import dense_ladder
 
-        compact_stages = dense_ladder(n_particles)
+        scale = max(1.0, cells / 55.0)
+        compact_stages = tuple(
+            (int(round(start * scale)), *rest)
+            for start, *rest in dense_ladder(n_particles)
+        )
 
     import functools
 

@@ -13,6 +13,15 @@ configurable slot-equivalent overhead.
 The absolute per-slot time differs per backend; the RANKING of ladders
 (up to the round-overhead charge) does not.
 
+CAVEAT: the model charges an intermediate stage width x span and lets
+overflow lanes (active > width) "wait, unharmed" — it does NOT price
+the deferred work of that overflow, so schedules whose widths sit far
+below the live count at their starts (e.g. the 55-cell-tuned "dense"
+ladder evaluated on a 119-cell mesh with 2x the crossings) come out
+fake-cheap. Trust the ranking only among schedules whose widths are >=
+the survivor count at each start; scale stage starts with
+crossings/move (≈ cells) when changing mesh density.
+
 Usage: python scripts/plan_ladder.py [cells] [particles] [round_cost_slots]
 """
 from __future__ import annotations
@@ -154,6 +163,11 @@ def main():
                   (32, M // 8), (48, M // 16), (64, M // 32),
                   (96, M // 64)),
         "auto_pow2": pow2_ladder(8, 160, w_of),
+        "dense_x2": tuple(
+            (2 * st, w) for st, w in (
+                (8, 5 * M // 8), (16, 3 * M // 8), (24, M // 4),
+                (32, M // 8), (48, M // 16), (64, M // 32), (96, M // 64))
+        ),
         "every8": tuple(
             (k, max(int(2 ** np.ceil(np.log2(max(act[min(k, kmax)], 1)))),
                     4096))
