@@ -465,7 +465,15 @@ def main() -> None:
                         "value": 0.0,
                         "unit": "segments/s",
                         "vs_baseline": 0.0,
-                        "detail": {"error": err},
+                        "detail": {
+                            "error": err,
+                            "note": (
+                                "TPU tunnel unreachable; last hardware "
+                                "measurements and the pending A/B grid "
+                                "are recorded in BENCHMARKS.md and "
+                                "BENCH_r02.json"
+                            ),
+                        },
                     }
                 )
             )
