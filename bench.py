@@ -468,10 +468,10 @@ def main() -> None:
                         "detail": {
                             "error": err,
                             "note": (
-                                "TPU tunnel unreachable; last hardware "
-                                "measurements and the pending A/B grid "
-                                "are recorded in BENCHMARKS.md and "
-                                "BENCH_r02.json"
+                                "device backend probe failed (error "
+                                "above); last hardware measurements and "
+                                "the pending A/B grid are recorded in "
+                                "BENCHMARKS.md and BENCH_r02.json"
                             ),
                         },
                     }
