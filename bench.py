@@ -42,7 +42,7 @@ def run(
     compact_stages: tuple | str | None = "default",
     unroll: int = 8,
     robust: bool = True,
-    tally_scatter: str = "interleaved",
+    tally_scatter: str = "pair",
     gathers: str = "merged",
     ledger: bool = True,
     fused: bool = True,
@@ -497,7 +497,7 @@ def main() -> None:
         compact_stages=_stages_from_env(),
         unroll=int(os.environ.get("BENCH_UNROLL", "8")),
         robust=os.environ.get("BENCH_ROBUST", "1") == "1",
-        tally_scatter=os.environ.get("BENCH_SCATTER", "interleaved"),
+        tally_scatter=os.environ.get("BENCH_SCATTER", "pair"),
         gathers=os.environ.get("BENCH_GATHERS", "merged"),
         ledger=os.environ.get("BENCH_LEDGER", "1") == "1",
         # Fused is the DEFAULT: the headline is a device-resident kernel

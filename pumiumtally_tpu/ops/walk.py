@@ -52,12 +52,16 @@ geo16 + topo_flat pair (two gathers) and round 1's four separate
 gathers. Material ids are resolved from class *indices* with one
 tiny-table gather after the loop, never per crossing.
 
-Tally scatter: both tally rows (c into slot 0, c² into slot 1) ride ONE
-interleaved scalar scatter-add into the flux viewed flat as
-[ntet*n_groups*2] — keys 2k and 2k+1 — which measures ~11% cheaper than
-two separate scatters and 3.6× cheaper than a 2-wide window scatter
-(scripts/microbench_complex_scatter.py; complex64 packing is
-unimplemented on this TPU backend).
+Tally scatter: the (c, c²) pair goes into the flux viewed flat as
+[ntet*n_groups*2] via a static strategy knob (``tally_scatter``):
+"pair" (default) issues two scalar scatter-adds, "interleaved" one
+2m-row scatter with keys 2k/2k+1. A dedicated in-loop TPU microbench
+measured interleaved ~11% cheaper, but in the real body on CPU the
+concatenate costs up to 5×, so the safer pair is the default until the
+hardware A/B grid (scripts/tpu_round3_capture2.sh) settles it; both are
+bit-identical (disjoint slots) and 3.6× cheaper than a 2-wide window
+scatter; complex64 packing is unimplemented on this TPU backend
+(scripts/microbench_complex_scatter.py).
 
 Degeneracy robustness
 ---------------------
@@ -302,7 +306,7 @@ def trace_impl(
     compact_stages: tuple | None = None,
     unroll: int = 1,
     robust: bool = True,
-    tally_scatter: str = "interleaved",
+    tally_scatter: str = "pair",
     gathers: str = "merged",
     ledger: bool = True,
     debug_checks: bool = False,
@@ -364,12 +368,13 @@ def trace_impl(
         default True except for A/B cost attribution or strict
         reference-parity runs.
       tally_scatter: per-crossing (Σc, Σc²) accumulation strategy.
-        "interleaved" (default) concatenates both rows into ONE 2m-row
-        scalar scatter (c at flat slot 2k, c² at 2k+1); "pair" issues two
-        m-row scatters. Numerically identical (disjoint slots). The
+        "pair" (default) issues two m-row scalar scatters; "interleaved"
+        concatenates both rows into ONE 2m-row scatter (c at flat slot
+        2k, c² at 2k+1). Numerically identical (disjoint slots). The
         strategies trade a concatenate for a second scatter dispatch and
-        measure differently per backend — keep both benchable; ignored
-        when score_squares=False.
+        measure differently per backend (module docstring "Tally
+        scatter") — keep both benchable; ignored when
+        score_squares=False.
       gathers: packed-body table-read strategy. "merged" (default) reads
         the whole geo20 row in one 20-wide gather; "split" reads the
         geometry [.. :16] and bitcast topology [16:20] columns as two
@@ -429,14 +434,14 @@ def trace_impl(
     # device-varying type under shard_map — see nseg0 below.)
     mat0 = material_id * 0 - 2 if packed else material_id
 
-    # The flux rides the loop flat so both tally rows (c at 2k, c² at
-    # 2k+1) go through ONE interleaved scalar scatter per crossing.
+    # The flux rides the loop flat as [ntet*n_groups*2] so both tally
+    # rows land at slots 2k / 2k+1 under either scatter strategy.
     flux_shape = flux.shape
     if flux_shape != (ntet, n_groups, 2):
         raise ValueError(
             f"flux must be [ntet, n_groups, 2] = ({ntet}, {n_groups}, 2); "
-            f"got {flux_shape} — the flat interleaved tally scatter depends "
-            "on the trailing (Σc, Σc²) pair layout"
+            f"got {flux_shape} — the flat stride-2 tally layout carries "
+            "the trailing (Σc, Σc²) pair"
         )
     flux = flux.reshape(-1)
     nbins = ntet * n_groups  # OOB sentinel key; 2·nbins is OOB in flat

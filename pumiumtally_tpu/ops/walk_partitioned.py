@@ -111,7 +111,7 @@ def _walk_phase(
     weight, group, flux, nseg, valid, prev, stuck, pseg,
     *, initial, tolerance, score_squares, max_crossings, max_local,
     unroll=1, compact_after=None, compact_size=None, compact_stages=None,
-    robust=True, tally_scatter="interleaved",
+    robust=True, tally_scatter="pair",
 ):
     """Advance every resident particle until done or pending-migration.
 
@@ -135,10 +135,10 @@ def _walk_phase(
     n_groups = flux.shape[1]
     cap = cur.shape[0]
     tol_floor = 8 * float(jnp.finfo(dtype).eps)
-    # Both tally rows ride ONE interleaved scalar scatter into the flux
-    # viewed flat — same design (and ~11% measured scatter saving) as the
-    # single-chip walk (ops/walk.py "Gather budget"), with the same
-    # guards: the stride-2 layout is load-bearing.
+    # The (c, c²) tally pair goes into the flux viewed flat under the
+    # same tally_scatter strategy knob (and default) as the single-chip
+    # walk — see ops/walk.py's module docstring; the stride-2 layout is
+    # load-bearing either way.
     flux_shape = flux.shape
     if flux_shape != (max_local, n_groups, 2):
         raise ValueError(
@@ -438,7 +438,7 @@ def make_partitioned_step(
     compact_size: int | None = None,
     compact_stages: tuple | None = None,
     robust: bool = True,
-    tally_scatter: str = "interleaved",
+    tally_scatter: str = "pair",
 ):
     """Build the jitted distributed trace step for one mesh partition.
 

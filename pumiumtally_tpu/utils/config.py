@@ -111,7 +111,7 @@ class TallyConfig:
     checkify_invariants: bool = False
     record_xpoints: int | None = None
     robust: bool = True
-    tally_scatter: str = "interleaved"
+    tally_scatter: str = "pair"
     gathers: str = "merged"
     ledger: bool = True
 

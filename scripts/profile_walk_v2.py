@@ -3,7 +3,7 @@ compaction): how much of a step is the tally scatter now that the gather
 side was halved in round 2?
 
 Variants:
-  full    — bench default (interleaved (c, c²) scatter per crossing)
+  full    — bench default (pair (c, c²) scatter per crossing)
   fast    — full tally, robust=False (degeneracy-recovery machinery off:
             no entry-face mask / chase / bump — isolates the hardening
             cost, which never fires on this box mesh)
@@ -46,7 +46,15 @@ def main():
     print(f"mesh: {mesh.ntet} tets, build {time.perf_counter()-t0:.1f}s",
           flush=True)
 
-    stages = ((16, n // 2), (24, n // 4), (40, max(n // 8, 256)))
+    from pumiumtally_tpu.utils.config import dense_ladder
+
+    # Same schedule as the bench headline, including the stage-start
+    # stretch with mesh density (bench.py: crossings/move ~ cells).
+    scale = max(1.0, cells / 55.0)
+    stages = tuple(
+        (int(round(start * scale)), *rest)
+        for start, *rest in dense_ladder(n)
+    )
 
     rng = np.random.default_rng(0)
     elem0 = jnp.asarray(rng.integers(0, mesh.ntet, n).astype(np.int32))

@@ -35,11 +35,11 @@ run bench_v3b_perstep env BENCH_FUSED=0 BENCH_EVENT=0 BENCH_PROBE=0 \
 # 2. headline, robust=False (hardening cost at full scale)
 run bench_v3b_fast env BENCH_ROBUST=0 BENCH_EVENT=0 BENCH_PROBE=0 \
     python bench.py
-# 3. scatter strategy A/B (CPU says "pair" is 40% cheaper; the in-loop
-#    TPU microbench said interleaved is 11% cheaper — settle it in the
-#    real body)
-run bench_v3b_pair env BENCH_SCATTER=pair BENCH_EVENT=0 BENCH_PROBE=0 \
-    python bench.py
+# 3. scatter strategy A/B ("pair" is now the default — CPU says it is
+#    40% cheaper in the real body; the in-loop TPU microbench said
+#    interleaved is 11% cheaper — settle it)
+run bench_v3b_interleaved env BENCH_SCATTER=interleaved BENCH_EVENT=0 \
+    BENCH_PROBE=0 python bench.py
 # 4. gather strategy A/B (merged geo20 vs split 16+4, CPU prefers split)
 run bench_v3b_splitg env BENCH_GATHERS=split BENCH_EVENT=0 BENCH_PROBE=0 \
     python bench.py

@@ -123,7 +123,7 @@ def _single_chip(mesh, elem, origin, dest, weight, group, n_groups=2):
 def _partitioned(mesh, part, elem, origin, dest, weight, group,
                  n_groups=2, exchange_size=None, max_rounds=None,
                  unroll=1, compact_after=None, compact_size=None,
-                 compact_stages=None):
+                 compact_stages=None, tally_scatter="pair"):
     n = len(elem)
     dmesh = make_device_mesh(N_DEV)
     placed = distribute_particles(
@@ -150,6 +150,7 @@ def _partitioned(mesh, part, elem, origin, dest, weight, group,
         compact_after=compact_after,
         compact_size=compact_size,
         compact_stages=compact_stages,
+        tally_scatter=tally_scatter,
     )
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -286,6 +287,23 @@ def test_partitioned_compaction_matches(box):
     np.testing.assert_array_equal(
         got["material_id"], np.asarray(ref.material_id)
     )
+    assert int(np.sum(np.asarray(res.n_segments))) == int(ref.n_segments)
+
+
+def test_partitioned_interleaved_scatter_matches(box):
+    """The interleaved tally-scatter strategy in the partitioned body
+    must be bit-identical to the default pair (disjoint flat slots) —
+    keeps the non-default branch of the hardware A/B covered."""
+    part = partition_mesh(box, N_DEV)
+    elem, origin, dest, weight, group = _random_batch(box, 64, seed=29)
+    ref = _single_chip(box, elem, origin, dest, weight, group)
+    res, got = _partitioned(
+        box, part, elem, origin, dest, weight, group,
+        tally_scatter="interleaved",
+    )
+    assert int(np.sum(np.asarray(res.n_dropped))) == 0
+    g_flux = assemble_global_flux(part, res.flux)
+    np.testing.assert_allclose(g_flux, np.asarray(ref.flux), atol=1e-12)
     assert int(np.sum(np.asarray(res.n_segments))) == int(ref.n_segments)
 
 

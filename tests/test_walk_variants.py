@@ -117,9 +117,10 @@ def test_robust_off_matches_on_clean_mesh(setup, body):
 
 @pytest.mark.parametrize(
     "knob",
-    [dict(tally_scatter="pair"), dict(gathers="split"),
-     dict(tally_scatter="pair", gathers="split"), dict(ledger=False)],
-    ids=["pair-scatter", "split-gathers", "both", "no-ledger"],
+    [dict(tally_scatter="interleaved"), dict(gathers="split"),
+     dict(tally_scatter="interleaved", gathers="split"),
+     dict(ledger=False)],
+    ids=["interleaved-scatter", "split-gathers", "both", "no-ledger"],
 )
 def test_scatter_gather_strategies_bit_identical(setup, knob):
     """The tally-scatter strategy (one interleaved 2m-row scatter vs a
