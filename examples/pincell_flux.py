@@ -20,9 +20,11 @@ sys.path.insert(
 
 import numpy as np
 
-if os.environ.get("PUMI_TPU_PLATFORM"):
-    import jax
+import jax
 
+from pumiumtally_tpu.utils.platform import maybe_force_cpu
+
+if not maybe_force_cpu() and os.environ.get("PUMI_TPU_PLATFORM"):
     jax.config.update("jax_platforms", os.environ["PUMI_TPU_PLATFORM"])
 
 from pumiumtally_tpu import Material, PumiTally, SyntheticTransport, TallyConfig
