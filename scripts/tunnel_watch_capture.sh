@@ -1,0 +1,20 @@
+#!/bin/bash
+# Poll the axon tunnel (subprocess probe — an in-process jax.devices()
+# blocks forever when the tunnel is down); the moment it revives, run
+# the staged hardware capture grid, then exit. Launch detached:
+#   nohup bash scripts/tunnel_watch_capture.sh >/tmp/tw.log 2>&1 &
+# NOTE: one JAX process holds the TPU exclusively — never run anything
+# else against the device while the capture is going.
+cd "$(dirname "$0")/.."
+CAPTURE="${1:-scripts/tpu_round3_capture2.sh}"
+while true; do
+  if timeout 90 python -c "import jax; print(jax.devices())" \
+      >/tmp/tunnel_probe.out 2>&1; then
+    echo "$(date -u +%H:%M:%S) LIVE — starting $CAPTURE"
+    bash "$CAPTURE" > /tmp/capture.log 2>&1
+    echo "$(date -u +%H:%M:%S) capture finished rc=$?"
+    exit 0
+  fi
+  echo "$(date -u +%H:%M:%S) down"
+  sleep 240
+done
