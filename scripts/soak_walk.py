@@ -1,0 +1,68 @@
+"""Extended walk soak: random jittered meshes x adversarial rays x the
+full strategy-knob grid (robust/tally_scatter/gathers, staged ladder
+with per-stage unroll). Asserts termination (robust mode), fail-safe
+truncation (fast mode), the per-particle conservation ledger, and the
+ledger-vs-flux total. A manual, longer-running complement to
+tests/test_jittered_mesh.py — run before shipping walk changes.
+
+Usage: python scripts/soak_walk.py [n_seeds]
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax
+
+from pumiumtally_tpu.utils.platform import maybe_force_cpu
+
+if not maybe_force_cpu():
+    jax.config.update("jax_platforms", "cpu")  # CPU soak by default
+import jax.numpy as jnp
+from pumiumtally_tpu import make_flux
+from pumiumtally_tpu.mesh.box import build_box_arrays
+from pumiumtally_tpu.mesh.core import TetMesh
+from pumiumtally_tpu.ops.walk import trace_impl
+
+fails = 0
+for seed in range(int(sys.argv[1]) if len(sys.argv) > 1 else 12):
+    rng = np.random.default_rng(1000 + seed)
+    nx = int(rng.integers(3, 8)); jitter = float(rng.uniform(0.0, 0.28))
+    coords, tets = build_box_arrays(1.0, 1.0, 1.0, nx, nx, nx)
+    interior = ((coords > 1e-9).all(1) & (coords < 1 - 1e-9).all(1))
+    c = coords.copy(); c[interior] += rng.uniform(-jitter/nx, jitter/nx, (interior.sum(), 3))
+    cid = (c[tets].mean(1)[:, 0] > 0.5).astype(np.int32)
+    try:
+        mesh = TetMesh.from_numpy(c, tets, cid, dtype=jnp.float32)
+    except ValueError:
+        continue  # tangled — correctly rejected
+    n = 256
+    elem = jnp.asarray(rng.integers(0, mesh.ntet, n).astype(np.int32))
+    origin = np.asarray(mesh.centroids())[np.asarray(elem)]
+    dest = rng.uniform(-0.05, 1.05, (n, 3))
+    verts = np.asarray(mesh.coords)
+    dest[:64] = verts[rng.integers(0, len(verts), 64)] + rng.normal(0, 1e-7, (64, 3))
+    dest[64:96, 1:] = origin[64:96, 1:]
+    robust = bool(seed % 2)
+    scatter = ["pair", "interleaved"][seed % 2]
+    gath = ["merged", "split"][(seed // 2) % 2]
+    r = trace_impl(
+        mesh, jnp.asarray(origin, jnp.float32), jnp.asarray(dest, jnp.float32),
+        elem, jnp.ones(n, bool), jnp.ones(n, jnp.float32),
+        jnp.zeros(n, jnp.int32), jnp.full(n, -1, jnp.int32),
+        make_flux(mesh.ntet, 1, jnp.float32),
+        initial=False, max_crossings=mesh.ntet + 64, tolerance=1e-6,
+        robust=robust, tally_scatter=scatter, gathers=gath,
+        compact_stages=((6, max(n//2, 32)), (12, max(n//4, 32), 4)), unroll=2,
+    )
+    pos = np.asarray(r.position); tl = np.asarray(r.track_length)
+    ok = (np.isfinite(pos).all()
+          and np.allclose(tl, np.linalg.norm(pos - origin, axis=1), atol=3e-4)
+          and np.isclose(float(np.asarray(r.flux)[..., 0].sum()), tl.sum(), rtol=1e-4)
+          and (not robust or bool(np.asarray(r.done).all())))
+    print(f"seed {seed}: nx={nx} jitter={jitter:.2f} robust={robust} "
+          f"{scatter}/{gath} done={int(np.asarray(r.done).sum())}/{n} "
+          f"{'OK' if ok else 'FAIL'}", flush=True)
+    fails += 0 if ok else 1
+print("SOAK", "PASS" if fails == 0 else f"{fails} FAILURES")
