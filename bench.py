@@ -401,12 +401,17 @@ def _stages_from_env() -> tuple | str | None:
         for p in stages.split(","):
             fields = p.split(":")
             if len(fields) not in (2, 3) or not all(
-                f.strip().lstrip("-").isdigit() for f in fields
+                f.strip().isdigit() for f in fields
             ):
                 raise ValueError(
                     f"BENCH_STAGES entries must be start:size[:unroll], got {p!r}"
                 )
-            entries.append(tuple(int(f) for f in fields))
+            entry = tuple(int(f) for f in fields)
+            if entry[1] < 1 or (len(entry) == 3 and entry[2] < 1):
+                raise ValueError(
+                    f"BENCH_STAGES size/unroll must be >= 1, got {p!r}"
+                )
+            entries.append(entry)
         return tuple(entries)
     if os.environ.get("BENCH_COMPACT_AFTER") or os.environ.get(
         "BENCH_COMPACT_SIZE"

@@ -265,10 +265,14 @@ class TraceResult(NamedTuple):
     n_xpoints: [n] recorded-crossing count per particle (may exceed K,
       in which case only the first K points were kept), or None.
     track_length: [n] per-particle scored track length (Σ segment
-      lengths, unweighted) — the reference's per-particle
-      ``total_tracklength_`` surface (compute_total_tracklength,
-      cpp:721-736) kept as a running in-walk ledger instead of a
-      post-hoc reduction. Doubles as the conservation invariant: equals
+      lengths, unweighted) — the analog used for the reference's
+      cpp:618-629 consistency check, kept as a running in-walk ledger.
+      NOT byte-identical to ``total_tracklength_``
+      (compute_total_tracklength, cpp:721-736), which stores
+      |dest − orig| of the *requested* move computed before the search:
+      for particles clipped at material stops or domain exits the scored
+      sum here is shorter than that pre-walk distance. Doubles as the
+      conservation invariant: equals
       |position − origin| to fp accumulation (asserted under
       debug_checks, the reference's cpp:618-629 consistency print);
       zeros on initial-search traces (nothing is scored).
@@ -390,9 +394,10 @@ def trace_impl(
       record_xpoints: when set to K, record each particle's first K
         boundary-crossing points into an [n, K, 3] buffer (the tracer's
         getIntersectionPoints() surface, reference test:403-479,
-        561-587). Debug/analysis only: mutually exclusive with the
-        compaction options so the recording never complicates the hot
-        path, which pays nothing when the flag is off.
+        561-587). Composes with compaction: the xp/kx lanes ride the
+        straggler gather/scatter-back like all other per-particle state,
+        so the production config can record too. The hot path pays
+        nothing when the flag is off.
       debug_checks: thread `checkify` device assertions through the walk
         body — the functional analog of the reference's
         OMEGA_H_CHECK_PRINTF kernel asserts (finite intersection points
@@ -469,14 +474,6 @@ def trace_impl(
         )
     if gathers not in ("merged", "split"):
         raise ValueError(f"gathers must be 'merged' or 'split': {gathers!r}")
-    if record_xpoints is not None and (
-        compact_after is not None or compact_stages is not None
-    ):
-        raise ValueError(
-            "record_xpoints is mutually exclusive with straggler "
-            "compaction (it is a debug/analysis surface; disable "
-            "compaction to record intersection points)"
-        )
 
     def make_body(dest_a, in_flight_a, weight_a, group_a):
         """One element-boundary crossing for every lane of a (sub)batch.
@@ -784,16 +781,19 @@ def trace_impl(
         origin, elem, done0, mat0, flux, nseg0, prev0, stuck0, pseg0,
         jnp.int32(0),
     )
-    xp = kx = None
     if record_xpoints is not None:
         xp0 = jnp.zeros((n, int(record_xpoints), 3), dtype)
         kx0 = elem * 0  # per-lane zero (device-varying under shard_map)
         carry = carry[:-1] + (xp0, kx0, jnp.int32(0))
-        (cur, elem, done, mat, flux, nseg, prev, stuck, pseg, xp, kx,
-         it) = run_phase(full_body, carry, phase1_bound)
-    else:
-        (cur, elem, done, mat, flux, nseg, prev, stuck, pseg,
-         it) = run_phase(full_body, carry, phase1_bound)
+    # Generic unpack: *xpk is () normally, (xp, kx) when recording —
+    # compaction rounds carry the recording lanes like any other
+    # per-particle state, so the two features compose.
+    # Static guard: a stage-0 schedule must not compile the dead
+    # full-width while_loop at all.
+    if phase1_bound > 0:
+        carry = run_phase(full_body, carry, phase1_bound)
+    (cur, elem, done, mat, flux, nseg, prev, stuck, pseg, *xpk,
+     it) = carry
 
     def compact_round(state, S, bound, stage_unroll=unroll):
         """One compaction round: gather the first S active lanes, advance
@@ -803,8 +803,14 @@ def trace_impl(
         stable partition) instead of argsort — same first-S-active
         selection, far cheaper than a 1M-lane sort. Slots past the number
         of active lanes gather clamped garbage; they are neutralized by
-        forcing their done flag and dropping their write-back rows."""
-        cur, elem, done, mat, flux, nseg, prev, stuck, pseg, it = state
+        forcing their done flag and dropping their write-back rows.
+
+        When intersection-point recording is on, the per-lane xp/kx
+        buffers ride the same gather/scatter-back (garbage lanes never
+        record: their forced done flag keeps real_cross False, and their
+        write-back rows drop), so recording composes with compaction."""
+        (cur, elem, done, mat, flux, nseg, prev, stuck, pseg, *xpk,
+         it) = state
         active = jnp.logical_not(done)
         idx, n_active = first_k_active(active, S)
         valid = jnp.arange(S) < n_active
@@ -816,10 +822,13 @@ def trace_impl(
         )
         sub_carry = (
             cur[idx], elem[idx], jnp.logical_not(valid), mat[idx],
-            flux, nseg, prev[idx], stuck[idx], pseg[idx], jnp.int32(0),
+            flux, nseg, prev[idx], stuck[idx], pseg[idx],
+            *(a[idx] for a in xpk), jnp.int32(0),
         )
         (scur, selem, sdone, smat, flux, nseg, sprev, sstuck, spseg,
-         sit) = run_phase(sub_body, sub_carry, bound, unroll=stage_unroll)
+         *sxpk, sit) = run_phase(
+            sub_body, sub_carry, bound, unroll=stage_unroll
+        )
         idx_sb = jnp.where(valid, idx, n)
         cur = cur.at[idx_sb].set(scur, mode="drop")
         elem = elem.at[idx_sb].set(selem, mode="drop")
@@ -828,11 +837,15 @@ def trace_impl(
         prev = prev.at[idx_sb].set(sprev, mode="drop")
         stuck = stuck.at[idx_sb].set(sstuck, mode="drop")
         pseg = pseg.at[idx_sb].set(spseg, mode="drop")
+        xpk = [
+            a.at[idx_sb].set(s, mode="drop") for a, s in zip(xpk, sxpk)
+        ]
         return (cur, elem, done, mat, flux, nseg, prev, stuck, pseg,
-                it + sit)
+                *xpk, it + sit)
 
     if compact_stages is not None and phase1_bound < max_crossings:
-        state = (cur, elem, done, mat, flux, nseg, prev, stuck, pseg, it)
+        state = (cur, elem, done, mat, flux, nseg, prev, stuck, pseg,
+                 *xpk, it)
         for i, (start, size, *rest) in enumerate(compact_stages):
             S = min(n, max(int(size), 1))
             s_unroll = int(rest[0]) if rest else unroll
@@ -868,7 +881,8 @@ def trace_impl(
                     outer_cond, outer_body, (*state, jnp.int32(0))
                 )
                 state = tuple(state)
-        cur, elem, done, mat, flux, nseg, prev, stuck, pseg, it = state
+        (cur, elem, done, mat, flux, nseg, prev, stuck, pseg, *xpk,
+         it) = state
 
     if debug_checks and not initial and ledger:
         from jax.experimental import checkify
@@ -915,6 +929,7 @@ def trace_impl(
     else:
         material_id = mat
 
+    xp, kx = xpk if xpk else (None, None)
     return TraceResult(
         position=cur,
         elem=elem,

@@ -104,6 +104,14 @@ class PartitionedTraceResult(NamedTuple):
     # track_length), migrating with its particle across cuts — the
     # conservation ledger that makes cut-boundary double-scoring visible.
     track_length: jax.Array | None = None
+    # [n_parts, 4, rounds_bound] per-chip per-round exchange diagnostics:
+    # rows are (pending before exchange, sent, received-for-me, free
+    # slots before adoption). adopted = min(received, free). The
+    # round-count model in one array: rounds where sent < pending are
+    # exchange-buffer overflow waits (raise exchange_size); a long tail
+    # of tiny pending counts is cut ping-pong (each cut crossing on a
+    # particle's path costs one round by construction).
+    round_stats: jax.Array | None = None
 
 
 def _walk_phase(
@@ -336,7 +344,10 @@ def _walk_phase(
         cur, elem, done, target, target_elem, material_id, flux, nseg,
         prev, stuck, pseg, jnp.int32(0),
     )
-    carry = run(full_body, valid, carry, phase1_bound)
+    # Static guard: a stage-0 schedule (the follow-up phases) must not
+    # compile the dead full-width while_loop at all.
+    if phase1_bound > 0:
+        carry = run(full_body, valid, carry, phase1_bound)
 
     if compact_stages is not None and phase1_bound < max_crossings:
         def compact_round(state, S, bound, stage_unroll=unroll):
@@ -437,6 +448,7 @@ def make_partitioned_step(
     compact_after: int | None = None,
     compact_size: int | None = None,
     compact_stages: tuple | None = None,
+    followup_compact_size: int | None = None,
     robust: bool = True,
     tally_scatter: str = "pair",
 ):
@@ -452,11 +464,18 @@ def make_partitioned_step(
       max_rounds: bound on walk/exchange rounds (default 4 * n_parts + 8 —
         a particle path can re-enter parts, Morton blocks are compact so
         few passes suffice; truncation shows up as done=False).
-      compact_after/compact_size: straggler compaction for each walk
-        phase, as in ops/walk.py (default off).
+      compact_after/compact_size: straggler compaction for the FIRST
+        walk phase, as in ops/walk.py (default off).
       compact_stages: staged compaction ladder ((start, size[, unroll]),
-        ...) applied to each walk phase, as in ops/walk.py; overrides
-        the two single-stage knobs.
+        ...) applied to the first walk phase, as in ops/walk.py;
+        overrides the two single-stage knobs.
+      followup_compact_size: lane width of the walk phases AFTER the
+        first exchange (default max(cap // 16, 64)). Only the particles
+        adopted in the preceding exchange are active in a follow-up
+        phase — usually a tiny fraction of cap — so follow-ups always
+        run as compaction rounds of this width from crossing 0 instead
+        of sweeping all cap slots again; per-round walk cost becomes
+        O(actives), not O(cap). Pure scheduling — results unchanged.
       robust/tally_scatter: the degeneracy-recovery and tally-scatter
         strategy knobs of ops/walk.py, applied to the partitioned body
         (same semantics, same defaults).
@@ -513,19 +532,36 @@ def make_partitioned_step(
         nseg0 = jnp.sum(vzero) * 0
         target0 = vzero * 0 - 1
 
-        walk = functools.partial(
-            _walk_phase,
+        walk_kw = dict(
             initial=initial,
             tolerance=tolerance,
             score_squares=score_squares,
             max_crossings=max_crossings,
             max_local=max_local,
             unroll=unroll,
+            robust=robust,
+            tally_scatter=tally_scatter,
+        )
+        walk_first = functools.partial(
+            _walk_phase,
             compact_after=compact_after,
             compact_size=compact_size,
             compact_stages=compact_stages,
-            robust=robust,
-            tally_scatter=tally_scatter,
+            **walk_kw,
+        )
+        # Follow-up phases: only the just-adopted immigrants are active,
+        # so skip the full-width phase entirely (stage start 0) and loop
+        # narrow compaction rounds to completion.
+        S_follow = (
+            followup_compact_size
+            if followup_compact_size is not None
+            else max(cap // 16, 64)
+        )
+        S_follow = min(S_follow, cap)
+        walk_follow = functools.partial(
+            _walk_phase,
+            compact_stages=((0, S_follow),),
+            **walk_kw,
         )
 
         me = jax.lax.axis_index(AXIS)
@@ -604,30 +640,25 @@ def make_partitioned_step(
             ).reshape(n_parts * E, 7)
             mine = g_i[:, 4] == 1  # occupied rows (all addressed to me)
 
-            # Place my immigrants into free slots: immigrants first among
-            # the received rows, free slots first among my slots.
-            imm_order = jnp.argsort(~mine)
-            free_order = jnp.argsort(valid)  # False (free) first
+            # Place my immigrants into free slots: the i-th immigrant row
+            # goes into the i-th free slot, both found with the
+            # first_k_active cumsum partition (walk.py) — linear scans, no
+            # argsort (a bitonic network on TPU).
             m = min(n_parts * E, cap)
-            src = imm_order[:m]
-            dst = free_order[:m]
-            take = mine[src]
-            n_mine = jnp.sum(mine)
-            n_free = jnp.sum(~valid)
+            src, n_mine = first_k_active(mine, m)
+            dst, n_free = first_k_active(jnp.logical_not(valid), m)
             dropped = dropped + jnp.maximum(n_mine - n_free, 0).astype(
                 dropped.dtype
             )
-            # Rows beyond the free-slot count must not overwrite occupied
-            # slots (argsort puts occupied ones after the free ones).
-            take = take & (jnp.arange(m) < n_free)
+            take = jnp.arange(m) < jnp.minimum(n_mine, n_free)
+            # Slots past the adopted count must write nothing: their
+            # src/dst entries are first_k_active garbage (lane 0), and a
+            # duplicate-index scatter would race the real adoption of
+            # slot 0 — route them out of bounds instead.
+            dst_sb = jnp.where(take, dst, cap)
 
             def place(slot_arr, rows):
-                upd = jnp.where(
-                    take.reshape((-1,) + (1,) * (rows.ndim - 1)),
-                    rows,
-                    slot_arr[dst],
-                )
-                return slot_arr.at[dst].set(upd)
+                return slot_arr.at[dst_sb].set(rows, mode="drop")
 
             cur = place(cur, g_f[src, 0:3].astype(cur.dtype))
             dest = place(dest, g_f[src, 3:6].astype(dest.dtype))
@@ -641,16 +672,24 @@ def make_partitioned_step(
             prev = place(prev, g_i[src, 6])
             stuck = place(stuck, jnp.zeros_like(stuck[dst]))
             valid = place(valid, take)
+            stats = jnp.stack(
+                [
+                    jnp.sum(emig).astype(jnp.int32),
+                    jnp.sum(sendable).astype(jnp.int32),
+                    n_mine.astype(jnp.int32),
+                    n_free.astype(jnp.int32),
+                ]
+            )
             return (cur, dest, elem, done, target, target_elem, material_id,
                     weight, group, pid, valid, prev, stuck, pseg, flux_l,
-                    nseg, dropped)
+                    nseg, dropped), stats
 
-        def run_walk(carry):
+        def run_walk(carry, walk_fn):
             (cur, dest, elem, done, target, target_elem, material_id,
              weight, group, pid, valid, prev, stuck, pseg, flux_l, nseg,
              dropped) = carry
             (cur, elem, done, target, target_elem, material_id, flux_l,
-             nseg, prev, stuck, pseg) = walk(
+             nseg, prev, stuck, pseg) = walk_fn(
                 tables_l, cur, dest, elem, done, target, target_elem,
                 material_id, weight, group, flux_l, nseg, valid, prev,
                 stuck, pseg,
@@ -664,24 +703,30 @@ def make_partitioned_step(
             material_id, weight, group, pid, valid, target0 + 0, vzero * 0,
             weight * 0, flux_l, nseg0, nseg0 * 0,
         )
-        carry = run_walk(carry)
+        carry = run_walk(carry, walk_first)
 
         def pending_somewhere(carry):
             target, valid = carry[4], carry[10]
             n_pend = jnp.sum(valid & (target >= 0)).astype(jnp.int32)
             return jax.lax.psum(n_pend, AXIS) > 0
 
+        stats0 = jnp.zeros((4, rounds_bound), jnp.int32) + vzero[0] * 0
+
         def round_body(state):
-            carry, r = state
-            carry = run_walk(exchange(carry))
-            return carry, r + 1
+            carry, r, stats = state
+            carry, ex_stats = exchange(carry)
+            carry = run_walk(carry, walk_follow)
+            stats = jax.lax.dynamic_update_slice(
+                stats, ex_stats[:, None], (0, r)
+            )
+            return carry, r + 1, stats
 
         def round_cond(state):
-            carry, r = state
+            carry, r, _ = state
             return jnp.logical_and(r < rounds_bound, pending_somewhere(carry))
 
-        carry, n_rounds = jax.lax.while_loop(
-            round_cond, round_body, (carry, nseg0 * 0)
+        carry, n_rounds, round_stats = jax.lax.while_loop(
+            round_cond, round_body, (carry, nseg0 * 0, stats0)
         )
         (cur, dest, elem, done, target, target_elem, material_id,
          weight, group, pid, valid, prev, stuck, pseg, flux_l, nseg,
@@ -702,6 +747,7 @@ def make_partitioned_step(
             n_rounds=n_rounds[None],
             n_dropped=dropped[None],
             track_length=pseg,
+            round_stats=round_stats[None],
         )
 
     table_specs = tuple(P(AXIS) for _ in tables)
@@ -725,6 +771,7 @@ def make_partitioned_step(
             n_rounds=P(AXIS),
             n_dropped=P(AXIS),
             track_length=particle_spec,
+            round_stats=P(AXIS),
         ),
     )
     jitted = jax.jit(mapped, donate_argnums=(15,))

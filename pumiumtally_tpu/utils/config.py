@@ -79,9 +79,10 @@ class TallyConfig:
       record_xpoints: when set to K, every trace records each particle's
         first K boundary-crossing points, retrievable via
         PumiTally.intersection_points() (tracer getIntersectionPoints()
-        parity, reference test:403-479). Debug/analysis only: it
-        disables straggler compaction for the run and costs one extra
-        [n,3] store per crossing; the default (None) pays nothing.
+        parity, reference test:403-479). Composes with straggler
+        compaction (the recording buffers ride the compaction rounds);
+        costs one extra [n,3] store per crossing plus [S,K,3] traffic
+        per compaction round. The default (None) pays nothing.
       robust: the walk's degeneracy-recovery machinery (ops/walk.py,
         "Degeneracy robustness"). False gives the reference tracer's
         truncate-on-degeneracy semantics (identical results on clean
@@ -93,6 +94,12 @@ class TallyConfig:
         ledger (TraceResult.track_length; required by the debug_checks
         consistency assert). One elementwise op per crossing — off only
         when squeezing the last percent from the hot loop.
+
+    Scope: ``ledger`` and ``gathers`` are honored by the single-chip and
+    streaming-pipeline walks only. The partitioned walk
+    (ops/walk_partitioned.py) always accumulates and migrates the ledger
+    (it is the cross-cut conservation check) and always uses its own
+    table layout; ``ledger=False`` / ``gathers`` are ignored there.
     """
 
     n_groups: int = 2
@@ -126,13 +133,8 @@ class TallyConfig:
 
     def resolve_compaction(self, n_particles: int) -> tuple[int | None, int | None]:
         """Compaction kicks in only where the straggler tail matters; tiny
-        batches stay on the flat loop. Recording intersection points
-        forces the flat loop (walk.py: mutually exclusive)."""
-        if (
-            self.compact_after is None
-            or n_particles < 1024
-            or self.record_xpoints is not None
-        ):
+        batches stay on the flat loop."""
+        if self.compact_after is None or n_particles < 1024:
             return None, None
         size = self.compact_size
         if size is None:
@@ -147,11 +149,7 @@ class TallyConfig:
         the executed slots of a 3-stage schedule at the benchmark's
         crossing statistics; harmless when walks are shorter, because
         each emptied stage is one guarded cheap round)."""
-        if (
-            self.compact_stages is None
-            or n_particles < 1024
-            or self.record_xpoints is not None
-        ):
+        if self.compact_stages is None or n_particles < 1024:
             return None
         if isinstance(self.compact_stages, str):
             if self.compact_stages != "auto":

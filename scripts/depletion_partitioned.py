@@ -1,0 +1,231 @@
+"""Config-5 rehearsal: depletion loop + multi-tally over the PARTITIONED walk.
+
+BASELINE.md ladder #5 ("full-core reactor, depletion loop, multi-tally")
+is partition-mandatory at its 100M-tet scale — the single-chip flat tally
+key overflows int32 (ops/walk.py guard) and the tables exceed one chip's
+HBM. This script is the working template at 1M-tet scale on the 8-device
+virtual CPU mesh:
+
+  * the partitioned step is built & compiled ONCE — depletion updates
+    change cross sections (a host-side [n_regions, n_groups] table), not
+    geometry or class tables, so the compiled walk is reused every step;
+  * each step drives a fresh synthetic-transport batch (isotropic rays,
+    exponential path lengths from the CURRENT region sigma_t) through the
+    partitioned walk with cross-chip migration;
+  * the flux + absorption-rate multi-tally is derived from the assembled
+    owned-element slabs (core/tally.reaction_rate — the response-product
+    design means NO second in-loop accumulator, single- or multi-chip);
+  * region densities burn as N' = N*exp(-burn*dt) (models/depletion.py
+    physics at partitioned scale);
+  * every step asserts the migrated conservation ledger: per-particle
+    scored track length == |final - origin| (the cut-boundary
+    double-scoring detector), and n_dropped == 0.
+
+Usage: XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+       python scripts/depletion_partitioned.py [cells] [n_particles] [steps]
+
+Writes one JSON line (PARTITIONED_DEPLETION evidence).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run_rehearsal(cells: int, n: int, n_steps: int) -> dict:
+    """Run the partitioned depletion rehearsal; returns the evidence dict.
+    Requires >= 8 JAX devices (virtual CPU mesh in tests/scripts)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from pumiumtally_tpu.core.tally import normalize_flux, reaction_rate
+    from pumiumtally_tpu.mesh.box import build_box_arrays
+    from pumiumtally_tpu.mesh.core import TetMesh
+    from pumiumtally_tpu.ops.walk_partitioned import (
+        collect_by_particle_id,
+        distribute_particles,
+        make_partitioned_step,
+    )
+    from pumiumtally_tpu.parallel.mesh_partition import (
+        assemble_global_flux,
+        partition_mesh,
+    )
+    from pumiumtally_tpu.parallel.particle_sharding import make_device_mesh
+
+    n_dev = 8
+    n_groups = 4
+    dtype = jnp.float32
+    dt = 0.1
+
+    # Two-region core: inner cube (region 1) hot absorber, outer (region 2).
+    t0 = time.perf_counter()
+    coords, tet2vert = build_box_arrays(1.0, 1.0, 1.0, cells, cells, cells)
+    cen = coords[tet2vert].mean(axis=1)
+    inner = np.all(np.abs(cen - 0.5) < 0.25, axis=1)
+    class_id = np.where(inner, 1, 2).astype(np.int32)
+    mesh = TetMesh.from_numpy(
+        coords, tet2vert, class_id=class_id, dtype=dtype
+    )
+    part = partition_mesh(mesh, n_dev)
+    build_s = time.perf_counter() - t0
+
+    # One-nuclide-per-region inventory (models/depletion.py physics).
+    density = {1: 1.0, 2: 1.0}
+    micro_total = {1: 3.0, 2: 1.5}
+    micro_abs = {1: 1.2, 2: 0.3}
+
+    dmesh = make_device_mesh(n_dev)
+    step = make_partitioned_step(
+        dmesh, part, n_groups=n_groups, max_crossings=mesh.ntet + 64,
+        tolerance=1e-6,
+    )
+    print(
+        f"[depletion-part] {mesh.ntet} tets, {n_dev} parts, {n} particles, "
+        f"{n_steps} steps, build {build_s:.0f}s",
+        file=sys.stderr, flush=True,
+    )
+
+    rng = np.random.default_rng(7)
+    steps_out = []
+    ok = True
+    for i in range(n_steps):
+        # Synthetic transport batch: isotropic rays seeded at sampled
+        # element centroids (host-seeded like the reference's driver);
+        # path length exponential in the CURRENT region sigma_t.
+        elem = rng.integers(0, mesh.ntet, n).astype(np.int32)
+        src = cen[elem] if i % 2 == 0 else np.clip(
+            cen[elem] + rng.normal(0, 0.01, (n, 3)), 0.002, 0.998
+        )
+        sigma_t = np.array(
+            [density[r] * micro_total[r] for r in (1, 2)]
+        )[(class_id[elem] == 2).astype(int)]
+        length = rng.exponential(1.0 / np.maximum(sigma_t, 1e-6))
+        u = rng.normal(size=(n, 3))
+        u /= np.linalg.norm(u, axis=1, keepdims=True)
+        dest = src + u * length[:, None]
+        weight = rng.uniform(0.5, 2.0, n)
+        group = rng.integers(0, n_groups, n).astype(np.int32)
+
+        placed = distribute_particles(
+            part, dmesh, elem,
+            dict(
+                origin=src.astype(np.float32),
+                dest=dest.astype(np.float32),
+                weight=weight.astype(np.float32),
+                group=group,
+                material_id=np.full(n, -1, np.int32),
+            ),
+        )
+        flux = jax.device_put(
+            jnp.zeros((n_dev, part.max_local, n_groups, 2), dtype),
+            NamedSharding(dmesh, P("p")),
+        )
+        t1 = time.perf_counter()
+        res = step(
+            placed["origin"], placed["dest"], placed["elem"],
+            jnp.zeros_like(placed["valid"]), placed["material_id"],
+            placed["weight"], placed["group"], placed["particle_id"],
+            placed["valid"], flux,
+        )
+        got = collect_by_particle_id(res, n)
+        step_s = time.perf_counter() - t1
+
+        # Conservation ledger across cuts: scored track length must equal
+        # net displacement (all movement rides the origin->dest ray).
+        disp = np.linalg.norm(got["position"] - src, axis=1)
+        ledger_ok = bool(
+            np.allclose(got["track_length"], disp, atol=2e-3)
+        )
+        dropped = int(np.asarray(res.n_dropped).sum())
+        done = bool(got["done"].all())
+
+        # Multi-tally: flux + absorption-rate response product over the
+        # assembled owned-element slabs.
+        g_flux = assemble_global_flux(part, res.flux)
+        sigma_abs = np.zeros((3, n_groups), np.float32)
+        for r in (1, 2):
+            sigma_abs[r, :] = density[r] * micro_abs[r]
+        rates = np.asarray(
+            reaction_rate(
+                jnp.asarray(g_flux), jnp.asarray(class_id),
+                jnp.asarray(sigma_abs),
+            )
+        )
+        norm = np.asarray(
+            normalize_flux(
+                jnp.asarray(g_flux), jnp.asarray(mesh.volumes), n, 1
+            )
+        )
+        vols = np.asarray(mesh.volumes)
+        burn_out = {}
+        for r in (1, 2):
+            mask = class_id == r
+            rate = float(rates[mask, :, 0].sum())
+            # Per-atom burn: region-integrated absorption normalized by
+            # source strength and region volume (flux per unit volume per
+            # particle), so the trajectory is scale-independent.
+            vol = float(vols[mask].sum())
+            burn = rate / (max(density[r], 1e-12) * n * vol)
+            density[r] = max(density[r] * float(np.exp(-burn * dt)), 1e-6)
+            burn_out[r] = rate
+        n_rounds = int(np.asarray(res.n_rounds)[0])
+        steps_out.append(
+            dict(
+                step=i,
+                seconds=round(step_s, 1),
+                rounds=n_rounds,
+                n_dropped=dropped,
+                all_done=done,
+                ledger_ok=ledger_ok,
+                absorption_rate={str(k): v for k, v in burn_out.items()},
+                densities={str(k): density[k] for k in density},
+                total_flux=float(g_flux[..., 0].sum()),
+                mean_norm_flux=float(norm[..., 0].mean()),
+            )
+        )
+        ok = ok and ledger_ok and done and dropped == 0
+        print(
+            f"[depletion-part] step {i}: {step_s:.1f}s, {n_rounds} rounds, "
+            f"densities {density}", file=sys.stderr, flush=True,
+        )
+
+    # Densities must strictly decrease (absorption burns them) and the hot
+    # inner region must burn faster than the outer one.
+    d1 = [s["densities"]["1"] for s in steps_out]
+    d2 = [s["densities"]["2"] for s in steps_out]
+    monotone = all(a > b for a, b in zip([1.0] + d1[:-1], d1))
+    ordered = (1.0 - d1[-1]) > (1.0 - d2[-1])
+    rec = dict(
+        metric="partitioned_depletion_rehearsal",
+        ntet=mesh.ntet,
+        n_parts=n_dev,
+        n_particles=n,
+        n_steps=n_steps,
+        steps=steps_out,
+        burn_monotone=bool(monotone),
+        inner_burns_faster=bool(ordered),
+        virtual_cpu_mesh=True,
+        ok=bool(ok and monotone and ordered),
+    )
+    return rec
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    cells = int(sys.argv[1]) if len(sys.argv) > 1 else 55
+    n = int(sys.argv[2]) if len(sys.argv) > 2 else 65536
+    n_steps = int(sys.argv[3]) if len(sys.argv) > 3 else 3
+    print(json.dumps(run_rehearsal(cells, n, n_steps)))
+
+
+if __name__ == "__main__":
+    main()

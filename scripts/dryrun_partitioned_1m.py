@@ -145,8 +145,18 @@ def main():
         )
     )
 
+    # Round-count model: per-round pending/sent/received/free totals
+    # (PartitionedTraceResult.round_stats). Rounds with sent < pending are
+    # exchange-overflow waits; a long tail of tiny pending counts is cut
+    # ping-pong.
+    n_rounds = int(np.asarray(res.n_rounds)[0])
+    stats = np.asarray(res.round_stats).sum(axis=0)[:, :n_rounds]
+
     rec = {
         "metric": "partitioned_1m_dryrun",
+        "round_pending": stats[0].tolist(),
+        "round_sent": stats[1].tolist(),
+        "round_received": stats[2].tolist(),
         "ntet": mesh.ntet,
         "n_parts": n_dev,
         "n_particles": n,

@@ -8,7 +8,7 @@
 cd "$(dirname "$0")/.."
 CAPTURE="${1:-scripts/tpu_round3_capture2.sh}"
 while true; do
-  if timeout 90 python -c "import jax; print(jax.devices())" \
+  if timeout 180 python -c "import jax; print(jax.devices())" \
       >/tmp/tunnel_probe.out 2>&1; then
     echo "$(date -u +%H:%M:%S) LIVE — starting $CAPTURE"
     bash "$CAPTURE" > /tmp/capture.log 2>&1

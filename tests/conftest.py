@@ -17,3 +17,15 @@ import jax
 # over the env var set above; the config update takes final precedence.
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+
+# Persistent compile cache: the suite's cost is dominated by XLA compiles
+# of the walk programs (one per static-config signature). Caching them on
+# disk makes every re-run after the first (the common case: the driver's
+# per-round gate, local red-green loops) skip the compiles entirely.
+# Threshold 0 caches even sub-second entries — hit rate matters more than
+# per-entry size here, and the cache lives in gitignored scratch.
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.path.join(os.path.dirname(__file__), os.pardir, ".jax_cache_tests"),
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)

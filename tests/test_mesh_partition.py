@@ -250,6 +250,7 @@ def test_partitioned_small_exchange_buffer(box):
     assert int(np.asarray(res.n_rounds)[0]) > 1
 
 
+@pytest.mark.slow
 def test_partitioned_unroll_matches(box):
     """The dispatch-amortizing unroll must not change partitioned results
     (done lanes and migration-frozen lanes are no-ops in the body)."""
@@ -266,6 +267,7 @@ def test_partitioned_unroll_matches(box):
     np.testing.assert_array_equal(got["material_id"], base["material_id"])
 
 
+@pytest.mark.slow
 def test_partitioned_compaction_matches(box):
     """Straggler compaction in the partitioned walk phase must not change
     results — it only reschedules lanes (migration-frozen lanes drop out
@@ -307,6 +309,7 @@ def test_partitioned_interleaved_scatter_matches(box):
     assert int(np.sum(np.asarray(res.n_segments))) == int(ref.n_segments)
 
 
+@pytest.mark.slow
 def test_partitioned_staged_ladder_matches(box):
     """The staged compaction ladder (with per-stage unroll overrides)
     in the partitioned walk phase must not change results — same

@@ -174,3 +174,166 @@ def test_two_process_allreduce(tmp_path):
     )
     expect = float(np.asarray(r.flux)[..., 0].sum())
     assert results[0] == pytest.approx(expect, rel=1e-10)
+
+
+# --------------------------------------------------------------------------- #
+# Per-function coverage (single-process, monkeypatched ranks) — so a failure
+# localizes to the broken piece instead of one opaque red cluster test.
+# --------------------------------------------------------------------------- #
+class TestInitDistributed:
+    def test_single_process_is_noop(self, monkeypatch):
+        from pumiumtally_tpu.parallel import multihost
+
+        monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+        monkeypatch.delenv("JAX_NUM_PROCESSES", raising=False)
+        assert multihost.init_distributed() is False
+        # num_processes=1 is a no-op even with a coordinator configured.
+        assert multihost.init_distributed("127.0.0.1:1", 1, 0) is False
+        # No coordinator address → no-op regardless of process count.
+        assert multihost.init_distributed(None, 4, 0) is False
+
+    def test_idempotent_after_init(self, monkeypatch):
+        from pumiumtally_tpu.parallel import multihost
+
+        # Once the cluster is up, a second call must return True without
+        # touching jax.distributed.initialize again (which would raise).
+        monkeypatch.setattr(multihost, "_initialized", True)
+
+        def boom(**kw):  # pragma: no cover - must not be reached
+            raise AssertionError("re-initialized a live cluster")
+
+        monkeypatch.setattr(
+            multihost.jax.distributed, "initialize", boom
+        )
+        assert multihost.init_distributed("127.0.0.1:1", 2, 0) is True
+
+    def test_env_var_contract(self, monkeypatch):
+        from pumiumtally_tpu.parallel import multihost
+
+        calls = {}
+
+        def fake_init(coordinator_address, num_processes, process_id):
+            calls.update(
+                addr=coordinator_address, n=num_processes, pid=process_id
+            )
+
+        monkeypatch.setattr(multihost, "_initialized", False)
+        monkeypatch.setattr(
+            multihost.jax.distributed, "initialize", fake_init
+        )
+        monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "10.0.0.1:1234")
+        monkeypatch.setenv("JAX_NUM_PROCESSES", "4")
+        monkeypatch.setenv("JAX_PROCESS_ID", "3")
+        assert multihost.init_distributed() is True
+        assert calls == {"addr": "10.0.0.1:1234", "n": 4, "pid": 3}
+
+
+class TestHostLocalBatch:
+    @pytest.mark.parametrize(
+        "size,n", [(1, 7), (2, 64), (3, 64), (3, 2), (4, 0), (8, 101)]
+    )
+    def test_split_covers_disjointly(self, monkeypatch, size, n):
+        import jax
+
+        from pumiumtally_tpu.parallel.multihost import host_local_batch
+
+        monkeypatch.setattr(jax, "process_count", lambda: size)
+        spans = []
+        for rank in range(size):
+            monkeypatch.setattr(jax, "process_index", lambda r=rank: r)
+            start, count = host_local_batch(n)
+            assert count >= 0
+            spans.append((start, count))
+        # Contiguous, ordered, disjoint, covering exactly [0, n), and
+        # balanced to within one particle (the work_per_rank contract).
+        pos = 0
+        for start, count in spans:
+            assert start == pos
+            pos += count
+        assert pos == n
+        counts = [c for _, c in spans]
+        assert max(counts) - min(counts) <= 1
+
+
+class TestAllreduceFlux:
+    def test_single_process_identity(self):
+        from pumiumtally_tpu.parallel.multihost import allreduce_flux
+
+        flux = np.arange(24, dtype=np.float64).reshape(2, 6, 2)
+        for in_program in (True, False):
+            out = allreduce_flux(flux, in_program=in_program)
+            np.testing.assert_array_equal(out, flux)
+
+    def test_in_program_failure_falls_back(self, monkeypatch):
+        import jax
+
+        from pumiumtally_tpu.parallel import multihost
+
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+
+        def broken(local):
+            raise RuntimeError("no collectives here")
+
+        gathered = {}
+
+        def fake_allgather(x):
+            gathered["called"] = True
+            return np.stack([np.asarray(x), np.asarray(x)])
+
+        monkeypatch.setattr(
+            multihost, "_allreduce_flux_in_program", broken
+        )
+        from jax.experimental import multihost_utils
+
+        monkeypatch.setattr(
+            multihost_utils, "process_allgather", fake_allgather
+        )
+        flux = np.ones((3, 1, 2))
+        out = multihost.allreduce_flux(flux, in_program=True)
+        assert gathered.get("called"), "fallback path not taken"
+        np.testing.assert_array_equal(out, 2 * flux)
+
+
+class TestWriteParallelVtk:
+    def test_piece_and_index_content(self, tmp_path, monkeypatch):
+        import jax
+        import jax.numpy as jnp
+
+        from pumiumtally_tpu import build_box
+        from pumiumtally_tpu.parallel.multihost import write_parallel_vtk
+
+        mesh = build_box(1, 1, 1, 2, 2, 2, dtype=jnp.float64)
+        flux = np.random.default_rng(0).random((mesh.ntet, 2, 2))
+        monkeypatch.setattr(jax, "process_index", lambda: 0)
+        monkeypatch.setattr(jax, "process_count", lambda: 3)
+        piece = write_parallel_vtk(str(tmp_path / "out"), mesh, flux)
+        assert piece == str(tmp_path / "out_p0000.vtu")
+        body = (tmp_path / "out_p0000.vtu").read_text()
+        assert "flux_group_0" in body and "flux_group_1" in body
+        index = (tmp_path / "out.pvtu").read_text()
+        # The rank-0 index must reference every process's piece by its
+        # RELATIVE name (a .pvtu with absolute paths breaks on move).
+        for r in range(3):
+            assert f"out_p{r:04d}.vtu" in index
+        assert str(tmp_path) not in index
+
+    def test_nonzero_rank_writes_no_index(self, tmp_path, monkeypatch):
+        import jax
+        import jax.numpy as jnp
+
+        from pumiumtally_tpu import build_box
+        from pumiumtally_tpu.parallel.multihost import write_parallel_vtk
+
+        mesh = build_box(1, 1, 1, 2, 2, 2, dtype=jnp.float64)
+        flux = np.zeros((mesh.ntet, 1, 2))
+        monkeypatch.setattr(jax, "process_index", lambda: 1)
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        piece = write_parallel_vtk(
+            str(tmp_path / "out"), mesh, flux,
+            elem_slice=slice(0, mesh.ntet // 2),
+        )
+        assert (tmp_path / "out_p0001.vtu").exists()
+        assert not (tmp_path / "out.pvtu").exists()
+        # elem_slice restricts the piece to this host's elements.
+        body = (tmp_path / "out_p0001.vtu").read_text()
+        assert f'NumberOfCells="{mesh.ntet // 2}"' in body

@@ -9,6 +9,7 @@ invariants (densities fall monotonically, rates stay consistent).
 from __future__ import annotations
 
 import numpy as np
+import pytest
 
 from pumiumtally_tpu import PumiTally, TallyConfig
 from pumiumtally_tpu.mesh.box import build_box_arrays
@@ -59,6 +60,7 @@ def test_reaction_rate_out_of_range_region_scores_zero():
     assert rr[cid == 0, :, 0].sum() > 0
 
 
+@pytest.mark.slow
 def test_depletion_burns_density_down():
     mesh = _two_region()
     t = PumiTally(mesh, 64, TallyConfig(n_groups=2, tolerance=1e-6))
@@ -74,3 +76,23 @@ def test_depletion_burns_density_down():
         assert all(d2 < d1 for d1, d2 in zip(dens, dens[1:])), dens
         assert all(h.absorption_rate[rid] > 0 for h in hist)
     assert all(h.total_flux > 0 for h in hist)
+
+
+@pytest.mark.slow
+def test_partitioned_depletion_rehearsal(monkeypatch):
+    """Config-5 shape over the PARTITIONED walk (BASELINE ladder #5
+    template for the partition-mandatory 100M-tet scale): N depletion
+    steps on the 8-way virtual mesh with a compiled-once step, conserved
+    migrated ledgers, zero drops, and physically ordered burn."""
+    import os
+
+    monkeypatch.syspath_prepend(
+        os.path.join(os.path.dirname(__file__), os.pardir, "scripts")
+    )
+    from depletion_partitioned import run_rehearsal
+
+    rec = run_rehearsal(cells=5, n=1024, n_steps=2)
+    assert rec["ok"], rec
+    for s in rec["steps"]:
+        assert s["ledger_ok"] and s["all_done"] and s["n_dropped"] == 0
+    assert rec["burn_monotone"] and rec["inner_burns_faster"]

@@ -99,6 +99,7 @@ def test_sharded_trace_matches_single_chip(setup):
     assert bool(np.asarray(r8.done).all())
 
 
+@pytest.mark.slow
 def test_sharded_flux_accumulates_across_steps(setup):
     mesh, dmesh = setup
     n = 32

@@ -3,6 +3,7 @@ batches, and per-batch outputs must come back in submission order."""
 from __future__ import annotations
 
 import numpy as np
+import pytest
 
 import jax.numpy as jnp
 
@@ -63,6 +64,7 @@ def test_pipeline_matches_sequential():
         assert b.all_done
 
 
+@pytest.mark.slow
 def test_pipeline_no_outputs_mode():
     mesh = build_box(1.0, 1.0, 1.0, 3, 3, 3)
     pipe = StreamingTallyPipeline(
@@ -76,6 +78,7 @@ def test_pipeline_no_outputs_mode():
     assert list(pipe.results()) == []
 
 
+@pytest.mark.slow
 def test_pipeline_records_xpoints_when_configured():
     """TallyConfig.record_xpoints must apply on the pipeline path too —
     BatchResult carries the crossing points (None when the flag is off)."""

@@ -77,6 +77,7 @@ def test_f64_run_to_run_reproducible():
     )
 
 
+@pytest.mark.slow
 def test_f64_stable_across_scheduling():
     """Changing lane scheduling (staged compaction + unroll) reorders the
     scatter-adds; in f64 the result must stay within accumulation noise of

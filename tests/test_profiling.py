@@ -6,6 +6,7 @@ import glob
 import os
 
 import numpy as np
+import pytest
 
 from pumiumtally_tpu import PumiTally, TallyConfig, build_box
 from pumiumtally_tpu.utils.profiling import (
@@ -15,6 +16,7 @@ from pumiumtally_tpu.utils.profiling import (
 )
 
 
+@pytest.mark.slow
 def test_profile_trace_writes_artifacts(tmp_path):
     logdir = str(tmp_path / "trace")
     mesh = build_box(1.0, 1.0, 1.0, 2, 2, 2)

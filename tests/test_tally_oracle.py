@@ -257,6 +257,7 @@ def test_intersection_points_surface():
         t2.intersection_points()
 
 
+@pytest.mark.slow
 def test_intersection_points_no_crossing_and_pre_trace_errors():
     """A particle that never leaves its tet records ZERO crossing points
     (the recorder logs genuine boundary crossings only), and calling the

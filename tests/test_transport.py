@@ -10,6 +10,7 @@ from __future__ import annotations
 import os
 
 import numpy as np
+import pytest
 
 from pumiumtally_tpu import PumiTally, TallyConfig, build_box
 from pumiumtally_tpu.mesh.box import build_box_arrays
@@ -24,6 +25,7 @@ def _two_region_mesh(cells=4):
     return TetMesh.from_numpy(coords, tets, class_id)
 
 
+@pytest.mark.slow
 def test_transport_smoke(tmp_path):
     mesh = _two_region_mesh()
     tally = PumiTally(

@@ -9,7 +9,11 @@ from pumiumtally_tpu import build_box, make_flux, trace
 from pumiumtally_tpu.ops.geometry import locate_points
 
 
-@pytest.mark.parametrize("compact_size", [8, 32, None])
+@pytest.mark.parametrize(
+    "compact_size",
+    [pytest.param(8, marks=pytest.mark.slow), 32,
+     pytest.param(None, marks=pytest.mark.slow)],
+)
 def test_compaction_matches_flat(compact_size):
     mesh = build_box(1, 1, 1, 4, 4, 4, dtype=jnp.float64)
     n = 128
@@ -86,3 +90,68 @@ def test_compaction_with_truncation_reports_not_done():
         compact_size=2,
     )
     assert not bool(np.asarray(r.done).any())
+
+
+@pytest.mark.parametrize(
+    "sched",
+    [
+        dict(compact_after=2, compact_size=16),
+        pytest.param(
+            dict(compact_stages=((2, 32), (6, 16), (10, 8))),
+            marks=pytest.mark.slow,
+        ),
+    ],
+)
+def test_record_xpoints_composes_with_compaction(sched):
+    """Intersection-point recording must survive the straggler
+    gather/scatter-back: the compacted walk records exactly the flat
+    walk's points and counts (the xp/kx lanes ride compaction rounds
+    like any other per-particle state), so the production config
+    (compact_stages="auto") can record too — reference tracer's
+    getIntersectionPoints() is unconditional (test:403-479)."""
+    mesh = build_box(1, 1, 1, 4, 4, 4, dtype=jnp.float64)
+    n = 128
+    rng = np.random.default_rng(11)
+    origin = rng.uniform(0.05, 0.95, (n, 3))
+    dest = origin + rng.normal(scale=0.05, size=(n, 3))
+    dest[: n // 4] = rng.uniform(-0.5, 1.5, (n // 4, 3))
+    in_flight = rng.random(n) > 0.2
+    weight = rng.uniform(0.1, 3.0, n)
+    group = rng.integers(0, 2, n)
+    elem = np.asarray(locate_points(mesh, jnp.asarray(origin), 1e-12))
+    assert (elem >= 0).all()
+
+    args = dict(
+        initial=False,
+        max_crossings=mesh.ntet + 64,
+        tolerance=1e-12,
+        record_xpoints=6,
+    )
+    common = (
+        mesh,
+        jnp.asarray(origin),
+        jnp.asarray(dest),
+        jnp.asarray(elem, jnp.int32),
+        jnp.asarray(in_flight),
+        jnp.asarray(weight),
+        jnp.asarray(group, jnp.int32),
+        jnp.full(n, -1, jnp.int32),
+    )
+    flat = trace(*common, make_flux(mesh.ntet, 2, jnp.float64), **args)
+    compact = trace(
+        *common, make_flux(mesh.ntet, 2, jnp.float64), **sched, **args
+    )
+
+    assert bool(np.asarray(compact.done).all())
+    np.testing.assert_array_equal(
+        np.asarray(compact.n_xpoints), np.asarray(flat.n_xpoints)
+    )
+    # Recorded points: identical where recorded; slots past a lane's
+    # count are never written in either schedule (both zero-initialized).
+    np.testing.assert_allclose(
+        np.asarray(compact.xpoints), np.asarray(flat.xpoints), atol=1e-14
+    )
+    np.testing.assert_allclose(
+        np.asarray(compact.flux), np.asarray(flat.flux), atol=1e-12
+    )
+    assert int(np.asarray(flat.n_xpoints).max()) >= 3  # scenario non-trivial

@@ -162,9 +162,17 @@ def test_score_squares_off_drops_only_squares(setup, body):
     "variant",
     [
         dict(unroll=4),
-        dict(unroll=8, compact_after=4, compact_size=32),
+        # compact/stage-unroll are the compile-heaviest variants; the
+        # "stages" row keeps staged-compaction parity in the fast suite.
+        pytest.param(
+            dict(unroll=8, compact_after=4, compact_size=32),
+            marks=pytest.mark.slow,
+        ),
         dict(compact_stages=((4, 64), (8, 48), (16, 24)), unroll=2),
-        dict(compact_stages=((4, 64), (8, 48, 4), (16, 24, 8)), unroll=2),
+        pytest.param(
+            dict(compact_stages=((4, 64), (8, 48, 4), (16, 24, 8)), unroll=2),
+            marks=pytest.mark.slow,
+        ),
     ],
     ids=["unroll", "compact", "stages", "stage-unroll"],
 )
