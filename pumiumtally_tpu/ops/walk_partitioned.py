@@ -33,6 +33,18 @@ migration — the `migrate` flag plumbed through `search(migrate)` into
 Pumi-PIC's rebuild/migrate machinery (pumipic_particle_data_structure
 .cpp:256-258, 741-769) — with XLA collectives instead of MPI messages.
 
+With a halo partition (partition_mesh(halo_layers=k) — the Pumi-PIC
+"buffered picparts" model, cpp:865-876, with depth as a knob) particles
+also walk and SCORE through up to k buffered layers of neighboring
+parts' elements as guests; only exiting the buffered region migrates.
+This collapses the one-round-per-recross ping-pong at jagged Morton cut
+boundaries (round_stats showed a geometric 27-round pending tail at 1M
+tets without it). Guest-scored flux lands in the host chip's halo rows
+and is folded onto owner rows by ONE static all_to_all at walk end
+(exact permutation-sum — results stay bit-comparable to single-chip),
+after which halo rows are zeroed so callers can accumulate flux across
+steps without double-folding.
+
 Tally writes touch only the chip-local flux slab `[max_local, g, 2]`; since
 every element is owned by exactly one chip there is no cross-chip tally
 reduction at all — assembly back to global element order is a permutation
@@ -508,16 +520,44 @@ def make_partitioned_step(
     tables = tuple(
         jax.device_put(t, table_sharding) for t in partition.device_tables()
     )
+    # Halo (buffered picparts): particles walk and score through buffered
+    # neighbor elements as guests; the extra tables drive the canonical
+    # back-reference on migration and the one static all_to_all that folds
+    # guest-scored flux onto owner rows at walk end.
+    has_halo = partition.row_owner is not None
+    if has_halo:
+        halo_tables = tuple(
+            jax.device_put(t, table_sharding)
+            for t in (
+                partition.row_owner,
+                partition.row_owner_local,
+                partition.halo_send_rows,
+                partition.halo_recv_rows,
+                jnp.asarray(np.asarray(partition.counts, np.int32)[:, None]),
+            )
+        )
+    else:
+        halo_tables = ()
 
-    def shard_body(
-        normals_t, faced_t, enc_t, class_t, nbrclass_t, volumes_t,
-        cur, dest, elem, done, material_id, weight, group, pid, valid, flux,
-    ):
+    def shard_body(*args):
+        (normals_t, faced_t, enc_t, class_t, nbrclass_t,
+         volumes_t) = args[:6]
+        if has_halo:
+            (row_owner_t, row_owner_local_t, halo_send_t, halo_recv_t,
+             n_owned_t) = args[6:11]
+        (cur, dest, elem, done, material_id, weight, group, pid, valid,
+         flux) = args[6 + len(halo_tables):]
         # Per-chip blocks arrive with a leading axis of 1; squeeze it.
         tables_l = (
             normals_t[0], faced_t[0], enc_t[0], class_t[0], nbrclass_t[0],
             volumes_t[0],
         )
+        if has_halo:
+            row_owner_l = row_owner_t[0]
+            row_owner_local_l = row_owner_local_t[0]
+            halo_send_l = halo_send_t[0]  # [n_parts, Eh] my rows by owner
+            halo_recv_l = halo_recv_t[0]  # [n_parts, Eh] owner rows by src
+            n_owned_l = n_owned_t[0, 0]
         flux_l = flux[0]
         cap = cur.shape[0]
         E = (
@@ -607,9 +647,20 @@ def make_partitioned_step(
             # hop is a relocation, not a real crossing, so the convexity
             # mask must not apply — send "no entry face" instead,
             # mirroring the chase prev-clear in the local bodies.
-            back_code = jnp.where(
-                stuck >= 4, jnp.int32(-1), -2 - (me * max_local + elem)
-            )
+            if has_halo:
+                # Canonical identity: the element being left may itself be
+                # a halo row here — reference its TRUE owner's row, which
+                # is how the receiver's adjacency encodes any non-local
+                # neighbor. (If the receiver buffers that element locally,
+                # its enc entry is a local index and the mask is simply
+                # inert for that immigrant's first crossing — the
+                # chase/bump recovery still covers the rare grazing cut.)
+                canon = -2 - (
+                    row_owner_l[elem] * max_local + row_owner_local_l[elem]
+                )
+            else:
+                canon = -2 - (me * max_local + elem)
+            back_code = jnp.where(stuck >= 4, jnp.int32(-1), canon)
             pay_i = fill(
                 jnp.stack(
                     [
@@ -732,6 +783,27 @@ def make_partitioned_step(
          weight, group, pid, valid, prev, stuck, pseg, flux_l, nseg,
          dropped) = carry
 
+        if has_halo:
+            # Fold guest-scored flux back onto owner rows: ONE static
+            # all_to_all over the precomputed halo row lists (pad entries
+            # index max_local: masked on gather, dropped on scatter).
+            sendable_h = halo_send_l < max_local  # [n_parts, Eh]
+            send_h = jnp.where(
+                sendable_h[..., None, None],
+                flux_l[jnp.minimum(halo_send_l, max_local - 1)],
+                0.0,
+            )  # [n_parts, Eh, G, 2]
+            recv_h = jax.lax.all_to_all(send_h, AXIS, 0, 0, tiled=False)
+            # My halo rows are folded out — zero them so a caller that
+            # accumulates flux across steps cannot double-fold them.
+            row_ix = jnp.arange(max_local)
+            flux_l = jnp.where(
+                (row_ix < n_owned_l)[:, None, None], flux_l, 0.0
+            )
+            flux_l = flux_l.at[halo_recv_l.reshape(-1)].add(
+                recv_h.reshape(-1, *recv_h.shape[2:]), mode="drop"
+            )
+
         return PartitionedTraceResult(
             position=cur,
             dest=dest,
@@ -750,7 +822,7 @@ def make_partitioned_step(
             round_stats=round_stats[None],
         )
 
-    table_specs = tuple(P(AXIS) for _ in tables)
+    table_specs = tuple(P(AXIS) for _ in (*tables, *halo_tables))
     particle_spec = P(AXIS)
     mapped = jax.shard_map(
         shard_body,
@@ -774,13 +846,15 @@ def make_partitioned_step(
             round_stats=P(AXIS),
         ),
     )
-    jitted = jax.jit(mapped, donate_argnums=(15,))
+    jitted = jax.jit(
+        mapped, donate_argnums=(6 + len(halo_tables) + 9,)  # the flux slab
+    )
 
     def step(cur, dest, elem, done, material_id, weight, group, pid, valid,
              flux):
         return jitted(
-            *tables, cur, dest, elem, done, material_id, weight, group, pid,
-            valid, flux,
+            *tables, *halo_tables, cur, dest, elem, done, material_id,
+            weight, group, pid, valid, flux,
         )
 
     return step
@@ -845,8 +919,19 @@ def distribute_particles(
     return out
 
 
-def collect_by_particle_id(result: PartitionedTraceResult, n: int) -> dict:
-    """Gather per-particle outputs back into host pid order."""
+def collect_by_particle_id(
+    result: PartitionedTraceResult,
+    n: int,
+    partition: MeshPartition | None = None,
+) -> dict:
+    """Gather per-particle outputs back into host pid order.
+
+    ``elem`` is the particle's local row on the chip HOLDING it — with a
+    halo a finished particle can rest as a guest in a buffered element.
+    Pass ``partition`` to additionally get ``elem_global`` (resolved via
+    each holding chip's local2global), the id a host driver needs to
+    re-seed the next move.
+    """
     pid = np.asarray(result.particle_id)
     valid = np.asarray(result.valid)
     sel = valid & (pid >= 0)
@@ -858,4 +943,13 @@ def collect_by_particle_id(result: PartitionedTraceResult, n: int) -> dict:
         buf = np.zeros((n,) + arr.shape[1:], arr.dtype)
         buf[idx] = arr[sel]
         out[name] = buf
+    if partition is not None:
+        cap = pid.shape[0] // partition.n_parts
+        chip = (np.arange(pid.shape[0]) // cap)[sel]
+        eg = partition.local2global[
+            chip, np.asarray(result.elem)[sel]
+        ]
+        buf = np.full(n, -1, np.int64)
+        buf[idx] = eg
+        out["elem_global"] = buf
     return out

@@ -66,17 +66,37 @@ class MeshPartition:
     Host (numpy) fields:
       owner: [ntet] chip owning each global element.
       global2local: [ntet] local index of each global element on its owner.
-      local2global: [n_parts, max_local] inverse map, -1 padding.
-      counts: [n_parts] owned-element count per chip.
+      local2global: [n_parts, max_local] inverse map, -1 padding. With a
+        halo, each part's rows are its owned block first (counts[p] rows)
+        followed by its halo rows (elements owned elsewhere but buffered
+        locally, Pumi-PIC "buffered picparts" style).
+      counts: [n_parts] OWNED-element count per chip (excludes halo).
+      halo_layers: face-adjacency depth of the halo (0 = none).
 
     Device (jax, leading axis = chip) fields — shard these with
     ``P(PARTICLE_AXIS)`` on the leading axis:
       face_normals: [n_parts, max_local, 4, 3]
       face_d:       [n_parts, max_local, 4]
-      tet2tet_enc:  [n_parts, max_local, 4] (encoding above)
+      tet2tet_enc:  [n_parts, max_local, 4] (encoding above; with a halo,
+        "local" spans owned + halo rows, and remote codes address the
+        TRUE owner's row — migration always rehomes to the owner)
       class_id:     [n_parts, max_local]
       nbr_class:    [n_parts, max_local, 4]
       volumes:      [n_parts, max_local]
+
+    Halo-only device fields (None when halo_layers == 0):
+      row_owner:        [n_parts, max_local] owning chip of each local row
+                        (p for owned rows, the true owner for halo rows,
+                        -1 padding).
+      row_owner_local:  [n_parts, max_local] that row's index on its owner.
+      halo_send_rows:   [n_parts, n_parts, Eh] — for sender p, block q
+                        lists p's halo-row local ids owned by q (pad
+                        max_local → dropped). Drives the one static
+                        all_to_all that folds guest-scored flux back onto
+                        owners at walk end.
+      halo_recv_rows:   [n_parts, n_parts, Eh] — for receiver q, block p
+                        lists the OWNER-local row ids matching
+                        halo_send_rows[p][q] (pad max_local → dropped).
     """
 
     n_parts: int
@@ -91,6 +111,11 @@ class MeshPartition:
     class_id: Any
     nbr_class: Any
     volumes: Any
+    halo_layers: int = 0
+    row_owner: Any = None
+    row_owner_local: Any = None
+    halo_send_rows: Any = None
+    halo_recv_rows: Any = None
 
     @property
     def ntet(self) -> int:
@@ -115,19 +140,37 @@ def decode_remote(enc: np.ndarray, max_local: int):
 
 
 def partition_mesh(
-    mesh: TetMesh, n_parts: int, *, order: np.ndarray | None = None
+    mesh: TetMesh,
+    n_parts: int,
+    *,
+    order: np.ndarray | None = None,
+    halo_layers: int = 0,
 ) -> MeshPartition:
     """Partition a TetMesh into ``n_parts`` Morton-contiguous element blocks
     and build the stacked local walk tables.
 
     ``order`` overrides the element ordering (tests use it to force skewed
     or adversarial partitions).
+
+    ``halo_layers`` buffers that many face-adjacency layers of neighboring
+    parts' elements onto each chip (the Pumi-PIC "buffered picparts"
+    model the reference builds on, pumipic_particle_data_structure
+    .cpp:865-876 — there with full-mesh buffering; here the halo depth is
+    a knob). Particles walk and SCORE through halo elements as guests —
+    the walk body is unchanged — and only migrate when they exit the
+    buffered region, which collapses the one-round-per-cut-recross
+    ping-pong at jagged Morton boundaries (see
+    PartitionedTraceResult.round_stats). Guest-scored flux is folded back
+    onto owner rows by one static all_to_all at walk end
+    (halo_send_rows/halo_recv_rows).
     """
     import jax.numpy as jnp
 
     ntet = mesh.ntet
     if n_parts < 1 or n_parts > ntet:
         raise ValueError(f"n_parts={n_parts} out of range for {ntet} elements")
+    if halo_layers < 0:
+        raise ValueError(f"halo_layers must be >= 0: {halo_layers}")
 
     tet2tet = np.asarray(mesh.tet2tet, np.int64)
     if order is None:
@@ -140,42 +183,127 @@ def partition_mesh(
     owner = np.empty(ntet, np.int32)
     global2local = np.empty(ntet, np.int64)
     counts = np.diff(bounds).astype(np.int64)
-    max_local = int(counts.max())
-    local2global = np.full((n_parts, max_local), -1, np.int64)
     for p in range(n_parts):
         block = order[bounds[p] : bounds[p + 1]]
         owner[block] = p
         global2local[block] = np.arange(block.size)
-        local2global[p, : block.size] = block
+
+    # Halo expansion: per part, `halo_layers` rings of face neighbors not
+    # already present. Halo rows follow the owned block in local order.
+    halos: list[np.ndarray] = []
+    if halo_layers > 0 and n_parts > 1:
+        for p in range(n_parts):
+            present = np.zeros(ntet, bool)
+            block = order[bounds[p] : bounds[p + 1]]
+            present[block] = True
+            frontier = block
+            ring_all = []
+            for _ in range(halo_layers):
+                nb = tet2tet[frontier].ravel()
+                nb = nb[nb >= 0]
+                nb = np.unique(nb[~present[nb]])
+                if nb.size == 0:
+                    break
+                present[nb] = True
+                ring_all.append(nb)
+                frontier = nb
+            halos.append(
+                np.concatenate(ring_all)
+                if ring_all
+                else np.empty(0, np.int64)
+            )
+    else:
+        halos = [np.empty(0, np.int64) for _ in range(n_parts)]
+
+    max_local = int(
+        max(counts[p] + halos[p].size for p in range(n_parts))
+    )
+    local2global = np.full((n_parts, max_local), -1, np.int64)
+    # Per-part local index of every present (owned or halo) element;
+    # built part-at-a-time to keep memory at one ntet-sized scratch.
+    loc_of = np.full(ntet, -1, np.int64)
+    enc = np.full((n_parts, max_local, 4), -1, np.int64)
+    nbr_class_rows = np.zeros((n_parts, max_local, 4), np.int32)
+    g_cls = np.asarray(mesh.class_id, np.int32)
+    for p in range(n_parts):
+        block = order[bounds[p] : bounds[p + 1]]
+        rows = np.concatenate([block, halos[p]])
+        local2global[p, : rows.size] = rows
+        loc_of[:] = -1
+        loc_of[rows] = np.arange(rows.size)
+        nbr = tet2tet[rows]  # [rows, 4] global ids, -1 boundary
+        nbr_safe = np.maximum(nbr, 0)
+        nbr_loc = loc_of[nbr_safe]
+        nbr_owner = owner[nbr_safe]
+        nbr_owner_local = global2local[nbr_safe]
+        enc[p, : rows.size] = np.where(
+            nbr < 0,
+            -1,
+            np.where(
+                nbr_loc >= 0,
+                nbr_loc,
+                # Remote codes address the TRUE owner's owned row, so a
+                # halo exit migrates the particle home in one hop.
+                -2 - (nbr_owner * max_local + nbr_owner_local),
+            ),
+        )
+        nbr_class_rows[p, : rows.size] = np.where(
+            nbr < 0, g_cls[rows][:, None], g_cls[nbr_safe]
+        )
 
     # Stacked per-part geometry tables (gather from the full mesh; padded
-    # rows replicate element 0 of the part — they are never addressed
-    # because tet2tet_enc never points at them).
+    # rows replicate the part's row 0 — they are never addressed because
+    # tet2tet_enc never points at them).
     g = np.where(local2global >= 0, local2global, local2global[:, :1])
     h_normals = np.asarray(mesh.face_normals)[g]
     h_face_d = np.asarray(mesh.face_d)[g]
-    h_class = np.asarray(mesh.class_id, np.int32)[g]
+    h_class = g_cls[g]
     h_volumes = np.asarray(mesh.volumes)[g]
 
-    # Neighbor encoding + neighbor class per face.
-    nbr = tet2tet[g]  # [P, L, 4] global neighbor ids, -1 boundary
-    nbr_safe = np.maximum(nbr, 0)
-    nbr_owner = owner[nbr_safe]
-    nbr_local = global2local[nbr_safe]
-    same = nbr_owner == np.arange(n_parts, dtype=np.int32)[:, None, None]
-    enc = np.where(
-        nbr < 0,
-        -1,
-        np.where(same, nbr_local, -2 - (nbr_owner * max_local + nbr_local)),
-    ).astype(np.int64)
-    h_nbr_class = np.where(
-        nbr < 0,
-        h_class[..., None] * np.ones((1, 1, 4), np.int32),
-        np.asarray(mesh.class_id, np.int32)[nbr_safe],
-    ).astype(np.int32)
-    # Padded rows: make them inert (domain boundary on all faces).
-    pad = local2global < 0
-    enc[pad] = -1
+    # A 1-part "partition" has no cuts, hence no halo: record depth 0 so
+    # the dataclass contract (halo fields None iff halo_layers == 0) holds.
+    halo_kwargs: dict = dict(
+        halo_layers=int(halo_layers) if n_parts > 1 else 0
+    )
+    if halo_layers > 0 and n_parts > 1:
+        row_owner = np.where(local2global >= 0, owner[g], -1).astype(
+            np.int32
+        )
+        row_owner_local = np.where(
+            local2global >= 0, global2local[g], 0
+        ).astype(np.int32)
+        # Static guest-flux fold tables: sender p's halo rows owned by q,
+        # paired with their owner-local rows at q. Padded to the max
+        # (p, q) block with max_local (an OOB row index — dropped).
+        send_lists = [
+            [
+                np.nonzero(row_owner[p, : counts[p] + halos[p].size] == q)[0]
+                if q != p
+                else np.empty(0, np.int64)
+                for q in range(n_parts)
+            ]
+            for p in range(n_parts)
+        ]
+        Eh = max(
+            (len(sl) for row in send_lists for sl in row), default=0
+        )
+        Eh = max(Eh, 1)
+        halo_send = np.full((n_parts, n_parts, Eh), max_local, np.int32)
+        halo_recv = np.full((n_parts, n_parts, Eh), max_local, np.int32)
+        for p in range(n_parts):
+            for q in range(n_parts):
+                sl = send_lists[p][q]
+                if len(sl) == 0:
+                    continue
+                halo_send[p, q, : len(sl)] = sl
+                # Receiver q, block p: owner-local rows of those elements.
+                halo_recv[q, p, : len(sl)] = row_owner_local[p, sl]
+        halo_kwargs.update(
+            row_owner=jnp.asarray(row_owner, jnp.int32),
+            row_owner_local=jnp.asarray(row_owner_local, jnp.int32),
+            halo_send_rows=jnp.asarray(halo_send, jnp.int32),
+            halo_recv_rows=jnp.asarray(halo_recv, jnp.int32),
+        )
 
     dtype = mesh.dtype
     return MeshPartition(
@@ -189,8 +317,9 @@ def partition_mesh(
         face_d=jnp.asarray(h_face_d, dtype),
         tet2tet_enc=jnp.asarray(enc, jnp.int32),
         class_id=jnp.asarray(h_class, jnp.int32),
-        nbr_class=jnp.asarray(h_nbr_class, jnp.int32),
+        nbr_class=jnp.asarray(nbr_class_rows, jnp.int32),
         volumes=jnp.asarray(h_volumes, dtype),
+        **halo_kwargs,
     )
 
 

@@ -48,17 +48,19 @@ def main():
 
     cells = int(sys.argv[1]) if len(sys.argv) > 1 else 55
     n = int(sys.argv[2]) if len(sys.argv) > 2 else 65536
+    halo = int(sys.argv[3]) if len(sys.argv) > 3 else 0
     n_dev = 8
     n_groups = 4
     dtype = jnp.float32
 
     t0 = time.perf_counter()
     mesh = build_box(1.0, 1.0, 1.0, cells, cells, cells, dtype=dtype)
-    part = partition_mesh(mesh, n_dev)
+    part = partition_mesh(mesh, n_dev, halo_layers=halo)
     build_s = time.perf_counter() - t0
     print(
         f"[dryrun-1m] {mesh.ntet} tets, {n_dev} parts "
-        f"(max_local {part.max_local}), {n} particles, build {build_s:.0f}s",
+        f"(max_local {part.max_local}, halo {halo}), {n} particles, "
+        f"build {build_s:.0f}s",
         file=sys.stderr, flush=True,
     )
 
@@ -154,6 +156,8 @@ def main():
 
     rec = {
         "metric": "partitioned_1m_dryrun",
+        "halo_layers": halo,
+        "max_local": part.max_local,
         "round_pending": stats[0].tolist(),
         "round_sent": stats[1].tolist(),
         "round_received": stats[2].tolist(),
