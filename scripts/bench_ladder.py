@@ -116,7 +116,10 @@ def run_partitioned(n_devices=8, cells=32, n_particles=65536, steps=3):
         f"{n_particles} particles (virtual={virtual})",
         file=sys.stderr, flush=True,
     )
-    part = partition_mesh(mesh, n_devices)
+    # 2-layer buffered-picparts halo: measured at 1M tets it cuts the
+    # migration rounds 27 -> 3 (cut ping-pong; BENCHMARKS.md round-4
+    # section) at +9% table memory, exact results.
+    part = partition_mesh(mesh, n_devices, halo_layers=2)
     dmesh = make_device_mesh(n_devices)
     # unroll/compact_after are TPU dispatch-amortization knobs; on the
     # virtual CPU mesh they only add wasted body evaluations (measured

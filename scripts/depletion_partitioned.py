@@ -22,7 +22,13 @@ virtual CPU mesh:
     double-scoring detector), and n_dropped == 0.
 
 Usage: XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-       python scripts/depletion_partitioned.py [cells] [n_particles] [steps]
+       python scripts/depletion_partitioned.py \
+           [cells] [n_particles] [steps] [halo_layers]
+
+halo_layers defaults to 1 (buffered-picparts, parallel/mesh_partition.py)
+— the production-shaped choice for this rehearsal; pass 0 to reproduce
+the unbuffered library default, 2 for the bench ladder's configuration.
+The emitted JSON records the value either way.
 
 Writes one JSON line (PARTITIONED_DEPLETION evidence).
 """
@@ -38,7 +44,9 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def run_rehearsal(cells: int, n: int, n_steps: int) -> dict:
+def run_rehearsal(
+    cells: int, n: int, n_steps: int, halo_layers: int = 1
+) -> dict:
     """Run the partitioned depletion rehearsal; returns the evidence dict.
     Requires >= 8 JAX devices (virtual CPU mesh in tests/scripts)."""
     import jax
@@ -73,7 +81,7 @@ def run_rehearsal(cells: int, n: int, n_steps: int) -> dict:
     mesh = TetMesh.from_numpy(
         coords, tet2vert, class_id=class_id, dtype=dtype
     )
-    part = partition_mesh(mesh, n_dev)
+    part = partition_mesh(mesh, n_dev, halo_layers=halo_layers)
     build_s = time.perf_counter() - t0
 
     # One-nuclide-per-region inventory (models/depletion.py physics).
@@ -204,6 +212,7 @@ def run_rehearsal(cells: int, n: int, n_steps: int) -> dict:
     ordered = (1.0 - d1[-1]) > (1.0 - d2[-1])
     rec = dict(
         metric="partitioned_depletion_rehearsal",
+        halo_layers=halo_layers,
         ntet=mesh.ntet,
         n_parts=n_dev,
         n_particles=n,
@@ -224,7 +233,8 @@ def main():
     cells = int(sys.argv[1]) if len(sys.argv) > 1 else 55
     n = int(sys.argv[2]) if len(sys.argv) > 2 else 65536
     n_steps = int(sys.argv[3]) if len(sys.argv) > 3 else 3
-    print(json.dumps(run_rehearsal(cells, n, n_steps)))
+    halo = int(sys.argv[4]) if len(sys.argv) > 4 else 1
+    print(json.dumps(run_rehearsal(cells, n, n_steps, halo)))
 
 
 if __name__ == "__main__":
