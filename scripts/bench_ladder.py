@@ -195,6 +195,7 @@ def run_partitioned(n_devices=8, cells=32, n_particles=65536, steps=3):
                 "n_devices": n_devices,
                 "ntet": mesh.ntet,
                 "n_particles": n_particles,
+                "halo_layers": part.halo_layers,
                 "steps": steps,
                 "compile_s": round(compile_s, 1),
                 "tally_reduce_gbps": round(nbytes / (tr1 - tr0) / 1e9, 3),

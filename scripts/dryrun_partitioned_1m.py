@@ -155,7 +155,13 @@ def main():
     stats = np.asarray(res.round_stats).sum(axis=0)[:, :n_rounds]
 
     rec = {
-        "metric": "partitioned_1m_dryrun",
+        # Scale-tagged so multi-round evidence aggregation never mixes
+        # rungs (the 10M rung reuses this script at cells=119).
+        "metric": (
+            "partitioned_10m_dryrun"
+            if mesh.ntet > 5_000_000
+            else "partitioned_1m_dryrun"
+        ),
         "halo_layers": halo,
         "max_local": part.max_local,
         "round_pending": stats[0].tolist(),

@@ -489,3 +489,43 @@ def test_halo_cuts_migration_rounds():
     np.testing.assert_allclose(
         got["track_length"], np.asarray(ref.track_length), atol=1e-12
     )
+
+
+@pytest.mark.slow
+def test_partitioned_halo_jittered_mesh_parity():
+    """Halo parity on an IRREGULAR mesh (jittered interior vertices,
+    near-degenerate tets): the robustness trio (entry-face mask with the
+    canonical cross-cut back-reference, chase, bump) must agree with the
+    single-chip walk through buffered guest elements too. f64, same
+    arithmetic => exact agreement."""
+    from test_jittered_mesh import _jittered_mesh
+
+    mesh = _jittered_mesh(6, 0.25, seed=11, dtype=DTYPE)
+    n = 256
+    rng = np.random.default_rng(9)
+    elem = rng.integers(0, mesh.ntet, n).astype(np.int32)
+    origin = np.asarray(mesh.centroids())[elem]
+    dest = rng.uniform(0.02, 0.98, (n, 3))
+    weight = np.ones(n)
+    group = np.zeros(n, np.int32)
+    ref = _single_chip(mesh, elem, origin, dest, weight, group, n_groups=1)
+    assert bool(np.asarray(ref.done).all())
+    part = partition_mesh(mesh, N_DEV, halo_layers=2)
+    res, got = _partitioned(
+        mesh, part, elem, origin, dest, weight, group, n_groups=1
+    )
+    assert got["done"].all()
+    assert int(np.sum(np.asarray(res.n_dropped))) == 0
+    g_flux = assemble_global_flux(part, res.flux)
+    np.testing.assert_allclose(
+        g_flux, np.asarray(ref.flux), rtol=0, atol=1e-12
+    )
+    np.testing.assert_allclose(
+        got["position"], np.asarray(ref.position), atol=1e-12
+    )
+    np.testing.assert_array_equal(
+        got["material_id"], np.asarray(ref.material_id)
+    )
+    np.testing.assert_allclose(
+        got["track_length"], np.asarray(ref.track_length), atol=1e-12
+    )
