@@ -14,6 +14,7 @@ from .parallel.mesh_partition import (
     assemble_global_flux,
     partition_mesh,
 )
+from .parallel.partitioned_api import PartitionedTally
 from .core.state import ParticleState, make_particle_state
 from .core.tally import make_flux, normalize_flux, reaction_rate
 from .mesh.box import build_box, build_box_arrays
@@ -29,6 +30,7 @@ __version__ = "0.1.0"
 
 __all__ = [
     "PumiTally",
+    "PartitionedTally",
     "MeshPartition",
     "partition_mesh",
     "assemble_global_flux",

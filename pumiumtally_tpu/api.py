@@ -47,6 +47,17 @@ from .utils.config import TallyConfig
 from .utils.timing import TallyTimes, phase_timer
 
 
+def _check_group_range(group: np.ndarray, n_groups: int) -> None:
+    """Host-side group-bounds rejection shared by both facades (the
+    reference hard-asserts on device, cpp:634-638)."""
+    if group.size and (group.min() < 0 or group.max() >= n_groups):
+        bad = group[(group < 0) | (group >= n_groups)]
+        raise ValueError(
+            f"energy group indices out of range [0, {n_groups}): "
+            f"{np.unique(bad)!r}"
+        )
+
+
 def _out_param(arr, name: str, expected_dtypes, min_size: int) -> np.ndarray:
     """Validate an out-param array the way the reference's raw-pointer ABI
     implies: writable, C-contiguous, correctly typed and sized. Returns a
@@ -136,15 +147,7 @@ class PumiTally:
         return host if self._perm is None else host[self._perm]
 
     def _check_groups(self, group: np.ndarray) -> None:
-        # The reference hard-asserts group bounds on device (cpp:634-638).
-        if group.size and (
-            group.min() < 0 or group.max() >= self.config.n_groups
-        ):
-            bad = group[(group < 0) | (group >= self.config.n_groups)]
-            raise ValueError(
-                f"energy group indices out of range [0, {self.config.n_groups}): "
-                f"{np.unique(bad)!r}"
-            )
+        _check_group_range(group, self.config.n_groups)
 
     def _check_finite(self, name: str, arr: np.ndarray) -> None:
         if self.config.checkify_invariants and not np.isfinite(arr).all():

@@ -1,0 +1,307 @@
+"""PumiTally-shaped facade over the halo-partitioned distributed walk.
+
+The single-chip facade (api.PumiTally) is the reference's 4-call contract
+(images/public_methods_explanation.svg) on one chip's replicated mesh.
+This module is the same contract for the PARTITION-MANDATORY scale
+(BASELINE config 5: ~100M tets × 64 groups overflows both one chip's HBM
+and the int32 flat tally key, ops/walk.py guard): the mesh is split into
+Morton blocks with a buffered-picparts halo (parallel/mesh_partition.py),
+each device walks its own particles with cross-chip migration
+(ops/walk_partitioned.py), and the host sees the familiar surface:
+
+    t = PartitionedTally(mesh, N, TallyConfig(...), n_parts=8)
+    t.initialize_particle_location(pos, 3*N)
+    t.move_to_next_location(dest, flying, w, g, mats, 3*N)   # repeat
+    t.write_pumi_tally_mesh("flux.vtu")
+
+Design notes (vs the device-resident single-chip facade):
+  * Particle state lives HOST-side between calls and is redistributed to
+    owner chips each move (distribute_particles). That is one host↔device
+    round-trip per call — the partitioned facade optimizes for capacity
+    first; a device-resident variant is the make_partitioned_step layer
+    itself, which callers with a fixed batch can drive directly.
+  * The global mesh object is retained for host-side duties (VTK
+    coordinates, volumes for normalization); its numpy tables are the
+    only full-mesh arrays touched after construction.
+  * Flux accumulates in per-chip owned-element slabs across calls (halo
+    rows return zeroed from every step, so the accumulation cannot
+    double-fold guest scores); `raw_flux` assembles the global
+    [ntet, groups, 2] view on demand.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import warnings
+
+from ..api import _check_group_range, _out_param
+from ..ops.walk_partitioned import (
+    collect_by_particle_id,
+    distribute_particles,
+    make_partitioned_step,
+)
+from ..utils.config import TallyConfig
+from .mesh_partition import assemble_global_flux, partition_mesh
+from .particle_sharding import PARTICLE_AXIS as AXIS, make_device_mesh
+
+
+class PartitionedTally:
+    """The 4-call tally contract over a partitioned mesh (see module
+    docstring). Matches PumiTally semantics: element-0-centroid seeding,
+    initial search without tallying, per-move copy-back of clipped
+    positions / material ids / zeroed flying flags."""
+
+    def __init__(
+        self,
+        mesh,
+        num_particles: int,
+        config: TallyConfig | None = None,
+        *,
+        n_parts: int | None = None,
+        device_mesh=None,
+        halo_layers: int = 1,
+        cap: int | None = None,
+        exchange_size: int | None = None,
+        max_rounds: int | None = None,
+    ):
+        self.mesh = mesh
+        self.num_particles = int(num_particles)
+        self.config = config if config is not None else TallyConfig()
+        if mesh.dtype != jnp.dtype(self.config.dtype):
+            raise ValueError(
+                f"mesh dtype {mesh.dtype} != config dtype "
+                f"{self.config.dtype}"
+            )
+        if device_mesh is None:
+            device_mesh = make_device_mesh(n_parts)
+        self.device_mesh = device_mesh
+        self.n_parts = int(device_mesh.shape[AXIS])
+        self.partition = partition_mesh(
+            mesh, self.n_parts, halo_layers=halo_layers
+        )
+        self.cap = int(cap) if cap is not None else self.num_particles
+        if self.cap < self.num_particles:
+            # The element-0 seed places EVERY particle on one chip before
+            # the initial search, so any smaller cap is guaranteed to
+            # fail at the first distribute; reject it up front. (A
+            # sub-num_particles cap belongs to the device-resident
+            # make_partitioned_step layer, where the caller controls
+            # placement.)
+            raise ValueError(
+                f"cap={self.cap} < num_particles={self.num_particles}: "
+                "the element-0 seeding of initialize_particle_location "
+                "needs every particle to fit one chip"
+            )
+        self._step_kwargs = dict(
+            n_groups=self.config.n_groups,
+            max_crossings=self.config.resolve_max_crossings(mesh.ntet),
+            tolerance=self.config.tolerance,
+            score_squares=self.config.score_squares,
+            unroll=self.config.unroll,
+            robust=self.config.robust,
+            tally_scatter=self.config.tally_scatter,
+            exchange_size=exchange_size,
+            max_rounds=max_rounds,
+        )
+        self._steps: dict = {}
+        self.flux_slabs = jax.device_put(
+            jnp.zeros(
+                (
+                    self.n_parts,
+                    self.partition.max_local,
+                    self.config.n_groups,
+                    2,
+                ),
+                self.config.dtype,
+            ),
+            NamedSharding(device_mesh, P(AXIS)),
+        )
+        # Host-side particle state (PumiTally seeds at element 0's
+        # centroid with parent element 0, api.py) — element 0's four
+        # vertices only, no full-mesh centroid pass (core/state.py:53).
+        c0 = np.asarray(mesh.coords, np.float64)[
+            np.asarray(mesh.tet2vert[0])
+        ].mean(axis=0, keepdims=True)
+        self.positions = np.repeat(c0, self.num_particles, axis=0)
+        self.elem_global = np.zeros(self.num_particles, np.int64)
+        self.material_id = np.full(self.num_particles, -1, np.int32)
+        self.iter_count = 0
+        self.total_segments = 0
+        self.total_rounds = 0
+        self._initialized = False
+
+    # ------------------------------------------------------------------ #
+    def _step(self, initial: bool):
+        key = bool(initial)
+        if key not in self._steps:
+            self._steps[key] = make_partitioned_step(
+                self.device_mesh,
+                self.partition,
+                initial=initial,
+                **self._step_kwargs,
+            )
+        return self._steps[key]
+
+    def _run(self, dest, in_flight, weight, group, initial):
+        n = self.num_particles
+        moving = in_flight != 0
+        placed = distribute_particles(
+            self.partition,
+            self.device_mesh,
+            self.elem_global[moving],
+            dict(
+                origin=self.positions[moving],
+                dest=dest[moving],
+                weight=weight[moving],
+                group=group[moving],
+                material_id=self.material_id[moving],
+            ),
+            cap=self.cap,
+        )
+        res = self._step(initial)(
+            placed["origin"].astype(self.config.dtype),
+            placed["dest"].astype(self.config.dtype),
+            placed["elem"],
+            jnp.zeros_like(placed["valid"]),
+            placed["material_id"],
+            placed["weight"].astype(self.config.dtype),
+            placed["group"],
+            placed["particle_id"],
+            placed["valid"],
+            self.flux_slabs,
+        )
+        self.flux_slabs = res.flux
+        got = collect_by_particle_id(
+            res, int(moving.sum()), self.partition
+        )
+        if int(np.asarray(res.n_dropped).sum()) != 0:
+            raise RuntimeError(
+                "partitioned walk dropped immigrants: raise cap"
+            )
+        # Fold the moved particles back into full host order.
+        self.positions[moving] = got["position"]
+        self.elem_global[moving] = got["elem_global"]
+        if not initial:
+            self.material_id[moving] = got["material_id"]
+        self.total_segments += int(np.asarray(res.n_segments).sum())
+        self.total_rounds += int(np.asarray(res.n_rounds)[0])
+        n_lost = int(np.sum(~got["done"]))
+        if n_lost:
+            warnings.warn(
+                f"{n_lost} partitioned walk(s) truncated (max_crossings="
+                f"{self._step_kwargs['max_crossings']} or the migration "
+                "round bound); tallies for them are incomplete. Raise "
+                "TallyConfig.max_crossings / max_rounds.",
+                RuntimeWarning,
+                stacklevel=4,
+            )
+        return got, moving
+
+    # ------------------------------------------------------------------ #
+    def initialize_particle_location(
+        self, init_particle_positions: np.ndarray, size: int | None = None
+    ) -> None:
+        """Parent-element search: fly from the element-0 seed to the true
+        source positions; nothing is tallied (cpp:360-385 semantics)."""
+        n = self.num_particles
+        pos = np.ascontiguousarray(
+            init_particle_positions, np.float64
+        ).reshape(-1)
+        if size is None:
+            size = pos.size
+        assert size == n * 3
+        dest = pos[:size].reshape(-1, 3)
+        self._run(
+            dest,
+            np.ones(n, np.int8),
+            np.ones(n),
+            np.zeros(n, np.int32),
+            initial=True,
+        )
+        self._initialized = True
+
+    def move_to_next_location(
+        self,
+        particle_destinations: np.ndarray,
+        flying: np.ndarray,
+        weights: np.ndarray,
+        groups: np.ndarray,
+        material_ids: np.ndarray,
+        size: int | None = None,
+    ) -> None:
+        """Advance in-flight particles, tally, and copy clipped positions /
+        material ids back into the caller's arrays; flying flags reset to
+        0 (the cpp:221-319 call-site contract, like api.PumiTally)."""
+        assert self._initialized, (
+            "initialize_particle_location must run before moves"
+        )
+        n = self.num_particles
+        dest_flat = _out_param(
+            particle_destinations, "particle_destinations",
+            [np.float64], n * 3,
+        )
+        if size is None:
+            size = dest_flat.size
+        assert size == n * 3
+        flying_flat = _out_param(flying, "flying", [np.int8], n)
+        mats_flat = _out_param(material_ids, "material_ids", [np.int32], n)
+        weights_h = np.asarray(weights, np.float64).reshape(-1)[:n]
+        groups_h = np.asarray(groups, np.int32).reshape(-1)[:n]
+        _check_group_range(groups_h, self.config.n_groups)
+
+        dest = dest_flat[: n * 3].reshape(n, 3)
+        got, moving = self._run(
+            dest, flying_flat[:n], weights_h, groups_h, initial=False
+        )
+        self.iter_count += 1
+        # Copy-back contract, including parked lanes: a flying=0 particle
+        # is not advanced and reports its HELD position and material (the
+        # single-chip facade's in_flight semantics, ops/walk.py).
+        out_pos = dest_flat[: n * 3].reshape(n, 3)
+        out_pos[moving] = got["position"]
+        out_pos[~moving] = self.positions[~moving]
+        mats_flat[:n][moving] = got["material_id"]
+        mats_flat[:n][~moving] = self.material_id[~moving]
+        flying_flat[:n] = 0
+
+    # ------------------------------------------------------------------ #
+    @property
+    def raw_flux(self) -> np.ndarray:
+        """Assembled global [ntet, n_groups, 2] accumulator."""
+        return assemble_global_flux(self.partition, self.flux_slabs)
+
+    def normalized_flux(self) -> np.ndarray:
+        from ..core.tally import normalize_flux
+
+        return np.asarray(
+            normalize_flux(
+                jnp.asarray(self.raw_flux),
+                self.mesh.volumes,
+                self.num_particles,
+                max(self.iter_count, 1),
+            )
+        )
+
+    def reaction_rate(self, sigma: np.ndarray) -> np.ndarray:
+        from ..core.tally import reaction_rate
+
+        return np.asarray(
+            reaction_rate(
+                jnp.asarray(self.raw_flux),
+                self.mesh.class_id,
+                jnp.asarray(sigma, self.config.dtype),
+            )
+        )
+
+    def write_pumi_tally_mesh(self, filename: str | None = None) -> str:
+        """Single-file VTK of the assembled normalized flux (PumiTally
+        contract); per-host PVTU pieces live in parallel/multihost.py."""
+        from ..io.vtk import write_flux_vtk
+
+        name = filename or self.config.output_filename
+        write_flux_vtk(name, self.mesh, self.normalized_flux())
+        return name
