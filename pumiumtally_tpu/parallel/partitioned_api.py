@@ -96,6 +96,9 @@ class PartitionedTally:
                 "the element-0 seeding of initialize_particle_location "
                 "needs every particle to fit one chip"
             )
+        # Straggler compaction resolves against the per-chip slot count
+        # (cap), the lane width each walk phase actually sweeps.
+        compact = self.config.resolve_compaction(self.cap)
         self._step_kwargs = dict(
             n_groups=self.config.n_groups,
             max_crossings=self.config.resolve_max_crossings(mesh.ntet),
@@ -104,6 +107,9 @@ class PartitionedTally:
             unroll=self.config.unroll,
             robust=self.config.robust,
             tally_scatter=self.config.tally_scatter,
+            compact_after=compact[0],
+            compact_size=compact[1],
+            compact_stages=self.config.resolve_compact_stages(self.cap),
             exchange_size=exchange_size,
             max_rounds=max_rounds,
         )
@@ -135,6 +141,11 @@ class PartitionedTally:
         self._initialized = False
 
     # ------------------------------------------------------------------ #
+    def _check_finite(self, name: str, arr: np.ndarray) -> None:
+        # Same opt-in host-side validation as PumiTally (api.py).
+        if self.config.checkify_invariants and not np.isfinite(arr).all():
+            raise ValueError(f"{name} contains non-finite values")
+
     def _step(self, initial: bool):
         key = bool(initial)
         if key not in self._steps:
@@ -147,7 +158,6 @@ class PartitionedTally:
         return self._steps[key]
 
     def _run(self, dest, in_flight, weight, group, initial):
-        n = self.num_particles
         moving = in_flight != 0
         placed = distribute_particles(
             self.partition,
@@ -197,7 +207,7 @@ class PartitionedTally:
                 "round bound); tallies for them are incomplete. Raise "
                 "TallyConfig.max_crossings / max_rounds.",
                 RuntimeWarning,
-                stacklevel=4,
+                stacklevel=3,
             )
         return got, moving
 
@@ -214,6 +224,7 @@ class PartitionedTally:
         if size is None:
             size = pos.size
         assert size == n * 3
+        self._check_finite("init_particle_positions", pos)
         dest = pos[:size].reshape(-1, 3)
         self._run(
             dest,
@@ -252,6 +263,8 @@ class PartitionedTally:
         weights_h = np.asarray(weights, np.float64).reshape(-1)[:n]
         groups_h = np.asarray(groups, np.int32).reshape(-1)[:n]
         _check_group_range(groups_h, self.config.n_groups)
+        self._check_finite("particle_destinations", dest_flat)
+        self._check_finite("weights", weights_h)
 
         dest = dest_flat[: n * 3].reshape(n, 3)
         got, moving = self._run(
