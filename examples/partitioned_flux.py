@@ -57,11 +57,15 @@ def main() -> None:
         )
     n_groups, n = 4, 20_000
     mesh = build_box(1.0, 1.0, 1.0, 16, 16, 16)
-    part = partition_mesh(mesh, n_parts)
+    # 2-layer buffered-picparts halo: particles walk/score through
+    # buffered neighbor elements as guests, collapsing the
+    # one-round-per-recross migration ping-pong at cut boundaries
+    # (1M-tet measurement: rounds 27 -> 3; BENCHMARKS.md round 4).
+    part = partition_mesh(mesh, n_parts, halo_layers=2)
     dmesh = make_device_mesh(n_parts)
     print(
         f"mesh: {mesh.ntet} tets in {n_parts} parts "
-        f"(max {part.max_local} owned elements/chip)"
+        f"(max {part.max_local} owned+halo elements/chip)"
     )
 
     step = make_partitioned_step(
