@@ -323,6 +323,25 @@ def partition_mesh(
     )
 
 
+def disassemble_global_flux(
+    partition: MeshPartition, global_flux: np.ndarray
+) -> np.ndarray:
+    """Inverse of assemble_global_flux: scatter a global [ntet, g, 2]
+    accumulator into per-chip owned-element slabs [n_parts, max_local, g,
+    2]. Halo and pad rows are left ZERO — the walk's accumulation
+    invariant (guest flux is folded out and halo rows zeroed every step),
+    so a restored run cannot double-fold."""
+    global_flux = np.asarray(global_flux)
+    slabs = np.zeros(
+        (partition.n_parts, partition.max_local) + global_flux.shape[1:],
+        global_flux.dtype,
+    )
+    for p in range(partition.n_parts):
+        n = int(partition.counts[p])
+        slabs[p, :n] = global_flux[partition.local2global[p, :n]]
+    return slabs
+
+
 def assemble_global_flux(
     partition: MeshPartition, flux_slabs: np.ndarray
 ) -> np.ndarray:

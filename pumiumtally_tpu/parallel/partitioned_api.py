@@ -310,6 +310,21 @@ class PartitionedTally:
             )
         )
 
+    def save_checkpoint(self, filename: str) -> None:
+        """Persist flux (assembled — partition-layout independent) +
+        particle state + counters; resumable under a different part
+        count or halo depth (utils/checkpoint.py)."""
+        from ..utils.checkpoint import save_partitioned_checkpoint
+
+        save_partitioned_checkpoint(filename, self)
+
+    def restore_checkpoint(self, filename: str) -> None:
+        """Inverse of save_checkpoint; validates the mesh fingerprint and
+        run shape before overwriting any state."""
+        from ..utils.checkpoint import restore_partitioned_checkpoint
+
+        restore_partitioned_checkpoint(filename, self)
+
     def write_pumi_tally_mesh(self, filename: str | None = None) -> str:
         """Single-file VTK of the assembled normalized flux (PumiTally
         contract); per-host PVTU pieces live in parallel/multihost.py."""

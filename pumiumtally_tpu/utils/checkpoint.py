@@ -76,6 +76,37 @@ def load_meta(filename: str) -> dict:
         return json.loads(bytes(z["meta"].tobytes()).decode())
 
 
+def _validate_meta(meta: dict, tally, expected_kind: str | None) -> None:
+    """Shared restore-side validation: format, kind, mesh identity, run
+    shape. Raises on any mismatch rather than silently resuming a
+    different run (both facades)."""
+    if meta["format_version"] != FORMAT_VERSION:
+        raise ValueError(
+            f"checkpoint format {meta['format_version']} != "
+            f"{FORMAT_VERSION}"
+        )
+    kind = meta.get("kind")
+    if kind != expected_kind:
+        raise ValueError(
+            f"checkpoint kind {kind!r} does not match this facade "
+            f"(expected {expected_kind!r}: use "
+            f"{'PartitionedTally' if kind == 'partitioned' else 'PumiTally'}"
+            ".restore_checkpoint for this file)"
+        )
+    if meta["mesh_fingerprint"] != mesh_fingerprint(tally.mesh):
+        raise ValueError("checkpoint was written against a different mesh")
+    if meta["num_particles"] != tally.num_particles:
+        raise ValueError(
+            f"checkpoint has {meta['num_particles']} particles, tally "
+            f"has {tally.num_particles}"
+        )
+    if meta["n_groups"] != tally.config.n_groups:
+        raise ValueError(
+            f"checkpoint has {meta['n_groups']} energy groups, config "
+            f"has {tally.config.n_groups}"
+        )
+
+
 def restore_checkpoint(filename: str, tally) -> None:
     """Restore state saved by save_checkpoint into a PumiTally constructed
     with the same mesh and config. Raises on any mismatch rather than
@@ -84,25 +115,7 @@ def restore_checkpoint(filename: str, tally) -> None:
 
     with np.load(_normalize(filename)) as z:
         meta = json.loads(bytes(z["meta"].tobytes()).decode())
-        if meta["format_version"] != FORMAT_VERSION:
-            raise ValueError(
-                f"checkpoint format {meta['format_version']} != "
-                f"{FORMAT_VERSION}"
-            )
-        if meta["mesh_fingerprint"] != mesh_fingerprint(tally.mesh):
-            raise ValueError(
-                "checkpoint was written against a different mesh"
-            )
-        if meta["num_particles"] != tally.num_particles:
-            raise ValueError(
-                f"checkpoint has {meta['num_particles']} particles, tally "
-                f"has {tally.num_particles}"
-            )
-        if meta["n_groups"] != tally.config.n_groups:
-            raise ValueError(
-                f"checkpoint has {meta['n_groups']} energy groups, config "
-                f"has {tally.config.n_groups}"
-            )
+        _validate_meta(meta, tally, expected_kind=None)
         dtype = tally.config.dtype
         tally.flux = jnp.asarray(z["flux"], dtype)
         tally.state = tally.state._replace(
@@ -120,3 +133,65 @@ def restore_checkpoint(filename: str, tally) -> None:
         tally._initialized = bool(meta["initialized"])
         perm = z["perm"]
         tally._perm = None if perm.size == 0 else perm.astype(np.int64)
+
+
+def save_partitioned_checkpoint(filename: str, tally) -> None:
+    """Serialize a PartitionedTally's resumable state.
+
+    The flux is stored ASSEMBLED (global element order), so a checkpoint
+    is partition-layout independent: it can resume under a different
+    part count or halo depth (the owned-slab layout is derived state).
+    Particle state is the facade's host-side arrays.
+    """
+    filename = _normalize(filename)
+    meta = {
+        "format_version": FORMAT_VERSION,
+        "kind": "partitioned",
+        "mesh_fingerprint": mesh_fingerprint(tally.mesh),
+        "num_particles": tally.num_particles,
+        "n_groups": tally.config.n_groups,
+        "iter_count": tally.iter_count,
+        "total_segments": tally.total_segments,
+        "total_rounds": tally.total_rounds,
+        "initialized": tally._initialized,
+        "dtype": str(np.dtype(tally.config.dtype)),
+    }
+    np.savez_compressed(
+        filename,
+        meta=np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8),
+        flux=np.asarray(tally.raw_flux),
+        positions=tally.positions,
+        elem_global=tally.elem_global,
+        material_id=tally.material_id,
+    )
+
+
+def restore_partitioned_checkpoint(filename: str, tally) -> None:
+    """Restore state saved by save_partitioned_checkpoint into a
+    PartitionedTally on the same mesh (any partition layout)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..parallel.particle_sharding import PARTICLE_AXIS
+
+    with np.load(_normalize(filename)) as z:
+        meta = json.loads(bytes(z["meta"].tobytes()).decode())
+        _validate_meta(meta, tally, expected_kind="partitioned")
+        from ..parallel.mesh_partition import disassemble_global_flux
+
+        slabs = disassemble_global_flux(
+            tally.partition,
+            z["flux"].astype(np.dtype(tally.config.dtype)),
+        )
+        tally.flux_slabs = jax.device_put(
+            jnp.asarray(slabs),
+            NamedSharding(tally.device_mesh, P(PARTICLE_AXIS)),
+        )
+        tally.positions = z["positions"].copy()
+        tally.elem_global = z["elem_global"].copy()
+        tally.material_id = z["material_id"].copy()
+        tally.iter_count = int(meta["iter_count"])
+        tally.total_segments = int(meta["total_segments"])
+        tally.total_rounds = int(meta["total_rounds"])
+        tally._initialized = bool(meta["initialized"])
