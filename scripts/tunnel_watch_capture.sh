@@ -5,13 +5,27 @@
 #   nohup bash scripts/tunnel_watch_capture.sh >/tmp/tw.log 2>&1 &
 # NOTE: one JAX process holds the TPU exclusively — never run anything
 # else against the device while the capture is going.
+#
+# DEADLINE_EPOCH (optional env, unix seconds): the watcher stops waiting
+# and any running capture is killed at this time — the driver's own
+# round-end bench.py run needs the chip free, and a detached capture
+# that outlives the session would hold the exclusive device and starve
+# it. Default: 12h from launch.
 cd "$(dirname "$0")/.."
 CAPTURE="${1:-scripts/tpu_round3_capture2.sh}"
+DEADLINE_EPOCH="${DEADLINE_EPOCH:-$(( $(date +%s) + 43200 ))}"
 while true; do
+  now=$(date +%s)
+  if [ "$now" -ge "$DEADLINE_EPOCH" ]; then
+    echo "$(date -u +%H:%M:%S) deadline reached — exiting without capture"
+    exit 0
+  fi
   if timeout 180 python -c "import jax; print(jax.devices())" \
       >/tmp/tunnel_probe.out 2>&1; then
-    echo "$(date -u +%H:%M:%S) LIVE — starting $CAPTURE"
-    bash "$CAPTURE" > /tmp/capture.log 2>&1
+    left=$(( DEADLINE_EPOCH - $(date +%s) ))
+    echo "$(date -u +%H:%M:%S) LIVE — starting $CAPTURE (budget ${left}s)"
+    timeout --signal=TERM --kill-after=60 "$left" \
+      bash "$CAPTURE" > /tmp/capture.log 2>&1
     echo "$(date -u +%H:%M:%S) capture finished rc=$?"
     exit 0
   fi
