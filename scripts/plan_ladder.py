@@ -80,25 +80,92 @@ def ladder_slots(active: np.ndarray, n: int, stages, round_cost: float,
             total += span_slots(width, start, nxt)
             rounds += 1
         else:
-            # Final stage: rounds of `width` until the tail is done. Each
-            # round's iteration count is the max remaining need among its
-            # lanes; model longest-first service (consistent across
-            # candidates, slightly optimistic vs the real first-k-by-index
-            # pick): round j's span runs to the need of the j*width-th
-            # longest-lived lane, read off the decay curve by inverting
-            # active[] (monotone decreasing: #lanes needing > x = active[x]).
-            served = 0
-            while alive - served > 0:
-                # need of the (served)-th longest lane = largest x with
-                # active[x] > served
-                nd = int(np.searchsorted(-np.asarray(active), -served,
-                                         side="left")) - 1
-                nd = max(nd, start)
-                total += span_slots(width, start, min(nd, kmax))
-                rounds += 1
-                served += width
+            # Final stage: delegate to the standalone model (shared with
+            # optimize_ladder's DP so evaluator and optimizer can never
+            # drift apart).
+            total += final_loop_slots(
+                active, width, start, round_cost, unroll
+            )
             break
     return total + rounds * round_cost
+
+
+def pinned_width(active, k, floor=8192):
+    """Smallest power of two >= the live count at crossing k (never below
+    the live count, so the fake-cheap overflow caveat cannot apply),
+    floored. Shared by the DP optimizer and the candidate builders."""
+    kmax = len(active) - 1
+    a = active[min(k, kmax)]
+    return int(max(2 ** int(np.ceil(np.log2(max(a, 1)))), floor))
+
+
+def final_loop_slots(active, width, start, round_cost, unroll=8):
+    """Slot cost of ENDING the ladder at `start` with a looping final
+    stage of `width`: rounds of `width` until the tail is done, each
+    round's span read off the decay curve by longest-first service
+    (consistent across candidates, slightly optimistic vs the real
+    first-k-by-index pick). Shared by ladder_slots and the DP."""
+    kmax = len(active) - 1
+    alive = active[min(start, kmax)]
+    total, served, rounds = 0.0, 0, 0
+    while alive - served > 0:
+        nd = int(
+            np.searchsorted(-np.asarray(active), -served, side="left")
+        ) - 1
+        nd = max(nd, start)
+        span = min(nd, kmax) - start
+        span = -(-span // unroll) * unroll
+        total += width * span
+        rounds += 1
+        served += width
+    return total + rounds * round_cost
+
+
+def optimize_ladder(active, n, round_cost, unroll=8, grid_step=4,
+                    width_floor=8192):
+    """Optimum of the slot model over stage starts on a grid (shortest
+    path; exact over starts in range(grid_step, min(kmax, 512),
+    grid_step) — off-grid starts are not searched).
+
+    With each stage's width pinned to the smallest power of two >= the
+    survivor count at its start (pinned_width — never below the live
+    count, so the fake-cheap overflow caveat cannot apply), the model's
+    cost decomposes per stage: intermediate stage [a, b) costs
+    width(a) x span_unroll(a, b) + round_cost, and ending at `a` costs
+    the final-stage loop. That is a DAG shortest path over candidate
+    starts — solved by DP, no hand-listing.
+    """
+    kmax = len(active) - 1
+
+    def w_of(k):
+        return pinned_width(active, k, width_floor)
+
+    starts = list(range(grid_step, min(kmax, 512), grid_step))
+    # best[i] = (cost from start_i to completion, schedule tuple)
+    best: dict[int, tuple[float, tuple]] = {}
+    for a in reversed(starts):
+        wa = w_of(a)
+        # Option 1: a is the FINAL stage.
+        c_end = final_loop_slots(active, wa, a, round_cost, unroll)
+        best_here = (c_end, ((a, wa),))
+        # Option 2: one bounded round until a later start b.
+        for b in starts:
+            if b <= a:
+                continue
+            span = -(-(b - a) // unroll) * unroll
+            c = wa * span + round_cost + best[b][0]
+            if c < best_here[0]:
+                best_here = (c, ((a, wa),) + best[b][1])
+        best[a] = best_here
+    # Phase 1 (full width) to the first start; also allow "no ladder".
+    flat = ladder_slots(active, n, (), round_cost, unroll)
+    opt = (flat, ())
+    for a in starts:
+        span = -(-a // unroll) * unroll
+        c = n * span + best[a][0]
+        if c < opt[0]:
+            opt = (c, best[a][1])
+    return opt
 
 
 def main():
@@ -151,9 +218,7 @@ def main():
         return tuple((k, width_of(k)) for k in ks)
 
     def w_of(k):
-        # smallest power-of-two ≥ survivors at k (floor 8192)
-        a = act[min(k, kmax)]
-        return int(max(2 ** int(np.ceil(np.log2(max(a, 1)))), 8192))
+        return pinned_width(act, k)
 
     candidates = {
         "default_r2": ((16, M // 2), (24, M // 4), (40, M // 8)),
@@ -169,9 +234,7 @@ def main():
                 (32, M // 8), (48, M // 16), (64, M // 32), (96, M // 64))
         ),
         "every8": tuple(
-            (k, max(int(2 ** np.ceil(np.log2(max(act[min(k, kmax)], 1)))),
-                    4096))
-            for k in range(8, 128, 8)
+            (k, pinned_width(act, k, 4096)) for k in range(8, 128, 8)
         ),
         "none": (),
     }
@@ -181,6 +244,9 @@ def main():
         print(f"{name:12s} {s/1e6:9.1f} Mslots  ({base/s:4.2f}x vs flat)  "
               f"{stages if len(str(stages)) < 90 else str(stages)[:88]}",
               flush=True)
+    c_opt, sched_opt = optimize_ladder(act, M, round_cost)
+    print(f"{'OPTIMAL_DP':12s} {c_opt/1e6:9.1f} Mslots  "
+          f"({base/c_opt:4.2f}x vs flat)  {sched_opt}", flush=True)
 
 
 if __name__ == "__main__":

@@ -110,6 +110,17 @@ def main():
         ("tail64_96_u32", dict(
             compact_stages=((16, M // 2), (24, M // 4), (40, M // 8),
                             (64, M // 32, 16), (96, M // 64, 32)))),
+        # Round-4 DP optima (scripts/plan_ladder.py optimize_ladder —
+        # exact under the slot model with widths pinned >= the live
+        # count, so none of their cost is unpriced overflow; dense's
+        # early stages sit slightly BELOW the live count and model
+        # fake-cheap). Two round-cost assumptions; hardware arbitrates.
+        ("dp_r250k", dict(
+            compact_stages=((16, M // 2), (24, M // 4), (40, M // 8),
+                            (48, M // 16), (56, M // 32), (76, 8192)))),
+        ("dp_r2m", dict(
+            compact_stages=((16, M // 2), (24, M // 4), (44, M // 16),
+                            (76, 8192)))),
     ]
     for name, kw in variants:
         mseg, ms, iters, cs = run(**kw)
