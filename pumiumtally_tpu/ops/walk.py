@@ -141,6 +141,22 @@ def first_k_active(active: jax.Array, k: int):
     return idx, n_active
 
 
+def record_crossing(xp, kx, xpoint, real_cross):
+    """Record one boundary-crossing point for every ``real_cross`` lane:
+    non-crossing lanes row-index out of bounds (dropped), lanes past K
+    recorded crossings column-index out of bounds (dropped; the count
+    keeps incrementing so callers can detect truncation). Shared by the
+    single-chip and partitioned walk bodies so the recording semantics
+    cannot drift apart."""
+    rows = jnp.where(
+        real_cross, jnp.arange(xp.shape[0], dtype=jnp.int32),
+        jnp.int32(xp.shape[0]),
+    )
+    xp = xp.at[rows, kx].set(xpoint, mode="drop")
+    kx = kx + real_cross.astype(kx.dtype)
+    return xp, kx
+
+
 def chase_face_choice(sd, elem, it, dtype, interior):
     """Stochastic visibility-walk face choice for the relocation chase,
     shared by the single-chip and partitioned walk bodies.
@@ -606,12 +622,7 @@ def trace_impl(
                 # crossings). Non-crossing lanes row-index OOB (dropped);
                 # lanes past K crossings column-index OOB (dropped).
                 real_cross = crossed & ~chase if robust else crossed
-                rows = jnp.where(
-                    real_cross, jnp.arange(xp.shape[0], dtype=jnp.int32),
-                    jnp.int32(xp.shape[0]),
-                )
-                xp = xp.at[rows, kx].set(xpoint, mode="drop")
-                kx = kx + real_cross.astype(kx.dtype)
+                xp, kx = record_crossing(xp, kx, xpoint, real_cross)
             if packed:
                 # Topology came along in the geo20 row: select the exit
                 # face's code locally (no second table gather).

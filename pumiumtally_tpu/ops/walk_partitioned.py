@@ -82,6 +82,7 @@ from .walk import (
     escalated_bump,
     first_k_active,
     normalize_compact_stages,
+    record_crossing,
 )
 
 
@@ -124,14 +125,21 @@ class PartitionedTraceResult(NamedTuple):
     # of tiny pending counts is cut ping-pong (each cut crossing on a
     # particle's path costs one round by construction).
     round_stats: jax.Array | None = None
+    # [n_parts*cap, K, 3] / [n_parts*cap] per-particle boundary-crossing
+    # points and counts when make_partitioned_step(record_xpoints=K) was
+    # requested (ops/walk.py xpoints semantics; the buffers migrate with
+    # their particles across cuts, so a particle's sequence is its full
+    # path order regardless of which chips walked it). None otherwise.
+    xpoints: jax.Array | None = None
+    n_xpoints: jax.Array | None = None
 
 
 def _walk_phase(
     tables, cur, dest, elem, done, target, target_elem, material_id,
-    weight, group, flux, nseg, valid, prev, stuck, pseg,
-    *, initial, tolerance, score_squares, max_crossings, max_local,
+    weight, group, flux, nseg, valid, prev, stuck, pseg, *xpk,
+    initial, tolerance, score_squares, max_crossings, max_local,
     unroll=1, compact_after=None, compact_size=None, compact_stages=None,
-    robust=True, tally_scatter="pair",
+    robust=True, tally_scatter="pair", record_xpoints=None,
 ):
     """Advance every resident particle until done or pending-migration.
 
@@ -176,7 +184,7 @@ def _walk_phase(
     def make_body(dest_a, weight_a, group_a, valid_a):
         def body(carry):
             (cur, elem, done, target, target_elem, material_id, flux,
-             nseg, prev, stuck, pseg, it) = carry
+             nseg, prev, stuck, pseg, *xpk_c, it) = carry
             active = valid_a & ~done & (target < 0)
 
             dirv = dest_a - cur
@@ -240,6 +248,17 @@ def _walk_phase(
             domain_exit = crossed & (enc == -1)
             remote = crossed & (enc < -1)
             local_hop = crossed & (enc >= 0)
+
+            if record_xpoints is not None:
+                # Genuine boundary crossings only, exactly as in
+                # ops/walk.py — including the crossing INTO a remote
+                # element (the cut face is an interior mesh face; it is
+                # recorded once, on the sending chip, and the buffers
+                # migrate with the particle).
+                real_cross = crossed & ~chase if robust else crossed
+                xpk_c = list(
+                    record_crossing(xpk_c[0], xpk_c[1], xpoint, real_cross)
+                )
 
             if not initial:
                 seg = jnp.linalg.norm(xpoint - cur, axis=-1)
@@ -321,7 +340,7 @@ def _walk_phase(
                 )
             done = done | newly_done
             return (cur, elem, done, target, target_elem, material_id,
-                    flux, nseg, prev, stuck, pseg, it + 1)
+                    flux, nseg, prev, stuck, pseg, *xpk_c, it + 1)
 
         return body
 
@@ -354,7 +373,7 @@ def _walk_phase(
     )
     carry = (
         cur, elem, done, target, target_elem, material_id, flux, nseg,
-        prev, stuck, pseg, jnp.int32(0),
+        prev, stuck, pseg, *xpk, jnp.int32(0),
     )
     # Static guard: a stage-0 schedule (the follow-up phases) must not
     # compile the dead full-width while_loop at all.
@@ -366,7 +385,7 @@ def _walk_phase(
             """Gather the first S active lanes, advance them until done or
             pending, scatter back (first_k_active, shared with walk.py)."""
             (cur, elem, done, target, target_elem, material_id, flux,
-             nseg, prev, stuck, pseg, it) = state
+             nseg, prev, stuck, pseg, *xpk_s, it) = state
             active = valid & ~done & (target < 0)
             idx, n_active = first_k_active(active, S)
             sub_ok = jnp.arange(S) < n_active
@@ -376,10 +395,11 @@ def _walk_phase(
             sub_carry = (
                 cur[idx], elem[idx], jnp.logical_not(sub_ok), target[idx],
                 target_elem[idx], material_id[idx], flux, nseg,
-                prev[idx], stuck[idx], pseg[idx], jnp.int32(0),
+                prev[idx], stuck[idx], pseg[idx],
+                *(a[idx] for a in xpk_s), jnp.int32(0),
             )
             (scur, selem, sdone, star, stare, smat, flux, nseg, sprev,
-             sstuck, spseg, sit) = run(
+             sstuck, spseg, *sxpk, sit) = run(
                 sub_body, sub_ok, sub_carry, bound, unroll=stage_unroll
             )
             idx_sb = jnp.where(sub_ok, idx, cap)
@@ -392,8 +412,12 @@ def _walk_phase(
             prev = prev.at[idx_sb].set(sprev, mode="drop")
             stuck = stuck.at[idx_sb].set(sstuck, mode="drop")
             pseg = pseg.at[idx_sb].set(spseg, mode="drop")
+            xpk_s = [
+                a.at[idx_sb].set(v, mode="drop")
+                for a, v in zip(xpk_s, sxpk)
+            ]
             return (cur, elem, done, target, target_elem, material_id,
-                    flux, nseg, prev, stuck, pseg, it + sit)
+                    flux, nseg, prev, stuck, pseg, *xpk_s, it + sit)
 
         def any_active(c):
             done, target = c[2], c[3]
@@ -463,6 +487,7 @@ def make_partitioned_step(
     followup_compact_size: int | None = None,
     robust: bool = True,
     tally_scatter: str = "pair",
+    record_xpoints: int | None = None,
 ):
     """Build the jitted distributed trace step for one mesh partition.
 
@@ -491,6 +516,13 @@ def make_partitioned_step(
       robust/tally_scatter: the degeneracy-recovery and tally-scatter
         strategy knobs of ops/walk.py, applied to the partitioned body
         (same semantics, same defaults).
+      record_xpoints: when set to K, record each particle's first K
+        boundary-crossing points (ops/walk.py semantics — cut faces are
+        interior mesh faces, recorded once on the sending chip). The
+        [cap, K, 3] buffer and its counter ride the walk carry, the
+        compaction rounds, AND the migration exchange (payload grows by
+        3K floats + 1 int per emigrant row), so a particle's recorded
+        sequence is its full path order across chips.
 
     Returns step(cur, dest, elem, done, material, weight, group, pid, valid,
     flux) -> PartitionedTraceResult, where per-particle arrays are
@@ -581,6 +613,7 @@ def make_partitioned_step(
             unroll=unroll,
             robust=robust,
             tally_scatter=tally_scatter,
+            record_xpoints=record_xpoints,
         )
         walk_first = functools.partial(
             _walk_phase,
@@ -609,7 +642,7 @@ def make_partitioned_step(
         def exchange(carry):
             (cur, dest, elem, done, target, target_elem, material_id,
              weight, group, pid, valid, prev, stuck, pseg, flux_l, nseg,
-             dropped) = carry
+             dropped, *xpk) = carry
             emig = valid & (target >= 0)
 
             # Bucket emigrants by destination chip: a stable sort on the
@@ -632,12 +665,16 @@ def make_partitioned_step(
                 buf = jnp.zeros((n_parts * E,) + rows.shape[1:], rows.dtype)
                 return buf.at[slot].set(rows[order], mode="drop")
 
-            pay_f = fill(
-                jnp.concatenate(
-                    [cur, dest, weight[:, None], pseg[:, None]], axis=1
-                )
-            )  # [n_parts*E, 8] — the track-length ledger migrates with
-            # the particle so cut-boundary double-scoring stays visible
+            K3 = 3 * record_xpoints if record_xpoints is not None else 0
+            f_cols = [cur, dest, weight[:, None], pseg[:, None]]
+            if record_xpoints is not None:
+                # The intersection-point buffer migrates with its
+                # particle (flattened [K,3] -> 3K columns).
+                f_cols.append(xpk[0].reshape(cap, K3))
+            pay_f = fill(jnp.concatenate(f_cols, axis=1))
+            # [n_parts*E, 8(+3K)] — the track-length ledger (and the
+            # xpoint buffer) migrate with the particle so cut-boundary
+            # double-scoring stays visible
             # Entry-face identity for the receiver: the face by which
             # the migrated particle enters its new element points back at
             # (this chip, this element), which the receiver's adjacency
@@ -661,20 +698,18 @@ def make_partitioned_step(
             else:
                 canon = -2 - (me * max_local + elem)
             back_code = jnp.where(stuck >= 4, jnp.int32(-1), canon)
-            pay_i = fill(
-                jnp.stack(
-                    [
-                        pid,
-                        group,
-                        material_id,
-                        target_elem,
-                        valid.astype(jnp.int32),  # occupied marker
-                        done.astype(jnp.int32),
-                        back_code,
-                    ],
-                    axis=1,
-                )
-            )  # [n_parts*E, 7]
+            i_cols = [
+                pid,
+                group,
+                material_id,
+                target_elem,
+                valid.astype(jnp.int32),  # occupied marker
+                done.astype(jnp.int32),
+                back_code,
+            ]
+            if record_xpoints is not None:
+                i_cols.append(xpk[1].astype(jnp.int32))  # crossing count
+            pay_i = fill(jnp.stack(i_cols, axis=1))  # [n_parts*E, 7(+1)]
 
             # Sent slots free up.
             sent_src = jnp.where(sendable, order, cap)
@@ -683,12 +718,13 @@ def make_partitioned_step(
 
             # ONE all_to_all: block d of my send buffer goes to chip d;
             # I receive n_parts blocks of rows all addressed to me.
+            FW, IW = 8 + K3, 7 + (1 if record_xpoints is not None else 0)
             g_f = jax.lax.all_to_all(
-                pay_f.reshape(n_parts, E, 8), AXIS, 0, 0, tiled=False
-            ).reshape(n_parts * E, 8)
+                pay_f.reshape(n_parts, E, FW), AXIS, 0, 0, tiled=False
+            ).reshape(n_parts * E, FW)
             g_i = jax.lax.all_to_all(
-                pay_i.reshape(n_parts, E, 7), AXIS, 0, 0, tiled=False
-            ).reshape(n_parts * E, 7)
+                pay_i.reshape(n_parts, E, IW), AXIS, 0, 0, tiled=False
+            ).reshape(n_parts * E, IW)
             mine = g_i[:, 4] == 1  # occupied rows (all addressed to me)
 
             # Place my immigrants into free slots: the i-th immigrant row
@@ -722,6 +758,16 @@ def make_partitioned_step(
             done = place(done, g_i[src, 5].astype(bool))
             prev = place(prev, g_i[src, 6])
             stuck = place(stuck, jnp.zeros_like(stuck[dst]))
+            if record_xpoints is not None:
+                xpk = [
+                    place(
+                        xpk[0],
+                        g_f[src, 8:8 + K3].reshape(
+                            -1, record_xpoints, 3
+                        ).astype(xpk[0].dtype),
+                    ),
+                    place(xpk[1], g_i[src, 7].astype(xpk[1].dtype)),
+                ]
             valid = place(valid, take)
             stats = jnp.stack(
                 [
@@ -733,27 +779,35 @@ def make_partitioned_step(
             )
             return (cur, dest, elem, done, target, target_elem, material_id,
                     weight, group, pid, valid, prev, stuck, pseg, flux_l,
-                    nseg, dropped), stats
+                    nseg, dropped, *xpk), stats
 
         def run_walk(carry, walk_fn):
             (cur, dest, elem, done, target, target_elem, material_id,
              weight, group, pid, valid, prev, stuck, pseg, flux_l, nseg,
-             dropped) = carry
+             dropped, *xpk) = carry
             (cur, elem, done, target, target_elem, material_id, flux_l,
-             nseg, prev, stuck, pseg) = walk_fn(
+             nseg, prev, stuck, pseg, *xpk) = walk_fn(
                 tables_l, cur, dest, elem, done, target, target_elem,
                 material_id, weight, group, flux_l, nseg, valid, prev,
-                stuck, pseg,
+                stuck, pseg, *xpk,
             )
             return (cur, dest, elem, done, target, target_elem, material_id,
                     weight, group, pid, valid, prev, stuck, pseg, flux_l,
-                    nseg, dropped)
+                    nseg, dropped, *xpk)
 
         carry = (
             cur, dest, elem, done, target0, vzero * 0,
             material_id, weight, group, pid, valid, target0 + 0, vzero * 0,
             weight * 0, flux_l, nseg0, nseg0 * 0,
         )
+        if record_xpoints is not None:
+            # Device-varying zeros (shard_map vma rule), like the other
+            # loop-carried lanes.
+            xp0 = (
+                jnp.zeros((cap, int(record_xpoints), 3), cur.dtype)
+                + cur[:, :1, None] * 0
+            )
+            carry = carry + (xp0, vzero * 0)
         carry = run_walk(carry, walk_first)
 
         def pending_somewhere(carry):
@@ -781,7 +835,7 @@ def make_partitioned_step(
         )
         (cur, dest, elem, done, target, target_elem, material_id,
          weight, group, pid, valid, prev, stuck, pseg, flux_l, nseg,
-         dropped) = carry
+         dropped, *xpk) = carry
 
         if has_halo:
             # Fold guest-scored flux back onto owner rows: ONE static
@@ -820,6 +874,8 @@ def make_partitioned_step(
             n_dropped=dropped[None],
             track_length=pseg,
             round_stats=round_stats[None],
+            xpoints=xpk[0] if xpk else None,
+            n_xpoints=xpk[1] if xpk else None,
         )
 
     table_specs = tuple(P(AXIS) for _ in (*tables, *halo_tables))
@@ -844,6 +900,10 @@ def make_partitioned_step(
             n_dropped=P(AXIS),
             track_length=particle_spec,
             round_stats=P(AXIS),
+            xpoints=particle_spec if record_xpoints is not None else None,
+            n_xpoints=(
+                particle_spec if record_xpoints is not None else None
+            ),
         ),
     )
     jitted = jax.jit(
@@ -937,8 +997,11 @@ def collect_by_particle_id(
     sel = valid & (pid >= 0)
     idx = pid[sel]
     out = {}
-    for name in ("position", "material_id", "done", "elem", "weight",
-                 "group", "track_length"):
+    names = ["position", "material_id", "done", "elem", "weight",
+             "group", "track_length"]
+    if result.xpoints is not None:
+        names += ["xpoints", "n_xpoints"]
+    for name in names:
         arr = np.asarray(getattr(result, name))
         buf = np.zeros((n,) + arr.shape[1:], arr.dtype)
         buf[idx] = arr[sel]

@@ -107,6 +107,7 @@ class PartitionedTally:
             unroll=self.config.unroll,
             robust=self.config.robust,
             tally_scatter=self.config.tally_scatter,
+            record_xpoints=self.config.record_xpoints,
             compact_after=compact[0],
             compact_size=compact[1],
             compact_stages=self.config.resolve_compact_stages(self.cap),
@@ -139,6 +140,7 @@ class PartitionedTally:
         self.total_segments = 0
         self.total_rounds = 0
         self._initialized = False
+        self._last_xpoints: tuple | None = None
 
     # ------------------------------------------------------------------ #
     def _check_finite(self, name: str, arr: np.ndarray) -> None:
@@ -199,6 +201,16 @@ class PartitionedTally:
             self.material_id[moving] = got["material_id"]
         self.total_segments += int(np.asarray(res.n_segments).sum())
         self.total_rounds += int(np.asarray(res.n_rounds)[0])
+        if self.config.record_xpoints is not None:
+            # Full host order; parked lanes record nothing (count 0).
+            n = self.num_particles
+            xp = np.zeros(
+                (n, int(self.config.record_xpoints), 3), np.float64
+            )
+            counts = np.zeros(n, np.int32)  # PumiTally contract dtype
+            xp[moving] = got["xpoints"]
+            counts[moving] = got["n_xpoints"]
+            self._last_xpoints = (xp, counts)
         n_lost = int(np.sum(~got["done"]))
         if n_lost:
             warnings.warn(
@@ -310,6 +322,23 @@ class PartitionedTally:
             )
         )
 
+    def intersection_points(self) -> tuple[np.ndarray, np.ndarray]:
+        """Per-particle boundary-crossing points of the LAST call, host
+        order — the PumiTally.intersection_points contract over the
+        partitioned walk (the buffers migrate with their particles, so
+        each sequence is the particle's full path order across chips)."""
+        if self.config.record_xpoints is None:
+            raise ValueError(
+                "set TallyConfig.record_xpoints=K to record intersection "
+                "points (off by default: the hot path pays nothing)"
+            )
+        if self._last_xpoints is None:
+            raise RuntimeError(
+                "no trace has run yet: call initialize_particle_location "
+                "(and move_to_next_location) before intersection_points"
+            )
+        return self._last_xpoints
+
     def save_checkpoint(self, filename: str) -> None:
         """Persist flux (assembled — partition-layout independent) +
         particle state + counters; resumable under a different part
@@ -324,6 +353,10 @@ class PartitionedTally:
         from ..utils.checkpoint import restore_partitioned_checkpoint
 
         restore_partitioned_checkpoint(filename, self)
+        # Recorded crossing points describe the pre-restore trace, not
+        # the restored state — the "LAST call" contract must not serve
+        # them up after a resume.
+        self._last_xpoints = None
 
     def write_pumi_tally_mesh(self, filename: str | None = None) -> str:
         """Single-file VTK of the assembled normalized flux (PumiTally

@@ -529,3 +529,72 @@ def test_partitioned_halo_jittered_mesh_parity():
     np.testing.assert_allclose(
         got["track_length"], np.asarray(ref.track_length), atol=1e-12
     )
+
+
+@pytest.mark.parametrize("halo", [0, 1])
+def test_partitioned_record_xpoints_matches_single_chip(box, halo):
+    """Intersection-point recording on the partitioned walk: the buffers
+    migrate with their particles, so each particle's recorded sequence is
+    its full path order across chips — exactly the single-chip record
+    (cut faces are interior faces, recorded once on the sending chip)."""
+    part = partition_mesh(box, N_DEV, halo_layers=halo)
+    elem, origin, dest, weight, group = _random_batch(box, 96, seed=3)
+    K = 8
+    ref = trace_impl(
+        box,
+        jnp.asarray(origin, DTYPE),
+        jnp.asarray(dest, DTYPE),
+        jnp.asarray(elem),
+        jnp.ones(len(elem), bool),
+        jnp.asarray(weight, DTYPE),
+        jnp.asarray(group),
+        jnp.full(len(elem), -1, jnp.int32),
+        make_flux(box.ntet, 2, DTYPE),
+        initial=False,
+        max_crossings=box.ntet + 8,
+        tolerance=1e-8,
+        record_xpoints=K,
+    )
+    n = len(elem)
+    dmesh = make_device_mesh(N_DEV)
+    placed = distribute_particles(
+        part, dmesh, elem,
+        dict(
+            origin=np.asarray(origin, np.float64),
+            dest=np.asarray(dest, np.float64),
+            weight=np.asarray(weight, np.float64),
+            group=np.asarray(group, np.int32),
+            material_id=np.full(n, -1, np.int32),
+        ),
+    )
+    step = make_partitioned_step(
+        dmesh, part, n_groups=2, max_crossings=box.ntet + 8,
+        tolerance=1e-8, record_xpoints=K,
+        compact_stages=((4, 64), (8, 32)),
+    )
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    flux = jax.device_put(
+        jnp.zeros((N_DEV, part.max_local, 2, 2), DTYPE),
+        NamedSharding(dmesh, P("p")),
+    )
+    res = step(
+        placed["origin"].astype(DTYPE), placed["dest"].astype(DTYPE),
+        placed["elem"], jnp.zeros_like(placed["valid"]),
+        placed["material_id"], placed["weight"].astype(DTYPE),
+        placed["group"], placed["particle_id"], placed["valid"], flux,
+    )
+    got = collect_by_particle_id(res, n)
+    assert got["done"].all()
+    np.testing.assert_array_equal(
+        got["n_xpoints"], np.asarray(ref.n_xpoints)
+    )
+    np.testing.assert_allclose(
+        got["xpoints"], np.asarray(ref.xpoints), atol=1e-12
+    )
+    # And the walk results are still exact alongside the recording.
+    g_flux = assemble_global_flux(part, res.flux)
+    np.testing.assert_allclose(
+        g_flux, np.asarray(ref.flux), rtol=0, atol=1e-12
+    )
+    assert np.asarray(ref.n_xpoints).max() >= 2  # scenario non-trivial

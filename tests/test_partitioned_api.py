@@ -141,3 +141,32 @@ def test_partitioned_checkpoint_roundtrip_across_layouts(mesh, tmp_path):
     c = PartitionedTally(other, N, cfg, n_parts=8)
     with pytest.raises(ValueError, match="different mesh"):
         c.restore_checkpoint(str(tmp_path / "ck"))
+
+
+def test_partitioned_tally_intersection_points_matches_single(mesh):
+    """The facade's intersection_points() must equal PumiTally's for the
+    same moves (getIntersectionPoints parity over the partitioned walk),
+    with parked lanes recording nothing."""
+    cfg = TallyConfig(
+        n_groups=2, dtype=jnp.float64, tolerance=1e-8, record_xpoints=6
+    )
+    single = PumiTally(mesh, N, cfg)
+    parted = PartitionedTally(mesh, N, cfg, n_parts=8, halo_layers=1)
+    rng = np.random.default_rng(31)
+    pos = rng.uniform(0.05, 0.95, (N, 3))
+    dest = np.clip(pos + rng.normal(0, 0.3, (N, 3)), -0.1, 1.1)
+    flying = np.ones(N, np.int8)
+    flying[::5] = 0
+    for t in (single, parted):
+        t.initialize_particle_location(pos.ravel().copy())
+        buf = dest.ravel().copy()
+        t.move_to_next_location(
+            buf, flying.copy(), np.ones(N),
+            np.zeros(N, np.int32), np.zeros(N, np.int32),
+        )
+    xp_s, c_s = single.intersection_points()
+    xp_p, c_p = parted.intersection_points()
+    np.testing.assert_array_equal(c_p, c_s)
+    np.testing.assert_allclose(xp_p, xp_s, atol=1e-12)
+    assert c_s[flying == 0].max() == 0 if (flying == 0).any() else True
+    assert c_s.max() >= 2
