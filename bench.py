@@ -16,8 +16,10 @@ BENCH_ROBUST/BENCH_SCATTER/BENCH_GATHERS/BENCH_LEDGER, and
 BENCH_FUSED (default 1) runs all steps in ONE device program
 (lax.fori_loop) — pure device time, immune to per-dispatch tunnel
 latency; BENCH_FUSED=0 launches one program per step (the gap between
-the modes is the dispatch overhead). Prints exactly ONE JSON line on
-stdout.
+the modes is the dispatch overhead). BENCH_REPEAT (default 2) times
+that many measurement windows on the compiled program and reports the
+best (shared-tunnel interference is one-sided; every window lands in
+detail.windows). Prints exactly ONE JSON line on stdout.
 """
 from __future__ import annotations
 
@@ -46,12 +48,14 @@ def run(
     gathers: str = "merged",
     ledger: bool = True,
     fused: bool = True,
+    repeats: int = 2,
 ) -> dict:
     import jax  # noqa: F401 — must import before the backend pin
 
     from pumiumtally_tpu.utils.platform import maybe_force_cpu
 
     maybe_force_cpu()
+    repeats = max(1, repeats)
     import jax.numpy as jnp
 
     from pumiumtally_tpu import build_box, make_flux
@@ -160,10 +164,19 @@ def run(
         )
         int(np.asarray(tot))
         compile_s = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        pos, elem_c, flux, tot, ncross = run_fused(keys[2:], pos, elem_c, flux)
-        total_segments = int(np.asarray(tot))
-        elapsed = time.perf_counter() - t0
+        # Repeated measurement windows on the SAME compiled program: the
+        # shared tunnel shows ±5% cross-job interference (BENCHMARKS.md
+        # "Sweep variance"), so the headline is the best window — the
+        # closest observable to uncontended device capability. Every
+        # window is recorded in detail.windows.
+        windows = []
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            pos, elem_c, flux, tot, ncross = run_fused(
+                keys[2:], pos, elem_c, flux
+            )
+            wseg = int(np.asarray(tot))
+            windows.append((wseg, time.perf_counter() - t0))
     else:
         # Warmup / compile.
         t0 = time.perf_counter()
@@ -173,20 +186,23 @@ def run(
         pos, elem_c, flux, nseg, _ = step(keys[1], pos, elem_c, flux)
         jax.block_until_ready(pos)
 
-        total_segments = 0
-        t0 = time.perf_counter()
-        for i in range(steps):
-            pos, elem_c, flux, nseg, ncross = step(
-                keys[2 + i], pos, elem_c, flux
-            )
-            total_segments += nseg  # device-side accumulate; read at end
-        # Host readback of a value depending on every step — a stricter
-        # fence than block_until_ready on one output buffer (which proved
-        # unreliable under the remote-TPU runtime; see
-        # scripts/sweep_unroll.py).
-        total_segments = int(np.asarray(total_segments))
-        elapsed = time.perf_counter() - t0
+        windows = []
+        for _ in range(repeats):
+            total_segments = 0
+            t0 = time.perf_counter()
+            for i in range(steps):
+                pos, elem_c, flux, nseg, ncross = step(
+                    keys[2 + i], pos, elem_c, flux
+                )
+                total_segments += nseg  # device-side accumulate; read at end
+            # Host readback of a value depending on every step — a
+            # stricter fence than block_until_ready on one output buffer
+            # (which proved unreliable under the remote-TPU runtime; see
+            # scripts/sweep_unroll.py).
+            total_segments = int(np.asarray(total_segments))
+            windows.append((total_segments, time.perf_counter() - t0))
 
+    total_segments, elapsed = max(windows, key=lambda w: w[0] / w[1])
     segments_per_sec = total_segments / elapsed
 
     # ---- event-loop benchmark (reference §3.3 per-event pattern) -------
@@ -234,6 +250,12 @@ def run(
             "gathers": gathers,
             "ledger": ledger,
             "fused_steps": fused,
+            # Per-window (segments, seconds) for every measurement
+            # repeat; the headline is the best window (tunnel noise is
+            # one-sided — interference only subtracts).
+            "windows": [
+                [w, round(s, 4)] for w, s in windows
+            ],
             # Whether a persistent compile cache was ENABLED (not whether
             # this compile hit it — a cold first run still pays the real
             # remote compile). compile_s under an enabled+warm cache
@@ -517,6 +539,7 @@ def main() -> None:
         # in degraded windows). BENCH_FUSED=0 restores one-launch-per-step
         # (the per-move launch shape; its gap to fused IS that overhead).
         fused=os.environ.get("BENCH_FUSED", "1") == "1",
+        repeats=int(os.environ.get("BENCH_REPEAT", "2")),
     )
     print(
         f"[bench] {result['detail']}", file=sys.stderr
