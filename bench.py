@@ -79,7 +79,10 @@ def run(
         rng.integers(0, n_groups, n_particles).astype(np.int32)
     )
     material = jnp.full(n_particles, -1, jnp.int32)
-    flux = make_flux(mesh.ntet, n_groups, dtype)
+    # Flat device layout — [ntet,n_groups,2] pads its minor dim 2 → 128
+    # under the TPU (8,128) tile (64× HBM; the 64-group config OOMed at
+    # 32.7 GB as 3-D, round 4). See core.tally.make_flux.
+    flux = make_flux(mesh.ntet, n_groups, dtype, flat=True)
 
     if compact_stages == "default":
         # The slot-planned dense ladder (ONE definition, shared with
@@ -123,6 +126,7 @@ def run(
             tally_scatter=tally_scatter,
             gathers=gathers,
             ledger=ledger,
+            n_groups=n_groups,
         )
         return r.position, r.elem, r.flux, r.n_segments, r.n_crossings
 
@@ -361,7 +365,8 @@ def run_event_loop(
     dev_w = jnp.asarray(weights, cfg.dtype)
     dev_g = jnp.asarray(groups)
     dev_m = jnp.full(n_particles, -1, jnp.int32)
-    kflux = make_flux(mesh.ntet, n_groups, cfg.dtype)
+    kw["n_groups"] = n_groups
+    kflux = make_flux(mesh.ntet, n_groups, cfg.dtype, flat=True)
     r = trace(mesh, dev_origin, dev_dests[0], dev_elem, dev_if, dev_w,
               dev_g, dev_m, kflux, **kw)  # warm (already compiled shape)
     int(np.asarray(r.n_segments))  # fence
@@ -523,14 +528,14 @@ def main() -> None:
         ),
         compact_stages=_stages_from_env(),
         unroll=int(os.environ.get("BENCH_UNROLL", "8")),
-        # The bench mesh is a clean box: the degeneracy-recovery
-        # machinery provably never fires (robust on/off is BIT-IDENTICAL
-        # here — tests/test_walk_variants.py pins it), and the reference
-        # tracer has no such machinery either, so the headline doesn't
-        # pay its cost. The library default for real meshes stays
-        # robust=True; BENCH_ROBUST=1 prices the machinery.
-        robust=os.environ.get("BENCH_ROBUST", "0") == "1",
-        tally_scatter=os.environ.get("BENCH_SCATTER", "pair"),
+        # Robust (the library default) measured FREE on TPU in the
+        # round-4 A/B (7.266 vs 7.272 Mseg/s, within noise; the 2.5×
+        # CPU cost does not transfer), so the headline now runs the
+        # library-default configuration. BENCH_ROBUST=0 restores the
+        # reference tracer's truncate-mode semantics for attribution.
+        robust=os.environ.get("BENCH_ROBUST", "1") == "1",
+        # "auto" = interleaved on TPU / pair on CPU (round-4 A/B).
+        tally_scatter=os.environ.get("BENCH_SCATTER", "auto"),
         gathers=os.environ.get("BENCH_GATHERS", "merged"),
         ledger=os.environ.get("BENCH_LEDGER", "1") == "1",
         # Fused is the DEFAULT: the headline is a device-resident kernel

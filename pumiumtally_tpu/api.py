@@ -39,7 +39,7 @@ import jax
 import jax.numpy as jnp
 
 from .core.state import ParticleState, make_particle_state, seed_at_element_centroid
-from .core.tally import make_flux, normalize_flux
+from .core.tally import make_flux, normalize_flux_host
 from .io.vtk import write_flux_vtk
 from .mesh.core import TetMesh
 from .ops.walk import trace
@@ -117,7 +117,11 @@ class PumiTally:
             self.state: ParticleState = seed_at_element_centroid(
                 make_particle_state(self.num_particles, dtype=cfg.dtype), mesh
             )
-            self.flux = make_flux(mesh.ntet, cfg.n_groups, dtype=cfg.dtype)
+            # Flat device layout: [ntet,n_groups,2] on TPU pads the minor
+            # dim 2 → 128 under the (8,128) tile (64× HBM; see make_flux).
+            self.flux = make_flux(
+                mesh.ntet, cfg.n_groups, dtype=cfg.dtype, flat=True
+            )
             self.iter_count = 0
             self.total_segments = 0
             self._initialized = False
@@ -211,6 +215,7 @@ class PumiTally:
                 gathers=self.config.gathers,
                 ledger=self.config.ledger,
                 record_xpoints=self.config.record_xpoints,
+                n_groups=self.config.n_groups,
             )
             self.flux = result.flux
             self.state = s._replace(
@@ -291,6 +296,7 @@ class PumiTally:
                 gathers=cfg.gathers,
                 ledger=cfg.ledger,
                 record_xpoints=cfg.record_xpoints,
+                n_groups=cfg.n_groups,
             )
             self.flux = result.flux
             self.state = s._replace(
@@ -375,28 +381,27 @@ class PumiTally:
 
     def normalized_flux(self) -> np.ndarray:
         """[ntet, n_groups, 3] (mean, second moment, sd) — normalizeFlux
-        parity (cpp:648-683), with the sd NaN guard fix."""
-        return np.asarray(
-            normalize_flux(
-                self.flux,
-                self.mesh.volumes,
-                self.num_particles,
-                max(self.iter_count, 1),
-            )
+        parity (cpp:648-683), with the sd NaN guard fix. Runs on HOST
+        so the 3-D view never materializes in the TPU's padded tile
+        layout (normalize_flux_host docstring)."""
+        return normalize_flux_host(
+            self.raw_flux,
+            self.mesh.volumes,
+            self.num_particles,
+            max(self.iter_count, 1),
         )
 
     def reaction_rate(self, sigma: np.ndarray) -> np.ndarray:
         """Multi-tally support: a reaction-rate tally (raw Σ w·l·σ and its
         square accumulator) for a per-(region, group) response table —
-        derived from the flux accumulator, see core.tally.reaction_rate."""
-        from .core.tally import reaction_rate
+        derived from the flux accumulator, see core.tally.reaction_rate.
+        Host-side for the same padded-layout reason as normalized_flux."""
+        from .core.tally import reaction_rate_host
 
-        return np.asarray(
-            reaction_rate(
-                self.flux,
-                self.mesh.class_id,
-                jnp.asarray(sigma, self.config.dtype),
-            )
+        return reaction_rate_host(
+            self.raw_flux,
+            np.asarray(self.mesh.class_id),
+            np.asarray(sigma, self.config.dtype),
         )
 
     def write_pumi_tally_mesh(self, filename: str | None = None) -> str:
@@ -429,8 +434,12 @@ class PumiTally:
     # ------------------------------------------------------------------ #
     @property
     def raw_flux(self) -> np.ndarray:
-        """Unnormalized [ntet, n_groups, 2] (Σ w·len, Σ (w·len)²)."""
-        return np.asarray(self.flux)
+        """Unnormalized [ntet, n_groups, 2] (Σ w·len, Σ (w·len)²). The
+        device accumulator is flat (make_flux flat=True); the 3-D view
+        is assembled host-side."""
+        return np.asarray(self.flux).reshape(
+            self.mesh.ntet, self.config.n_groups, 2
+        )
 
     @property
     def element_ids(self) -> np.ndarray:

@@ -72,7 +72,8 @@ class StreamingTallyPipeline:
         self.depth = max(1, int(depth))
         self.want_outputs = want_outputs
         self.flux = make_flux(
-            mesh.ntet, self.config.n_groups, dtype=self.config.dtype
+            mesh.ntet, self.config.n_groups, dtype=self.config.dtype,
+            flat=True,
         )
         self._inflight: collections.deque = collections.deque()
         self._n_submitted = 0
@@ -128,6 +129,7 @@ class StreamingTallyPipeline:
             gathers=cfg.gathers,
             ledger=cfg.ledger,
             record_xpoints=cfg.record_xpoints,
+            n_groups=cfg.n_groups,
         )
         # The flux chain threads through every batch (donated each step);
         # per-batch outputs wait in the in-flight queue.
@@ -166,7 +168,9 @@ class StreamingTallyPipeline:
 
     def finish(self) -> np.ndarray:
         """Drain the queue and return the accumulated raw flux
-        [ntet, n_groups, 2]."""
+        [ntet, n_groups, 2] (device accumulator is flat; reshaped host-side)."""
         while self._inflight:
             self._drain_one()
-        return np.asarray(self.flux)
+        return np.asarray(self.flux).reshape(
+            self.mesh.ntet, self.config.n_groups, 2
+        )

@@ -54,12 +54,13 @@ tiny-table gather after the loop, never per crossing.
 
 Tally scatter: the (c, c²) pair goes into the flux viewed flat as
 [ntet*n_groups*2] via a static strategy knob (``tally_scatter``):
-"pair" (default) issues two scalar scatter-adds, "interleaved" one
-2m-row scatter with keys 2k/2k+1. A dedicated in-loop TPU microbench
-measured interleaved ~11% cheaper, but in the real body on CPU the
-concatenate costs up to 5×, so the safer pair is the default until the
-hardware A/B grid (scripts/tpu_round3_capture2.sh) settles it; both are
-bit-identical (disjoint slots) and 3.6× cheaper than a 2-wide window
+"pair" issues two scalar scatter-adds, "interleaved" one 2m-row scatter
+with keys 2k/2k+1. The round-4 hardware A/B settled the backend split:
+interleaved wins on TPU (7.41 vs 7.27 Mseg/s in the real body,
+consistent with the in-loop microbench's −11% scatter cost), pair wins
+on CPU (the concatenate costs up to 5× there) — so the default is
+"auto": interleaved on TPU, pair elsewhere, resolved at trace time.
+Both are bit-identical (disjoint slots) and 3.6× cheaper than a 2-wide window
 scatter; complex64 packing is unimplemented on this TPU backend
 (scripts/microbench_complex_scatter.py).
 
@@ -326,11 +327,12 @@ def trace_impl(
     compact_stages: tuple | None = None,
     unroll: int = 1,
     robust: bool = True,
-    tally_scatter: str = "pair",
+    tally_scatter: str = "auto",
     gathers: str = "merged",
     ledger: bool = True,
     debug_checks: bool = False,
     record_xpoints: int | None = None,
+    n_groups: int | None = None,
 ) -> TraceResult:
     """Advance all particles from origin to dest through the mesh.
 
@@ -342,7 +344,11 @@ def trace_impl(
         not scored, position reported as their origin.
       weight, group: [n] statistical weight and energy-group index.
       material_id: [n] int32, updated on material-boundary stops.
-      flux: [ntet, n_groups, 2] tally accumulator (donated).
+      flux: tally accumulator (donated). Either [ntet, n_groups, 2] or
+        FLAT [ntet*n_groups*2] (stride-2 (Σc, Σc²) pairs; requires the
+        explicit ``n_groups`` kwarg). Flat is the TPU production layout:
+        a trailing dim of 2 pads 64× under the (8,128) tile (make_flux
+        docstring); the result's flux keeps the caller's shape.
       initial: when True this is the parent-element *location* search —
         nothing is tallied and material/class boundaries do not stop the
         particle (cpp:472's !initial guard); only the domain boundary clips.
@@ -388,10 +394,12 @@ def trace_impl(
         default True except for A/B cost attribution or strict
         reference-parity runs.
       tally_scatter: per-crossing (Σc, Σc²) accumulation strategy.
-        "pair" (default) issues two m-row scalar scatters; "interleaved"
+        "pair" issues two m-row scalar scatters; "interleaved"
         concatenates both rows into ONE 2m-row scatter (c at flat slot
-        2k, c² at 2k+1). Numerically identical (disjoint slots). The
-        strategies trade a concatenate for a second scatter dispatch and
+        2k, c² at 2k+1); "auto" (default) picks interleaved on TPU and
+        pair elsewhere, per the round-4 hardware A/B. Numerically
+        identical (disjoint slots). The strategies trade a concatenate
+        for a second scatter dispatch and
         measure differently per backend (module docstring "Tally
         scatter") — keep both benchable; ignored when
         score_squares=False.
@@ -426,7 +434,19 @@ def trace_impl(
     dtype = origin.dtype
     ntet = mesh.tet2tet.shape[0]
     n = origin.shape[0]
-    n_groups = flux.shape[1]
+    if flux.ndim == 1:
+        if n_groups is None:
+            raise ValueError(
+                "flat flux ([ntet*n_groups*2]) requires the explicit "
+                "n_groups kwarg"
+            )
+    elif n_groups is None:
+        n_groups = flux.shape[1]
+    elif flux.ndim == 3 and n_groups != flux.shape[1]:
+        raise ValueError(
+            f"n_groups={n_groups} disagrees with flux.shape[1]="
+            f"{flux.shape[1]}"
+        )
 
     in_flight = in_flight.astype(bool)
     weight = weight.astype(dtype)
@@ -458,11 +478,11 @@ def trace_impl(
     # The flux rides the loop flat as [ntet*n_groups*2] so both tally
     # rows land at slots 2k / 2k+1 under either scatter strategy.
     flux_shape = flux.shape
-    if flux_shape != (ntet, n_groups, 2):
+    if flux_shape not in ((ntet, n_groups, 2), (ntet * n_groups * 2,)):
         raise ValueError(
-            f"flux must be [ntet, n_groups, 2] = ({ntet}, {n_groups}, 2); "
-            f"got {flux_shape} — the flat stride-2 tally layout carries "
-            "the trailing (Σc, Σc²) pair"
+            f"flux must be [ntet, n_groups, 2] = ({ntet}, {n_groups}, 2) "
+            f"or flat ({ntet * n_groups * 2},); got {flux_shape} — the "
+            "flat stride-2 tally layout carries the trailing (Σc, Σc²) pair"
         )
     flux = flux.reshape(-1)
     nbins = ntet * n_groups  # OOB sentinel key; 2·nbins is OOB in flat
@@ -484,9 +504,14 @@ def trace_impl(
     # f32 rounding (1 - 1e-8 == 1 in f32). See the tolerance docstring.
     tol_floor = 8 * float(jnp.finfo(dtype).eps)
 
+    if tally_scatter == "auto":
+        tally_scatter = (
+            "interleaved" if jax.default_backend() == "tpu" else "pair"
+        )
     if tally_scatter not in ("interleaved", "pair"):
         raise ValueError(
-            f"tally_scatter must be 'interleaved' or 'pair': {tally_scatter!r}"
+            f"tally_scatter must be 'auto', 'interleaved' or 'pair': "
+            f"{tally_scatter!r}"
         )
     if gathers not in ("merged", "split"):
         raise ValueError(f"gathers must be 'merged' or 'split': {gathers!r}")
@@ -993,6 +1018,7 @@ trace = jax.jit(
         "ledger",
         "debug_checks",
         "record_xpoints",
+        "n_groups",
     ),
     donate_argnames=("flux",),
 )

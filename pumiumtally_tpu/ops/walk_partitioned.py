@@ -529,9 +529,16 @@ def make_partitioned_step(
     [n_parts * cap] sharded over the device axis and flux is
     [n_parts, max_local, n_groups, 2] sharded on its leading axis.
     """
+    if tally_scatter == "auto":
+        # Same backend split as the single-chip walk (ops/walk.py):
+        # interleaved measured best on TPU, pair on CPU (round-4 A/B).
+        tally_scatter = (
+            "interleaved" if jax.default_backend() == "tpu" else "pair"
+        )
     if tally_scatter not in ("interleaved", "pair"):
         raise ValueError(
-            f"tally_scatter must be 'interleaved' or 'pair': {tally_scatter!r}"
+            f"tally_scatter must be 'auto', 'interleaved' or 'pair': "
+            f"{tally_scatter!r}"
         )
     n_parts = partition.n_parts
     if device_mesh.shape[AXIS] != n_parts:

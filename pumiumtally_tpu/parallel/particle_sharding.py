@@ -53,15 +53,22 @@ def n_shards(device_mesh: Mesh) -> int:
 
 
 def make_sharded_flux(
-    device_mesh: Mesh, ntet: int, n_groups: int, dtype=jnp.float32
+    device_mesh: Mesh,
+    ntet: int,
+    n_groups: int,
+    dtype=jnp.float32,
+    flat: bool = False,
 ) -> jax.Array:
-    """Per-chip partial tallies: [n_dev, ntet, n_groups, 2], sharded on the
-    leading device axis (each chip owns one [ntet, n_groups, 2] slab)."""
+    """Per-chip partial tallies sharded on the leading device axis:
+    [n_dev, ntet, n_groups, 2], or with flat=True [n_dev, ntet*n_groups*2]
+    (each chip owns one flat slab — the TPU production layout, see
+    core.tally.make_flux on the 64× minor-dim tile padding)."""
     nd = n_shards(device_mesh)
     sharding = NamedSharding(device_mesh, P(PARTICLE_AXIS))
-    return jax.device_put(
-        jnp.zeros((nd, ntet, n_groups, 2), dtype=dtype), sharding
+    shape = (
+        (nd, ntet * n_groups * 2) if flat else (nd, ntet, n_groups, 2)
     )
+    return jax.device_put(jnp.zeros(shape, dtype=dtype), sharding)
 
 
 def shard_particles(device_mesh: Mesh, *arrays):
@@ -91,6 +98,7 @@ def make_sharded_trace(
     compact_after: int | None = None,
     compact_size: int | None = None,
     unroll: int = 8,
+    n_groups: int | None = None,
 ):
     """Build the multi-chip fused trace step.
 
@@ -109,6 +117,7 @@ def make_sharded_trace(
         compact_after=compact_after,
         compact_size=compact_size,
         unroll=unroll,
+        n_groups=n_groups,
     )
 
     def shard_body(

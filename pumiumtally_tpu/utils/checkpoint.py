@@ -54,7 +54,10 @@ def save_checkpoint(filename: str, tally) -> None:
     np.savez_compressed(
         filename,
         meta=np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8),
-        flux=np.asarray(tally.flux),
+        # Canonical on-disk shape is [ntet, n_groups, 2] regardless of the
+        # device layout (flat since round 4), so checkpoints stay portable
+        # across layout changes.
+        flux=np.asarray(tally.raw_flux),
         origin=np.asarray(s.origin),
         dest=np.asarray(s.dest),
         elem=np.asarray(s.elem),
@@ -117,7 +120,9 @@ def restore_checkpoint(filename: str, tally) -> None:
         meta = json.loads(bytes(z["meta"].tobytes()).decode())
         _validate_meta(meta, tally, expected_kind=None)
         dtype = tally.config.dtype
-        tally.flux = jnp.asarray(z["flux"], dtype)
+        # Device accumulator is flat (api make_flux flat=True); accept
+        # both 3-D (canonical/older) and flat on-disk arrays.
+        tally.flux = jnp.asarray(z["flux"], dtype).reshape(-1)
         tally.state = tally.state._replace(
             origin=jnp.asarray(z["origin"], dtype),
             dest=jnp.asarray(z["dest"], dtype),

@@ -90,6 +90,8 @@ class TallyConfig:
         well-behaved.
       tally_scatter / gathers: walk scheduling strategies (ops/walk.py
         docstring) — benchmark-tunable, numerically identical.
+        tally_scatter "auto" resolves per backend at trace time
+        (interleaved on TPU, pair elsewhere — round-4 hardware A/B).
       ledger: accumulate the per-particle track-length conservation
         ledger (TraceResult.track_length; required by the debug_checks
         consistency assert). One elementwise op per crossing — off only
@@ -118,7 +120,7 @@ class TallyConfig:
     checkify_invariants: bool = False
     record_xpoints: int | None = None
     robust: bool = True
-    tally_scatter: str = "pair"
+    tally_scatter: str = "auto"
     gathers: str = "merged"
     ledger: bool = True
 

@@ -1,0 +1,97 @@
+"""The flat flux device layout must be a pure re-indexing of the 3-D one.
+
+On TPU the canonical [ntet, n_groups, 2] accumulator pads its minor dim
+2 → 128 under the (8,128) tile layout — a 64× HBM blowup (the 1M-tet
+64-group flux allocated 32.7 GB, round-4 capture bench_v3b_64g). The
+production paths therefore keep the accumulator FLAT on device
+(make_flux flat=True + trace_impl n_groups=...) and assemble the 3-D
+view host-side. These tests pin that the flat path is bit-identical to
+the 3-D path, and that the host-side normalize/reaction-rate twins match
+their jitted originals.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from pumiumtally_tpu import build_box, make_flux
+from pumiumtally_tpu.core.tally import (
+    normalize_flux,
+    normalize_flux_host,
+    reaction_rate,
+    reaction_rate_host,
+)
+from pumiumtally_tpu.ops.walk import trace_impl
+
+
+def _scene(n=128, n_groups=3, seed=3):
+    mesh = build_box(1.0, 1.0, 1.0, 4, 4, 4, dtype=jnp.float32)
+    rng = np.random.default_rng(seed)
+    elem = jnp.asarray(rng.integers(0, mesh.ntet, n).astype(np.int32))
+    origin = jnp.asarray(
+        np.asarray(mesh.centroids())[np.asarray(elem)], jnp.float32
+    )
+    dest = jnp.asarray(rng.uniform(-0.1, 1.1, (n, 3)), jnp.float32)
+    args = (
+        mesh, origin, dest, elem,
+        jnp.ones(n, bool),
+        jnp.asarray(rng.uniform(0.5, 2.0, n), jnp.float32),
+        jnp.asarray(rng.integers(0, n_groups, n), jnp.int32),
+        jnp.full(n, -1, jnp.int32),
+    )
+    kw = dict(initial=False, max_crossings=mesh.ntet + 8, tolerance=1e-6)
+    return mesh, args, kw, n_groups
+
+
+def test_flat_flux_matches_3d():
+    mesh, args, kw, g = _scene()
+    r3 = trace_impl(*args, make_flux(mesh.ntet, g, jnp.float32), **kw)
+    rf = trace_impl(
+        *args, make_flux(mesh.ntet, g, jnp.float32, flat=True),
+        n_groups=g, **kw,
+    )
+    assert rf.flux.shape == (mesh.ntet * g * 2,)
+    np.testing.assert_array_equal(
+        np.asarray(rf.flux).reshape(mesh.ntet, g, 2), np.asarray(r3.flux)
+    )
+    np.testing.assert_array_equal(np.asarray(rf.elem), np.asarray(r3.elem))
+    np.testing.assert_array_equal(
+        np.asarray(rf.position), np.asarray(r3.position)
+    )
+    assert int(rf.n_segments) == int(r3.n_segments)
+
+
+def test_flat_flux_requires_n_groups():
+    mesh, args, kw, g = _scene(n=8)
+    flat = make_flux(mesh.ntet, g, jnp.float32, flat=True)
+    try:
+        trace_impl(*args, flat, **kw)
+    except ValueError as e:
+        assert "n_groups" in str(e)
+    else:  # pragma: no cover
+        raise AssertionError("flat flux without n_groups must raise")
+
+
+def test_normalize_flux_host_matches_device():
+    mesh, args, kw, g = _scene()
+    r = trace_impl(*args, make_flux(mesh.ntet, g, jnp.float32), **kw)
+    flux = np.asarray(r.flux)
+    vols = np.asarray(mesh.volumes)
+    dev = np.asarray(normalize_flux(r.flux, mesh.volumes, 128, 4))
+    host = normalize_flux_host(flux, vols, 128, 4)
+    np.testing.assert_allclose(host, dev, rtol=1e-6, atol=0)
+
+
+def test_reaction_rate_host_matches_device():
+    mesh, args, kw, g = _scene()
+    r = trace_impl(*args, make_flux(mesh.ntet, g, jnp.float32), **kw)
+    rng = np.random.default_rng(0)
+    sigma = rng.uniform(0.1, 2.0, (3, g)).astype(np.float32)
+    dev = np.asarray(
+        reaction_rate(r.flux, mesh.class_id, jnp.asarray(sigma))
+    )
+    host = reaction_rate_host(
+        np.asarray(r.flux), np.asarray(mesh.class_id), sigma
+    )
+    np.testing.assert_allclose(host, dev, rtol=1e-6, atol=0)
