@@ -1,0 +1,44 @@
+#!/bin/bash
+# Round-4 wave-2 TPU capture — the post-flat-flux re-measurement.
+# Wave 1 (tpu_round3_capture2.sh, bench_out/) settled the A/B grid:
+# fused ≈ per-step (dispatch is NOT the 5.43 suspect), robust free on
+# TPU, merged gathers +10% over split, interleaved scatter ≥ pair,
+# dense ladder 7.60 vs r2-schedule 4.84 Mseg/s. It also exposed the
+# 64-group OOM (3-D flux tile padding) that the flat layout now fixes.
+# This wave re-runs the rows wave 1 lost to tunnel faults, on the new
+# defaults (flat flux + auto scatter + robust), cheapest-first.
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p bench_out
+export JAX_COMPILATION_CACHE_DIR="$PWD/.jax_cache"
+
+run() {
+  name="$1"; shift
+  echo "=== $name: $* ==="
+  timeout "${CAPTURE_TIMEOUT:-2400}" "$@" \
+    >"bench_out/$name.out" 2>"bench_out/$name.err"
+  echo "rc=$? ($name)"
+  tail -3 "bench_out/$name.out" 2>/dev/null
+}
+
+# 0. tunnel health
+run probe_w2 python scripts/probe_dispatch.py
+# 1. headline on the NEW defaults (flat flux, auto->interleaved scatter,
+#    robust on), best-of-3 windows -> the BENCH_r04 candidate
+run bench_w2_headline env BENCH_EVENT=0 BENCH_PROBE=0 BENCH_REPEAT=3 \
+    python bench.py
+# 2. 64-group contention guard — the flat layout's 511 MB vs the 32.7 GB
+#    3-D OOM of wave 1
+run bench_w2_64g env BENCH_GROUPS=64 BENCH_EVENT=0 BENCH_PROBE=0 \
+    python bench.py
+# 3. 2M-particle batch (amortizes per-stage fixed cost; HBM now has the
+#    ~3.5 GB the padded flux wasted back)
+run bench_w2_2m env BENCH_PARTICLES=2097152 BENCH_EVENT=0 BENCH_PROBE=0 \
+    python bench.py
+# 4. 10M-tet rung retry (wave 1 died on a compile-service drop)
+run bench_w2_10m env BENCH_CELLS=119 BENCH_PARTICLES=2097152 \
+    BENCH_STEPS=5 BENCH_EVENT=0 BENCH_PROBE=0 python bench.py
+# 5. event-loop + pipeline retry
+run bench_w2_event env BENCH_EVENT=1 BENCH_PROBE=0 BENCH_STEPS=3 \
+    python bench.py
+echo "=== wave2 complete ==="
