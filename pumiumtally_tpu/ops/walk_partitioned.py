@@ -45,10 +45,12 @@ and is folded onto owner rows by ONE static all_to_all at walk end
 after which halo rows are zeroed so callers can accumulate flux across
 steps without double-folding.
 
-Tally writes touch only the chip-local flux slab `[max_local, g, 2]`; since
-every element is owned by exactly one chip there is no cross-chip tally
-reduction at all — assembly back to global element order is a permutation
-(mesh_partition.assemble_global_flux).
+Tally writes touch only the chip-local flux slab — `[max_local, g, 2]`
+or flat `[max_local*g*2]`, the TPU production layout (the 3-D slab pads
+its minor dim 2 → 128 under the (8,128) tile; core.tally.make_flux);
+since every element is owned by exactly one chip there is no cross-chip
+tally reduction at all — assembly back to global element order is a
+permutation (mesh_partition.assemble_global_flux).
 
 Capacity contract: a chip's particle buffer (`cap` slots, the per-chip
 block of the global particle axis) must fit everything that migrates in.
@@ -93,7 +95,10 @@ class PartitionedTraceResult(NamedTuple):
     position/material_id/group/weight/particle_id/elem/valid/done:
       [n_parts*cap] slot-major particle state after the step; `valid` marks
       occupied slots, `elem` is the *local* element index on the owning chip.
-    flux: [n_parts, max_local, n_groups, 2] per-chip owned-element slabs.
+    flux: per-chip owned-element slabs, in the CALLER's layout —
+      [n_parts, max_local, n_groups, 2], or flat
+      [n_parts, max_local*n_groups*2] when the step was driven with flat
+      slabs (the TPU production layout and PartitionedTally's default).
     n_segments: [n_parts] scored segment count per chip.
     n_rounds: [n_parts] walk/exchange rounds executed (replicated value).
     n_dropped: [n_parts] immigrants dropped for lack of free slots (0 unless
@@ -139,7 +144,7 @@ def _walk_phase(
     weight, group, flux, nseg, valid, prev, stuck, pseg, *xpk,
     initial, tolerance, score_squares, max_crossings, max_local,
     unroll=1, compact_after=None, compact_size=None, compact_stages=None,
-    robust=True, tally_scatter="pair", record_xpoints=None,
+    robust=True, tally_scatter="pair", record_xpoints=None, n_groups=None,
 ):
     """Advance every resident particle until done or pending-migration.
 
@@ -160,18 +165,32 @@ def _walk_phase(
     completion)."""
     normals_t, faced_t, enc_t, class_t, nbrclass_t, _ = tables
     dtype = cur.dtype
-    n_groups = flux.shape[1]
+    if flux.ndim == 1:
+        if n_groups is None:
+            raise ValueError(
+                "flat flux ([max_local*n_groups*2]) requires the explicit "
+                "n_groups kwarg"
+            )
+    elif n_groups is None:
+        n_groups = flux.shape[1]
     cap = cur.shape[0]
     tol_floor = 8 * float(jnp.finfo(dtype).eps)
     # The (c, c²) tally pair goes into the flux viewed flat under the
     # same tally_scatter strategy knob (and default) as the single-chip
     # walk — see ops/walk.py's module docstring; the stride-2 layout is
-    # load-bearing either way.
+    # load-bearing either way. A flat per-shard slab
+    # [max_local*n_groups*2] is the TPU production layout (the 3-D slab
+    # pads its minor dim 2 → 128 under the (8,128) tile — see
+    # core.tally.make_flux).
     flux_shape = flux.shape
-    if flux_shape != (max_local, n_groups, 2):
+    if flux_shape not in (
+        (max_local, n_groups, 2),
+        (max_local * n_groups * 2,),
+    ):
         raise ValueError(
             f"flux must be [max_local, n_groups, 2] = ({max_local}, "
-            f"{n_groups}, 2); got {flux_shape}"
+            f"{n_groups}, 2) or flat ({max_local * n_groups * 2},); "
+            f"got {flux_shape}"
         )
     nbins = max_local * n_groups  # OOB sentinel key
     if 2 * nbins >= 2**31:
@@ -527,7 +546,10 @@ def make_partitioned_step(
     Returns step(cur, dest, elem, done, material, weight, group, pid, valid,
     flux) -> PartitionedTraceResult, where per-particle arrays are
     [n_parts * cap] sharded over the device axis and flux is
-    [n_parts, max_local, n_groups, 2] sharded on its leading axis.
+    [n_parts, max_local, n_groups, 2] — or FLAT [n_parts,
+    max_local*n_groups*2], the TPU production layout (the 3-D slab pads
+    its minor dim 2 → 128 under the (8,128) tile; core.tally.make_flux) —
+    sharded on its leading axis. The result keeps the caller's layout.
     """
     if tally_scatter == "auto":
         # Same backend split as the single-chip walk (ops/walk.py):
@@ -621,6 +643,7 @@ def make_partitioned_step(
             robust=robust,
             tally_scatter=tally_scatter,
             record_xpoints=record_xpoints,
+            n_groups=n_groups,
         )
         walk_first = functools.partial(
             _walk_phase,
@@ -848,6 +871,12 @@ def make_partitioned_step(
             # Fold guest-scored flux back onto owner rows: ONE static
             # all_to_all over the precomputed halo row lists (pad entries
             # index max_local: masked on gather, dropped on scatter).
+            # With a flat slab the fold runs on a transient 3-D view —
+            # a one-shot reshape at walk end, not the loop-carried
+            # accumulator, so the padded tile layout never persists.
+            flat_carry = flux_l.ndim == 1
+            if flat_carry:
+                flux_l = flux_l.reshape(max_local, n_groups, 2)
             sendable_h = halo_send_l < max_local  # [n_parts, Eh]
             send_h = jnp.where(
                 sendable_h[..., None, None],
@@ -864,6 +893,8 @@ def make_partitioned_step(
             flux_l = flux_l.at[halo_recv_l.reshape(-1)].add(
                 recv_h.reshape(-1, *recv_h.shape[2:]), mode="drop"
             )
+            if flat_carry:
+                flux_l = flux_l.reshape(-1)
 
         return PartitionedTraceResult(
             position=cur,

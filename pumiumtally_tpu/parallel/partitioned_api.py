@@ -115,13 +115,15 @@ class PartitionedTally:
             max_rounds=max_rounds,
         )
         self._steps: dict = {}
+        # Flat per-chip slabs [n_parts, max_local*n_groups*2]: the TPU
+        # production layout (3-D slabs pad their minor dim 2 → 128 under
+        # the (8,128) tile; core.tally.make_flux). The 3-D view is
+        # assembled host-side in raw_flux.
         self.flux_slabs = jax.device_put(
             jnp.zeros(
                 (
                     self.n_parts,
-                    self.partition.max_local,
-                    self.config.n_groups,
-                    2,
+                    self.partition.max_local * self.config.n_groups * 2,
                 ),
                 self.config.dtype,
             ),
@@ -296,30 +298,30 @@ class PartitionedTally:
     # ------------------------------------------------------------------ #
     @property
     def raw_flux(self) -> np.ndarray:
-        """Assembled global [ntet, n_groups, 2] accumulator."""
-        return assemble_global_flux(self.partition, self.flux_slabs)
+        """Assembled global [ntet, n_groups, 2] accumulator. The device
+        slabs are flat; the 3-D view exists host-side only."""
+        slabs = np.asarray(self.flux_slabs).reshape(
+            self.n_parts, self.partition.max_local, self.config.n_groups, 2
+        )
+        return assemble_global_flux(self.partition, slabs)
 
     def normalized_flux(self) -> np.ndarray:
-        from ..core.tally import normalize_flux
+        from ..core.tally import normalize_flux_host
 
-        return np.asarray(
-            normalize_flux(
-                jnp.asarray(self.raw_flux),
-                self.mesh.volumes,
-                self.num_particles,
-                max(self.iter_count, 1),
-            )
+        return normalize_flux_host(
+            self.raw_flux,
+            np.asarray(self.mesh.volumes),
+            self.num_particles,
+            max(self.iter_count, 1),
         )
 
     def reaction_rate(self, sigma: np.ndarray) -> np.ndarray:
-        from ..core.tally import reaction_rate
+        from ..core.tally import reaction_rate_host
 
-        return np.asarray(
-            reaction_rate(
-                jnp.asarray(self.raw_flux),
-                self.mesh.class_id,
-                jnp.asarray(sigma, self.config.dtype),
-            )
+        return reaction_rate_host(
+            self.raw_flux,
+            np.asarray(self.mesh.class_id),
+            np.asarray(sigma, self.config.dtype),
         )
 
     def intersection_points(self) -> tuple[np.ndarray, np.ndarray]:

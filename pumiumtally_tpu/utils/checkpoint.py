@@ -189,8 +189,9 @@ def restore_partitioned_checkpoint(filename: str, tally) -> None:
             tally.partition,
             z["flux"].astype(np.dtype(tally.config.dtype)),
         )
+        # Device slabs are FLAT per chip (partitioned_api flux_slabs).
         tally.flux_slabs = jax.device_put(
-            jnp.asarray(slabs),
+            jnp.asarray(slabs.reshape(slabs.shape[0], -1)),
             NamedSharding(tally.device_mesh, P(PARTICLE_AXIS)),
         )
         tally.positions = z["positions"].copy()

@@ -123,7 +123,8 @@ def _single_chip(mesh, elem, origin, dest, weight, group, n_groups=2):
 def _partitioned(mesh, part, elem, origin, dest, weight, group,
                  n_groups=2, exchange_size=None, max_rounds=None,
                  unroll=1, compact_after=None, compact_size=None,
-                 compact_stages=None, tally_scatter="pair"):
+                 compact_stages=None, tally_scatter="pair",
+                 flat_flux=False):
     n = len(elem)
     dmesh = make_device_mesh(N_DEV)
     placed = distribute_particles(
@@ -154,9 +155,13 @@ def _partitioned(mesh, part, elem, origin, dest, weight, group,
     )
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    flux_shape = (
+        (N_DEV, part.max_local * n_groups * 2)
+        if flat_flux
+        else (N_DEV, part.max_local, n_groups, 2)
+    )
     flux = jax.device_put(
-        jnp.zeros((N_DEV, part.max_local, n_groups, 2), DTYPE),
-        NamedSharding(dmesh, P("p")),
+        jnp.zeros(flux_shape, DTYPE), NamedSharding(dmesh, P("p"))
     )
     done0 = jnp.zeros_like(placed["valid"])
     res = step(
@@ -332,6 +337,33 @@ def test_partitioned_staged_ladder_matches(box):
         got["track_length"], np.asarray(ref.track_length), atol=1e-12
     )
     assert int(np.sum(np.asarray(res.n_segments))) == int(ref.n_segments)
+
+
+@pytest.mark.parametrize("halo", [0, 1])
+def test_partitioned_flat_flux_matches(box, halo):
+    """The flat per-chip slab layout ([n_parts, max_local*g*2] — the TPU
+    production layout, see core.tally.make_flux on the 64× tile padding)
+    must be a pure re-indexing of the 3-D slabs: every output equal, the
+    flux equal after reshape. Covers the halo fold's transient 3-D view."""
+    part = partition_mesh(box, N_DEV, halo_layers=halo)
+    elem, origin, dest, weight, group = _random_batch(box, 96, seed=3)
+    res3, got3 = _partitioned(box, part, elem, origin, dest, weight, group)
+    resf, gotf = _partitioned(
+        box, part, elem, origin, dest, weight, group, flat_flux=True
+    )
+    assert resf.flux.shape == (N_DEV, part.max_local * 2 * 2)
+    np.testing.assert_array_equal(
+        np.asarray(resf.flux).reshape(N_DEV, part.max_local, 2, 2),
+        np.asarray(res3.flux),
+    )
+    np.testing.assert_array_equal(gotf["position"], got3["position"])
+    np.testing.assert_array_equal(gotf["material_id"], got3["material_id"])
+    np.testing.assert_array_equal(
+        gotf["track_length"], got3["track_length"]
+    )
+    assert int(np.sum(np.asarray(resf.n_segments))) == int(
+        np.sum(np.asarray(res3.n_segments))
+    )
 
 
 def test_morton_order_is_permutation():
