@@ -35,6 +35,9 @@ run bench_w2_64g env BENCH_GROUPS=64 BENCH_EVENT=0 BENCH_PROBE=0 \
 #    ~3.5 GB the padded flux wasted back)
 run bench_w2_2m env BENCH_PARTICLES=2097152 BENCH_EVENT=0 BENCH_PROBE=0 \
     python bench.py
+# 3b. BASELINE ladder refresh (configs 1,2,4 on hardware; 3 re-executes
+#     itself on the virtual CPU mesh) -> BENCH_LADDER r4 rows
+run ladder_w2 python scripts/bench_ladder.py --configs 1,2,4
 # 4. 10M-tet rung retry (wave 1 died on a compile-service drop)
 run bench_w2_10m env BENCH_CELLS=119 BENCH_PARTICLES=2097152 \
     BENCH_STEPS=5 BENCH_EVENT=0 BENCH_PROBE=0 python bench.py
