@@ -501,9 +501,11 @@ def main() -> None:
                             "error": err,
                             "note": (
                                 "device backend probe failed (error "
-                                "above); last hardware measurements and "
-                                "the pending A/B grid are recorded in "
-                                "BENCHMARKS.md and BENCH_r02.json"
+                                "above); the round-4 hardware grid "
+                                "measured 7.27-7.62 Mseg/s/chip on this "
+                                "configuration (BENCHMARKS.md 'Round-4 "
+                                "hardware A/B grid'; raw rows in "
+                                "bench_out/)"
                             ),
                         },
                     }
