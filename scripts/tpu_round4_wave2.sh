@@ -13,12 +13,20 @@ mkdir -p bench_out
 export JAX_COMPILATION_CACHE_DIR="$PWD/.jax_cache"
 
 run() {
+  # Up to 2 attempts: wave 1 lost its headline row to a one-off
+  # compile-service drop ("response body closed"); a transient fault
+  # heals on retry (and the compile cache makes the retry cheap), while
+  # a dead tunnel fails fast on the probe anyway.
   name="$1"; shift
-  echo "=== $name: $* ==="
-  timeout "${CAPTURE_TIMEOUT:-2400}" "$@" \
-    >"bench_out/$name.out" 2>"bench_out/$name.err"
-  echo "rc=$? ($name)"
-  tail -3 "bench_out/$name.out" 2>/dev/null
+  for attempt in 1 2; do
+    echo "=== $name (attempt $attempt): $* ==="
+    timeout "${CAPTURE_TIMEOUT:-2400}" "$@" \
+      >"bench_out/$name.out" 2>"bench_out/$name.err"
+    rc=$?
+    echo "rc=$rc ($name)"
+    tail -3 "bench_out/$name.out" 2>/dev/null
+    [ "$rc" -eq 0 ] && break
+  done
 }
 
 # 0. tunnel health
