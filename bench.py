@@ -49,6 +49,7 @@ def run(
     ledger: bool = True,
     fused: bool = True,
     repeats: int = 2,
+    flat_flux: bool = True,
 ) -> dict:
     import jax  # noqa: F401 — must import before the backend pin
 
@@ -81,8 +82,9 @@ def run(
     material = jnp.full(n_particles, -1, jnp.int32)
     # Flat device layout — [ntet,n_groups,2] pads its minor dim 2 → 128
     # under the TPU (8,128) tile (64× HBM; the 64-group config OOMed at
-    # 32.7 GB as 3-D, round 4). See core.tally.make_flux.
-    flux = make_flux(mesh.ntet, n_groups, dtype, flat=True)
+    # 32.7 GB as 3-D, round 4). See core.tally.make_flux. BENCH_FLAT=0
+    # restores the 3-D layout for the A/B.
+    flux = make_flux(mesh.ntet, n_groups, dtype, flat=flat_flux)
 
     if compact_stages == "default":
         # The slot-planned dense ladder (ONE definition, shared with
@@ -254,6 +256,7 @@ def run(
             "gathers": gathers,
             "ledger": ledger,
             "fused_steps": fused,
+            "flat_flux": flat_flux,
             # Per-window (segments, seconds) for every measurement
             # repeat; the headline is the best window (tunnel noise is
             # one-sided — interference only subtracts).
@@ -547,6 +550,7 @@ def main() -> None:
         # (the per-move launch shape; its gap to fused IS that overhead).
         fused=os.environ.get("BENCH_FUSED", "1") == "1",
         repeats=int(os.environ.get("BENCH_REPEAT", "2")),
+        flat_flux=os.environ.get("BENCH_FLAT", "1") == "1",
     )
     print(
         f"[bench] {result['detail']}", file=sys.stderr

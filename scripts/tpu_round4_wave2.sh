@@ -39,6 +39,10 @@ run bench_w2_headline env BENCH_EVENT=0 BENCH_PROBE=0 BENCH_REPEAT=3 \
 #    3-D OOM of wave 1
 run bench_w2_64g env BENCH_GROUPS=64 BENCH_EVENT=0 BENCH_PROBE=0 \
     python bench.py
+# 2b. the 3-D layout A/B — quantifies what the flat accumulator is
+#     worth at 8 groups (the padded [ntet,8,2] form is ~4.1 GB vs 511 MB)
+run bench_w2_3d env BENCH_FLAT=0 BENCH_EVENT=0 BENCH_PROBE=0 \
+    python bench.py
 # 3. 2M-particle batch (amortizes per-stage fixed cost; HBM now has the
 #    ~3.5 GB the padded flux wasted back)
 run bench_w2_2m env BENCH_PARTICLES=2097152 BENCH_EVENT=0 BENCH_PROBE=0 \
