@@ -132,7 +132,7 @@ def run_rehearsal(
             ),
         )
         flux = jax.device_put(
-            jnp.zeros((n_dev, part.max_local, n_groups, 2), dtype),
+            jnp.zeros((n_dev, part.max_local * n_groups * 2), dtype),
             NamedSharding(dmesh, P("p")),
         )
         t1 = time.perf_counter()
@@ -156,7 +156,12 @@ def run_rehearsal(
 
         # Multi-tally: flux + absorption-rate response product over the
         # assembled owned-element slabs.
-        g_flux = assemble_global_flux(part, res.flux)
+        g_flux = assemble_global_flux(
+            part,
+            np.asarray(res.flux).reshape(
+                n_dev, part.max_local, n_groups, 2
+            ),
+        )
         sigma_abs = np.zeros((3, n_groups), np.float32)
         for r in (1, 2):
             sigma_abs[r, :] = density[r] * micro_abs[r]

@@ -111,7 +111,7 @@ def main():
         ),
     )
     flux = jax.device_put(
-        jnp.zeros((n_dev, part.max_local, n_groups, 2), dtype),
+        jnp.zeros((n_dev, part.max_local * n_groups * 2), dtype),
         NamedSharding(dmesh, P("p")),
     )
     t0 = time.perf_counter()
@@ -123,7 +123,12 @@ def main():
     )
     got = collect_by_particle_id(res, n)
     part_s = time.perf_counter() - t0
-    g_flux = assemble_global_flux(part, res.flux)
+    g_flux = assemble_global_flux(
+        part,
+        np.asarray(res.flux).reshape(
+            n_dev, part.max_local, n_groups, 2
+        ),
+    )
 
     n_dropped = int(np.asarray(res.n_dropped).sum())
     all_done = bool(got["done"].all())
