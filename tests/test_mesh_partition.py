@@ -366,6 +366,54 @@ def test_partitioned_flat_flux_matches(box, halo):
     )
 
 
+def test_partitioned_64_groups_matches_single_chip(box):
+    """Config-4 × config-3 corner: 64 energy groups over the partitioned
+    walk (flat slabs). The per-shard flat keys (elem_local*64+group)*2
+    must land exactly where the single-chip walk's global keys do."""
+    g = 64
+    part = partition_mesh(box, N_DEV, halo_layers=1)
+    rng = np.random.default_rng(21)
+    n = 96
+    elem = rng.integers(0, box.ntet, n).astype(np.int32)
+    origin = np.asarray(box.centroids())[elem]
+    dest = rng.uniform(-0.1, 1.1, (n, 3))
+    weight = rng.uniform(0.5, 2.0, n)
+    group = rng.integers(0, g, n).astype(np.int32)
+    ref = trace_impl(
+        box,
+        jnp.asarray(origin, DTYPE),
+        jnp.asarray(dest, DTYPE),
+        jnp.asarray(elem),
+        jnp.ones(n, bool),
+        jnp.asarray(weight, DTYPE),
+        jnp.asarray(group),
+        jnp.full(n, -1, jnp.int32),
+        make_flux(box.ntet, g, DTYPE, flat=True),
+        n_groups=g,
+        initial=False,
+        max_crossings=box.ntet + 8,
+        tolerance=1e-8,
+    )
+    res, got = _partitioned(
+        box, part, elem, origin, dest, weight, group, n_groups=g,
+        flat_flux=True,
+    )
+    assert int(np.sum(np.asarray(res.n_dropped))) == 0
+    g_flux = assemble_global_flux(
+        part,
+        np.asarray(res.flux).reshape(N_DEV, part.max_local, g, 2),
+    )
+    np.testing.assert_allclose(
+        g_flux,
+        np.asarray(ref.flux).reshape(box.ntet, g, 2),
+        rtol=0,
+        atol=1e-12,
+    )
+    np.testing.assert_allclose(
+        got["position"], np.asarray(ref.position), atol=1e-12
+    )
+
+
 def test_morton_order_is_permutation():
     rng = np.random.default_rng(0)
     pts = rng.uniform(size=(500, 3))
