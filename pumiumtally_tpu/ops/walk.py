@@ -113,6 +113,7 @@ lane scheduling changes.
 from __future__ import annotations
 
 import functools
+import inspect
 from typing import NamedTuple
 
 import jax
@@ -305,6 +306,36 @@ class TraceResult(NamedTuple):
     xpoints: jax.Array | None = None
     n_xpoints: jax.Array | None = None
     track_length: jax.Array | None = None
+
+
+def resolve_tally_scatter(
+    tally_scatter: str, array=None, platform: str | None = None
+) -> str:
+    """Resolve the 'auto' tally-scatter strategy to a concrete one.
+
+    'auto' picks by the backend that will actually run the walk: the
+    platform of ``array``'s committed device when one is available
+    (e.g. the flux accumulator), else ``jax.default_backend()``.
+    Resolution must happen OUTSIDE jit — the knob is a static trace
+    key, so resolving the literal string 'auto' inside the traced
+    function would freeze the first call's backend decision into every
+    later cache hit, and would mispick when arrays are explicitly
+    placed off the default backend. Both strategies are bit-identical;
+    the choice is perf-only (round-4 hardware A/B: interleaved on TPU,
+    pair on CPU — BENCHMARKS.md).
+    """
+    if tally_scatter != "auto":
+        return tally_scatter
+    if platform is None and array is not None:
+        devices = getattr(array, "devices", None)
+        if callable(devices):
+            try:
+                platform = next(iter(devices())).platform
+            except Exception:  # tracer / uncommitted / numpy input
+                platform = None
+    if platform is None:
+        platform = jax.default_backend()
+    return "interleaved" if platform == "tpu" else "pair"
 
 
 def trace_impl(
@@ -504,10 +535,7 @@ def trace_impl(
     # f32 rounding (1 - 1e-8 == 1 in f32). See the tolerance docstring.
     tol_floor = 8 * float(jnp.finfo(dtype).eps)
 
-    if tally_scatter == "auto":
-        tally_scatter = (
-            "interleaved" if jax.default_backend() == "tpu" else "pair"
-        )
+    tally_scatter = resolve_tally_scatter(tally_scatter)
     if tally_scatter not in ("interleaved", "pair"):
         raise ValueError(
             f"tally_scatter must be 'auto', 'interleaved' or 'pair': "
@@ -990,6 +1018,30 @@ def _checked_jit(static_kwargs: tuple):
     return jax.jit(checkify.checkify(fn, errors=checkify.user_checks))
 
 
+# Bound from the signature so a reordered/inserted trace_impl parameter
+# breaks here loudly instead of silently consulting the wrong array.
+_FLUX_ARG_INDEX = list(
+    inspect.signature(trace_impl).parameters
+).index("flux")
+
+
+def _resolve_auto_kwargs(args, kwargs):
+    """Resolve 'auto' static knobs against the flux argument's device.
+
+    Runs before the jit cache key is formed so the backend decision is
+    re-made per call instead of frozen into the first trace."""
+    if kwargs.get("tally_scatter", "auto") == "auto":
+        flux = (
+            args[_FLUX_ARG_INDEX]
+            if len(args) > _FLUX_ARG_INDEX
+            else kwargs.get("flux")
+        )
+        kwargs = dict(
+            kwargs, tally_scatter=resolve_tally_scatter("auto", flux)
+        )
+    return kwargs
+
+
 def checked_trace(*args, **kwargs):
     """Run the walk with in-kernel invariant checks (OMEGA_H_CHECK parity).
 
@@ -998,10 +1050,11 @@ def checked_trace(*args, **kwargs):
     jitted and cached per static-kwarg signature, so repeated calls pay
     only the extra per-crossing reductions, not retracing.
     """
+    kwargs = _resolve_auto_kwargs(args, kwargs)
     return _checked_jit(tuple(sorted(kwargs.items())))(*args)
 
 
-trace = jax.jit(
+_trace_jit = jax.jit(
     trace_impl,
     static_argnames=(
         "initial",
@@ -1022,4 +1075,10 @@ trace = jax.jit(
     ),
     donate_argnames=("flux",),
 )
+
+
+def trace(*args, **kwargs):
+    return _trace_jit(*args, **_resolve_auto_kwargs(args, kwargs))
+
+
 trace.__doc__ = trace_impl.__doc__

@@ -85,6 +85,7 @@ from .walk import (
     first_k_active,
     normalize_compact_stages,
     record_crossing,
+    resolve_tally_scatter,
 )
 
 
@@ -505,7 +506,7 @@ def make_partitioned_step(
     compact_stages: tuple | None = None,
     followup_compact_size: int | None = None,
     robust: bool = True,
-    tally_scatter: str = "pair",
+    tally_scatter: str = "auto",
     record_xpoints: int | None = None,
 ):
     """Build the jitted distributed trace step for one mesh partition.
@@ -551,11 +552,16 @@ def make_partitioned_step(
     its minor dim 2 → 128 under the (8,128) tile; core.tally.make_flux) —
     sharded on its leading axis. The result keeps the caller's layout.
     """
+    # One policy site for the backend split (ops/walk.py
+    # resolve_tally_scatter: interleaved measured best on TPU, pair on
+    # CPU — round-4 A/B), resolved against the mesh the step will
+    # actually run on: the step is built once per device_mesh, so there
+    # is no stale-cache hazard, and the mesh's platform beats
+    # jax.default_backend() when they differ.
     if tally_scatter == "auto":
-        # Same backend split as the single-chip walk (ops/walk.py):
-        # interleaved measured best on TPU, pair on CPU (round-4 A/B).
-        tally_scatter = (
-            "interleaved" if jax.default_backend() == "tpu" else "pair"
+        tally_scatter = resolve_tally_scatter(
+            "auto",
+            platform=next(iter(device_mesh.devices.flat)).platform,
         )
     if tally_scatter not in ("interleaved", "pair"):
         raise ValueError(
@@ -871,30 +877,33 @@ def make_partitioned_step(
             # Fold guest-scored flux back onto owner rows: ONE static
             # all_to_all over the precomputed halo row lists (pad entries
             # index max_local: masked on gather, dropped on scatter).
-            # With a flat slab the fold runs on a transient 3-D view —
-            # a one-shot reshape at walk end, not the loop-carried
-            # accumulator, so the padded tile layout never persists.
+            # The fold runs on a 2-D [max_local, n_groups*2] view: the
+            # minor dim 2G tiles the TPU (8,128) lane layout cleanly
+            # (exactly 128 at g=64), where a [.., G, 2] view pads the
+            # minor dim 2 up to 128 — the same transient 64x HBM blowup
+            # the flat loop-carried slab exists to avoid (at the
+            # 10M-tet/64-group/halo-2 target that transient is ~40 GB).
             flat_carry = flux_l.ndim == 1
-            if flat_carry:
-                flux_l = flux_l.reshape(max_local, n_groups, 2)
+            flux2 = flux_l.reshape(max_local, n_groups * 2)
             sendable_h = halo_send_l < max_local  # [n_parts, Eh]
             send_h = jnp.where(
-                sendable_h[..., None, None],
-                flux_l[jnp.minimum(halo_send_l, max_local - 1)],
+                sendable_h[..., None],
+                flux2[jnp.minimum(halo_send_l, max_local - 1)],
                 0.0,
-            )  # [n_parts, Eh, G, 2]
+            )  # [n_parts, Eh, 2G]
             recv_h = jax.lax.all_to_all(send_h, AXIS, 0, 0, tiled=False)
             # My halo rows are folded out — zero them so a caller that
             # accumulates flux across steps cannot double-fold them.
             row_ix = jnp.arange(max_local)
-            flux_l = jnp.where(
-                (row_ix < n_owned_l)[:, None, None], flux_l, 0.0
+            flux2 = jnp.where((row_ix < n_owned_l)[:, None], flux2, 0.0)
+            flux2 = flux2.at[halo_recv_l.reshape(-1)].add(
+                recv_h.reshape(-1, n_groups * 2), mode="drop"
             )
-            flux_l = flux_l.at[halo_recv_l.reshape(-1)].add(
-                recv_h.reshape(-1, *recv_h.shape[2:]), mode="drop"
+            flux_l = (
+                flux2.reshape(-1)
+                if flat_carry
+                else flux2.reshape(max_local, n_groups, 2)
             )
-            if flat_carry:
-                flux_l = flux_l.reshape(-1)
 
         return PartitionedTraceResult(
             position=cur,

@@ -1,0 +1,21 @@
+#!/bin/bash
+# Round-5 TPU capture: run the staged round-4 wave-2 grid (flat layout
+# headline + 64g + 3-D A/B + 2M + ladder + 10M + event rows), then any
+# round-5 wave-3 experiments staged while the tunnel was down.
+# The wave-3 file is resolved AT RUN TIME, so experiments added after
+# the watcher is armed are still picked up.
+set -u
+cd "$(dirname "$0")/.."
+bash scripts/tpu_round4_wave2.sh
+rc2=$?
+rc3=skipped
+if [ -f scripts/tpu_round5_wave3.sh ]; then
+  echo "=== wave3 begins (wave2 rc=$rc2) ==="
+  bash scripts/tpu_round5_wave3.sh
+  rc3=$?
+fi
+# Partial captures are valuable (each row writes its own bench_out
+# files), so wave3 runs regardless — but the completion marker carries
+# both exit codes so a log reader can tell a clean sweep from a
+# tunnel-curtailed one.
+echo "=== round5 capture complete (wave2 rc=$rc2 wave3 rc=$rc3) ==="
