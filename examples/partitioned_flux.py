@@ -47,7 +47,7 @@ from pumiumtally_tpu.parallel.particle_sharding import make_device_mesh
 
 
 def main() -> None:
-    outdir = sys.argv[1] if len(sys.argv) > 1 else "."
+    outdir = sys.argv[1] if len(sys.argv) > 1 else "out"
     os.makedirs(outdir, exist_ok=True)
     n_parts = 8
     if len(jax.devices()) < n_parts:

@@ -41,7 +41,8 @@ def pincell_mesh(cells: int = 8, pin_radius: float = 0.25) -> TetMesh:
 
 
 def main() -> None:
-    out = sys.argv[1] if len(sys.argv) > 1 else "pincell_flux.vtu"
+    out = sys.argv[1] if len(sys.argv) > 1 else "out/pincell_flux.vtu"
+    os.makedirs(os.path.dirname(out) or ".", exist_ok=True)
     mesh = pincell_mesh()
     n_fuel = int(np.asarray(mesh.class_id).sum())
     print(f"mesh: {mesh.ntet} tets ({n_fuel} fuel, {mesh.ntet - n_fuel} moderator)")
