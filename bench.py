@@ -525,11 +525,27 @@ def main() -> None:
                             "error": err,
                             "note": (
                                 "device backend probe failed (error "
-                                "above); the round-4 hardware grid "
-                                "measured 7.27-7.62 Mseg/s/chip on this "
-                                "configuration (BENCHMARKS.md 'Round-4 "
-                                "hardware A/B grid'; raw rows in "
-                                "bench_out/)"
+                                "above), so this run produced no "
+                                "measurement. Historical context, with "
+                                "the config deltas stated so this record "
+                                "stands alone: the round-4 hardware grid "
+                                "measured 7.27-7.62 Mseg/s/chip, but on "
+                                "the PRE-FLAT 3-D [ntet,G,2] accumulator "
+                                "with pair scatter and windows that "
+                                "carried evolved particle state "
+                                "(BENCHMARKS.md 'Round-4 hardware A/B "
+                                "grid'; raw rows in bench_out/). The "
+                                "CURRENT defaults — flat stride-2 "
+                                "accumulator, auto->interleaved scatter "
+                                "on TPU, robust on, identical-workload "
+                                "windows — are bit-identical in results "
+                                "but have never produced a TPU number. "
+                                "Best-ever driver-captured: 8.53 "
+                                "Mseg/s/chip (round 2, r2 3-stage "
+                                "schedule, 3-D accumulator); same code "
+                                "re-measured 4.84 in the round-4 window "
+                                "(cross-epoch tunnel drift — never "
+                                "compare across epochs)."
                             ),
                         },
                     }

@@ -213,6 +213,32 @@ def normalize_compact_stages(
             raise ValueError(
                 f"compact_stages starts must be strictly increasing: {starts}"
             )
+        for st in compact_stages:
+            if st[1] < 1 or (len(st) == 3 and st[2] < 1):
+                raise ValueError(
+                    f"compact_stages size/unroll must be >= 1: {st!r}"
+                )
+        # Measured cliff guard (round-4 hardware grid, BENCHMARKS.md
+        # "Schedule sweep"): per-stage unroll >= 16 was perf-neutral on
+        # the 7-stage dense ladder (7.62 vs 7.60 Mseg/s) but CATASTROPHIC
+        # on a sparse 5-stage schedule (0.21 Mseg/s — ~35x slower, 381 s
+        # compile). The mechanism is uncharacterized, so the safe rule is
+        # the measured one: large per-stage unrolls only on dense-ladder-
+        # shaped schedules (>= 6 stages).
+        big_u = [st for st in compact_stages if len(st) == 3 and st[2] >= 16]
+        if big_u and len(compact_stages) < 6:
+            import warnings
+
+            warnings.warn(
+                f"compact_stages: per-stage unroll >= 16 on a sparse "
+                f"{len(compact_stages)}-stage schedule measured ~35x "
+                f"slower on TPU (0.21 vs 7.6 Mseg/s, round-4 grid; "
+                f"BENCHMARKS.md 'Schedule sweep'); large unrolls are "
+                f"only known-safe on the dense ladder (>= 6 stages). "
+                f"Offending stages: {big_u}",
+                RuntimeWarning,
+                stacklevel=2,
+            )
     return compact_stages
 
 

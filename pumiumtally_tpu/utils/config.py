@@ -52,7 +52,9 @@ class TallyConfig:
         ``"auto"`` for the slot-planned dense ladder — the best known
         schedule for walks with ~10-20 crossings per move
         (scripts/plan_ladder.py; BENCHMARKS.md "Slot-exact ladder
-        planning").
+        planning"). CAUTION: per-stage unroll >= 16 on a sparse (< 6
+        stage) schedule measured ~35x SLOWER on TPU (round-4 grid);
+        the walk warns when it sees that shape.
       unroll: boundary crossings advanced per while-loop iteration
         (ops/walk.py). The TPU while_loop is dispatch-bound, so unrolling
         the body ~2x's throughput (scripts/sweep_unroll.py); done lanes
