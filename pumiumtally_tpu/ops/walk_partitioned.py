@@ -123,13 +123,19 @@ class PartitionedTraceResult(NamedTuple):
     # track_length), migrating with its particle across cuts — the
     # conservation ledger that makes cut-boundary double-scoring visible.
     track_length: jax.Array | None = None
-    # [n_parts, 4, rounds_bound] per-chip per-round exchange diagnostics:
+    # [n_parts, 6, rounds_bound] per-chip per-round cost breakdown:
     # rows are (pending before exchange, sent, received-for-me, free
-    # slots before adoption). adopted = min(received, free). The
-    # round-count model in one array: rounds where sent < pending are
-    # exchange-buffer overflow waits (raise exchange_size); a long tail
-    # of tiny pending counts is cut ping-pong (each cut crossing on a
-    # particle's path costs one round by construction).
+    # slots before adoption, adopted, follow-up walk body iterations).
+    # The round-count model in one array: rounds where sent < pending
+    # are exchange-buffer overflow waits (raise exchange_size); a long
+    # tail of tiny pending counts is cut ping-pong (each cut crossing
+    # on a particle's path costs one round by construction). Row 5 x
+    # the follow-up lane width is that round's executed walk slots —
+    # the walk-vs-exchange cost split VERDICT r4 asked to expose
+    # (clean-box virtual mesh, PARTITIONED_PROFILE_r05.json: the 3
+    # rounds at 1M tets cost ~0.6 s of the 5.3 s step; phase 1
+    # dominates, and most of it is serialized per-iteration fixed
+    # cost — BENCHMARKS.md "Round-5 decomposition").
     round_stats: jax.Array | None = None
     # [n_parts*cap, K, 3] / [n_parts*cap] per-particle boundary-crossing
     # points and counts when make_partitioned_step(record_xpoints=K) was
@@ -483,10 +489,14 @@ def _walk_phase(
                 )
                 carry = tuple(carry)
 
-    # Strip the loop counter; prev/stuck return to the caller's carry.
-    # The flux rides the loop flat — restore the caller's layout.
+    # prev/stuck return to the caller's carry; the loop counter comes
+    # back LAST (total body iterations executed across all stages — the
+    # per-round walk-cost term of round_stats). The flux rides the loop
+    # flat — restore the caller's layout.
     out = carry[:-1]
-    return out[:6] + (out[6].reshape(flux_shape),) + out[7:]
+    return (
+        out[:6] + (out[6].reshape(flux_shape),) + out[7:] + (carry[-1],)
+    )
 
 
 def make_partitioned_step(
@@ -681,25 +691,31 @@ def make_partitioned_step(
              dropped, *xpk) = carry
             emig = valid & (target >= 0)
 
-            # Bucket emigrants by destination chip: a stable sort on the
-            # target (non-emigrants keyed past every chip) makes each
-            # destination's emigrants a contiguous run; the rank within
-            # the run addresses a fixed E-slot block of the send buffer.
-            # Rows overflowing their destination block stay resident and
-            # retry next round.
-            key = jnp.where(emig, target, n_parts)
-            order = jnp.argsort(key, stable=True)
-            skey = key[order]
-            first = jnp.searchsorted(skey, skey, side="left")
-            rank = jnp.arange(cap, dtype=first.dtype) - first
-            sendable = (skey < n_parts) & (rank < E)
-            slot = jnp.where(
-                sendable, skey * E + rank, n_parts * E
-            )  # OOB rows drop
+            # Bucket emigrants by destination chip: each destination's
+            # emigrants rank by a per-destination running count
+            # (n_parts static cumsums — n_parts is a trace constant) and
+            # address a fixed E-slot block of the send buffer. Rows
+            # overflowing their destination block stay resident and
+            # retry next round. This replaces a stable argsort +
+            # searchsorted formulation: a bitonic sort network costs
+            # O(cap·log²cap) on TPU and forced a full gather by the sort
+            # order, where the cumsum ranking is O(n_parts·cap) of pure
+            # elementwise/scan work and scatters rows from their
+            # original lanes. (At pod scale with many parts per host the
+            # sort wins asymptotically — revisit the crossover if a
+            # partition ever exceeds ~32 parts per exchange group.)
+            slot = jnp.full(cap, n_parts * E, jnp.int32)  # OOB rows drop
+            sendable = jnp.zeros(cap, bool)
+            for d in range(n_parts):
+                m_d = emig & (target == d)
+                rank_d = jnp.cumsum(m_d.astype(jnp.int32)) - 1
+                ok_d = m_d & (rank_d < E)
+                slot = jnp.where(ok_d, d * E + rank_d, slot)
+                sendable = sendable | ok_d
 
             def fill(rows):
                 buf = jnp.zeros((n_parts * E,) + rows.shape[1:], rows.dtype)
-                return buf.at[slot].set(rows[order], mode="drop")
+                return buf.at[slot].set(rows, mode="drop")
 
             K3 = 3 * record_xpoints if record_xpoints is not None else 0
             f_cols = [cur, dest, weight[:, None], pseg[:, None]]
@@ -747,10 +763,9 @@ def make_partitioned_step(
                 i_cols.append(xpk[1].astype(jnp.int32))  # crossing count
             pay_i = fill(jnp.stack(i_cols, axis=1))  # [n_parts*E, 7(+1)]
 
-            # Sent slots free up.
-            sent_src = jnp.where(sendable, order, cap)
-            valid = valid.at[sent_src].set(False, mode="drop")
-            target = target.at[sent_src].set(-1, mode="drop")
+            # Sent slots free up (sendable is in original lane order).
+            valid = valid & ~sendable
+            target = jnp.where(sendable, -1, target)
 
             # ONE all_to_all: block d of my send buffer goes to chip d;
             # I receive n_parts blocks of rows all addressed to me.
@@ -811,6 +826,7 @@ def make_partitioned_step(
                     jnp.sum(sendable).astype(jnp.int32),
                     n_mine.astype(jnp.int32),
                     n_free.astype(jnp.int32),
+                    jnp.minimum(n_mine, n_free).astype(jnp.int32),
                 ]
             )
             return (cur, dest, elem, done, target, target_elem, material_id,
@@ -822,14 +838,14 @@ def make_partitioned_step(
              weight, group, pid, valid, prev, stuck, pseg, flux_l, nseg,
              dropped, *xpk) = carry
             (cur, elem, done, target, target_elem, material_id, flux_l,
-             nseg, prev, stuck, pseg, *xpk) = walk_fn(
+             nseg, prev, stuck, pseg, *xpk, w_iters) = walk_fn(
                 tables_l, cur, dest, elem, done, target, target_elem,
                 material_id, weight, group, flux_l, nseg, valid, prev,
                 stuck, pseg, *xpk,
             )
             return (cur, dest, elem, done, target, target_elem, material_id,
                     weight, group, pid, valid, prev, stuck, pseg, flux_l,
-                    nseg, dropped, *xpk)
+                    nseg, dropped, *xpk), w_iters
 
         carry = (
             cur, dest, elem, done, target0, vzero * 0,
@@ -844,21 +860,24 @@ def make_partitioned_step(
                 + cur[:, :1, None] * 0
             )
             carry = carry + (xp0, vzero * 0)
-        carry = run_walk(carry, walk_first)
+        carry, _ = run_walk(carry, walk_first)
 
         def pending_somewhere(carry):
             target, valid = carry[4], carry[10]
             n_pend = jnp.sum(valid & (target >= 0)).astype(jnp.int32)
             return jax.lax.psum(n_pend, AXIS) > 0
 
-        stats0 = jnp.zeros((4, rounds_bound), jnp.int32) + vzero[0] * 0
+        stats0 = jnp.zeros((6, rounds_bound), jnp.int32) + vzero[0] * 0
 
         def round_body(state):
             carry, r, stats = state
             carry, ex_stats = exchange(carry)
-            carry = run_walk(carry, walk_follow)
+            carry, w_iters = run_walk(carry, walk_follow)
+            row = jnp.concatenate(
+                [ex_stats, w_iters.astype(jnp.int32)[None]]
+            )
             stats = jax.lax.dynamic_update_slice(
-                stats, ex_stats[:, None], (0, r)
+                stats, row[:, None], (0, r)
             )
             return carry, r + 1, stats
 
@@ -866,9 +885,15 @@ def make_partitioned_step(
             carry, r, _ = state
             return jnp.logical_and(r < rounds_bound, pending_somewhere(carry))
 
-        carry, n_rounds, round_stats = jax.lax.while_loop(
-            round_cond, round_body, (carry, nseg0 * 0, stats0)
-        )
+        if rounds_bound > 0:
+            carry, n_rounds, round_stats = jax.lax.while_loop(
+                round_cond, round_body, (carry, nseg0 * 0, stats0)
+            )
+        else:
+            # max_rounds=0: walk-only step (no migration rounds) — used
+            # by the phase profiler; the [6, 0] stats buffer must not
+            # reach dynamic_update_slice inside a traced body.
+            n_rounds, round_stats = nseg0 * 0, stats0
         (cur, dest, elem, done, target, target_elem, material_id,
          weight, group, pid, valid, prev, stuck, pseg, flux_l, nseg,
          dropped, *xpk) = carry

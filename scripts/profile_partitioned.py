@@ -1,0 +1,177 @@
+"""Phase-level cost breakdown of the partitioned step on the virtual mesh.
+
+Times, on the 8-device virtual CPU mesh (one host core — wall time is
+total work, scripts/dryrun_partitioned_1m.py's caveat):
+  * the single-chip walk of the same batch (reference),
+  * phase 1 only (max_rounds=0: walk to done-or-pending + halo fold),
+  * the full step (phase 1 + migration rounds),
+each on the SECOND call (fresh inputs, donated state restaged) so
+compile time is excluded. The full−phase1 delta is the migration
+rounds' total cost; phase1−single is the partitioned walk body's
+overhead at equal work.
+
+Usage: python scripts/profile_partitioned.py [cells] [n] [halo]
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from pumiumtally_tpu import build_box, make_flux
+    from pumiumtally_tpu.ops.walk import trace_impl
+    from pumiumtally_tpu.ops.walk_partitioned import (
+        distribute_particles,
+        make_partitioned_step,
+    )
+    from pumiumtally_tpu.parallel.mesh_partition import partition_mesh
+    from pumiumtally_tpu.parallel.particle_sharding import make_device_mesh
+
+    cells = int(sys.argv[1]) if len(sys.argv) > 1 else 55
+    n = int(sys.argv[2]) if len(sys.argv) > 2 else 65536
+    halo = int(sys.argv[3]) if len(sys.argv) > 3 else 2
+    n_dev = 8
+    n_groups = 4
+    dtype = jnp.float32
+
+    mesh = build_box(1.0, 1.0, 1.0, cells, cells, cells, dtype=dtype)
+    part = partition_mesh(mesh, n_dev, halo_layers=halo)
+
+    rng = np.random.default_rng(0)
+    elem = rng.integers(0, mesh.ntet, n).astype(np.int32)
+    origin = np.asarray(mesh.centroids())[elem]
+    dest = np.clip(origin + rng.normal(0, 0.08, (n, 3)), 0.005, 0.995)
+    weight = rng.uniform(0.5, 2.0, n)
+    group = rng.integers(0, n_groups, n).astype(np.int32)
+
+    def time_single():
+        def call():
+            r = trace_impl(
+                mesh,
+                jnp.asarray(origin, dtype),
+                jnp.asarray(dest, dtype),
+                jnp.asarray(elem),
+                jnp.ones(n, bool),
+                jnp.asarray(weight, dtype),
+                jnp.asarray(group),
+                jnp.full(n, -1, jnp.int32),
+                make_flux(mesh.ntet, n_groups, dtype),
+                initial=False,
+                max_crossings=mesh.ntet + 64,
+                tolerance=1e-6,
+            )
+            jax.block_until_ready(r.flux)
+            return r
+
+        call()
+        t0 = time.perf_counter()
+        r = call()
+        return time.perf_counter() - t0, int(r.n_segments)
+
+    dmesh = make_device_mesh(n_dev)
+
+    def time_step(max_rounds, **kw):
+        step = make_partitioned_step(
+            dmesh, part, n_groups=n_groups, max_crossings=mesh.ntet + 64,
+            tolerance=1e-6, max_rounds=max_rounds, **kw,
+        )
+
+        def call():
+            placed = distribute_particles(
+                part, dmesh, elem,
+                dict(
+                    origin=origin.astype(np.float32),
+                    dest=dest.astype(np.float32),
+                    weight=weight.astype(np.float32),
+                    group=group,
+                    material_id=np.full(n, -1, np.int32),
+                ),
+            )
+            flux = jax.device_put(
+                jnp.zeros((n_dev, part.max_local * n_groups * 2), dtype),
+                NamedSharding(dmesh, P("p")),
+            )
+            res = step(
+                placed["origin"], placed["dest"], placed["elem"],
+                jnp.zeros_like(placed["valid"]), placed["material_id"],
+                placed["weight"], placed["group"], placed["particle_id"],
+                placed["valid"], flux,
+            )
+            jax.block_until_ready(res.flux)
+            return res
+
+        call()
+        t0 = time.perf_counter()
+        res = call()
+        dt = time.perf_counter() - t0
+        return dt, int(np.asarray(res.n_segments).sum()), int(
+            np.asarray(res.n_rounds)[0]
+        )
+
+    single_s, nseg = time_single()
+    p1_s, p1_seg, _ = time_step(0)
+    full_s, full_seg, rounds = time_step(None)
+    # Production-shaped variants: unroll 8 (the single-chip default) and
+    # the density-scaled dense ladder on phase 1 — the dispatch-
+    # amortizing machinery the bare steps above don't use. On the
+    # one-core virtual mesh the per-while-iteration fixed cost is what
+    # separates width-8192 chips from the width-65536 single walk.
+    from pumiumtally_tpu.utils.config import dense_ladder
+
+    cap = -(-n // 8)
+    scale = max(1.0, cells / 55.0)
+    ladder = tuple(
+        (int(round(s * scale)), min(w, cap), *r)
+        for s, w, *r in dense_ladder(cap)
+    )
+    u8_s, _, _ = time_step(None, unroll=8)
+    u8l_s, _, _ = time_step(None, unroll=8, compact_stages=ladder)
+    # No-tally walk (initial=True): same loop structure and iteration
+    # counts, zero flux scatters — if the gap collapses here, the
+    # overhead is the scatter/flux path (e.g. lost in-place aliasing of
+    # the carried slab), not per-iteration fixed cost.
+    init_s, _, _ = time_step(None, initial=True)
+    sq1_s, _, _ = time_step(None, score_squares=False)
+
+    rec = {
+        "metric": "partitioned_phase_profile",
+        "ntet": mesh.ntet,
+        "n_particles": n,
+        "halo_layers": halo,
+        "single_s": round(single_s, 2),
+        "phase1_s": round(p1_s, 2),
+        "full_s": round(full_s, 2),
+        "full_u8_s": round(u8_s, 2),
+        "full_u8_ladder_s": round(u8l_s, 2),
+        "full_notally_s": round(init_s, 2),
+        "full_nosq_s": round(sq1_s, 2),
+        "rounds": rounds,
+        "rounds_s": round(full_s - p1_s, 2),
+        "phase1_over_single": round(p1_s / single_s, 2),
+        "full_over_single": round(full_s / single_s, 2),
+        "u8_over_single": round(u8_s / single_s, 2),
+        "u8_ladder_over_single": round(u8l_s / single_s, 2),
+        "n_segments_single": nseg,
+        "n_segments_phase1": p1_seg,
+        "n_segments_full": full_seg,
+    }
+    print(json.dumps(rec))
+
+
+if __name__ == "__main__":
+    main()
+
+
