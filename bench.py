@@ -89,22 +89,22 @@ def run(
     # restores the 3-D layout for the A/B.
     flux = make_flux(mesh.ntet, n_groups, dtype, flat=flat_flux)
 
-    if compact_stages == "default":
-        # The slot-planned dense ladder (ONE definition, shared with
-        # TallyConfig's "auto" — see dense_ladder's docstring and
-        # BENCHMARKS.md "Slot-exact ladder planning"), with stage STARTS
-        # scaled by mesh density: crossings/move ∝ path/element-size, so
-        # the 55-cell curve's stage boundaries stretch by cells/55
-        # (measured: mean 14.9 at 55 cells → 32.6 at 119). Widths keep
-        # their decay-tracking fractions. Supersedes the round-2 3-stage
-        # schedule; re-confirm on hardware via BENCH_STAGES.
-        from pumiumtally_tpu.utils.config import dense_ladder
+    if compact_stages in ("default", "plan"):
+        # ONE definition, shared with production:
+        # TallyConfig.resolve_compact_stages. "default" = the
+        # density-scaled dense ladder ("auto": stage starts stretch by
+        # (ntet/998250)^(1/3) = cells/55 on box meshes — measured mean
+        # 14.9 crossings/move at 55 cells → 32.6 at 119); "plan" = the
+        # executional ladder planner (utils/ladder.plan_stages) at the
+        # density-estimated mean — the wave-3 A/B row against the dense
+        # default (simulator says fewer slot-equivalents; hardware
+        # arbitrates).
+        from pumiumtally_tpu.utils.config import TallyConfig
 
-        scale = max(1.0, cells / 55.0)
-        compact_stages = tuple(
-            (int(round(start * scale)), *rest)
-            for start, *rest in dense_ladder(n_particles)
-        )
+        mode = "auto" if compact_stages == "default" else "plan"
+        compact_stages = TallyConfig(
+            compact_stages=mode, unroll=unroll
+        ).resolve_compact_stages(n_particles, ntet=mesh.ntet)
 
     import functools
 
@@ -378,7 +378,9 @@ def run_event_loop(
         score_squares=cfg.score_squares,
         tolerance=cfg.tolerance,
         unroll=cfg.unroll,
-        compact_stages=cfg.resolve_compact_stages(n_particles),
+        compact_stages=cfg.resolve_compact_stages(
+            n_particles, ntet=mesh.ntet
+        ),
     )
     ca, cs = cfg.resolve_compaction(n_particles)
     kw.update(compact_after=ca, compact_size=cs)
@@ -447,6 +449,8 @@ def _stages_from_env() -> tuple | str | None:
     stages = os.environ.get("BENCH_STAGES", "")
     if stages == "none":
         return None
+    if stages == "plan":
+        return "plan"
     if stages:
         entries = []
         for p in stages.split(","):

@@ -112,7 +112,7 @@ class PumiTally:
             self._max_crossings = cfg.resolve_max_crossings(mesh.ntet)
             self._compact = cfg.resolve_compaction(int(num_particles))
             self._compact_stages = cfg.resolve_compact_stages(
-                int(num_particles)
+                int(num_particles), ntet=mesh.ntet
             )
             self.state: ParticleState = seed_at_element_centroid(
                 make_particle_state(self.num_particles, dtype=cfg.dtype), mesh

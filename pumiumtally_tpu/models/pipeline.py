@@ -122,7 +122,9 @@ class StreamingTallyPipeline:
                     cfg.resolve_compaction(n),
                 )
             ),
-            compact_stages=cfg.resolve_compact_stages(n),
+            compact_stages=cfg.resolve_compact_stages(
+                n, ntet=self.mesh.ntet
+            ),
             unroll=cfg.unroll,
             robust=cfg.robust,
             tally_scatter=cfg.tally_scatter,

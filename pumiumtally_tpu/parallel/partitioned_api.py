@@ -110,7 +110,9 @@ class PartitionedTally:
             record_xpoints=self.config.record_xpoints,
             compact_after=compact[0],
             compact_size=compact[1],
-            compact_stages=self.config.resolve_compact_stages(self.cap),
+            compact_stages=self.config.resolve_compact_stages(
+                self.cap, ntet=mesh.ntet
+            ),
             exchange_size=exchange_size,
             max_rounds=max_rounds,
         )

@@ -145,24 +145,54 @@ class TallyConfig:
             size = max(256, n_particles // 8)
         return self.compact_after, min(size, n_particles)
 
-    def resolve_compact_stages(self, n_particles: int) -> tuple | None:
+    def resolve_compact_stages(
+        self, n_particles: int, ntet: int | None = None
+    ) -> tuple | None:
         """Clamp a configured stage schedule to the batch size (None when
-        unset — the single-stage knobs apply). The string ``"auto"``
-        selects the dense ladder whose widths track an exponential
-        active-lane decay (scripts/plan_ladder.py scores it at ~0.58x
-        the executed slots of a 3-stage schedule at the benchmark's
-        crossing statistics; harmless when walks are shorter, because
-        each emptied stage is one guarded cheap round)."""
+        unset — the single-stage knobs apply).
+
+        ``"auto"`` selects the dense ladder — the measured-best TPU
+        schedule (7.60 Mseg/s vs the 3-stage schedule's 4.84, round-4
+        hardware grid) — with stage STARTS scaled by mesh density when
+        ``ntet`` is known: crossings/move grow with path/element-size,
+        so the 55-cell-calibrated boundaries stretch by
+        (ntet/998250)^(1/3), exactly the scaling bench.py applies and
+        the 10M/119-cell rung validated against the DP planner.
+
+        ``"plan"`` runs the executional ladder planner
+        (utils/ladder.plan_stages) on the analytic decay at the same
+        density-estimated mean — it scores ~9% under the dense ladder
+        in the simulator (31.3M vs 34.2M slot-equivalents at bench
+        stats) and adapts the whole shape, not just the starts, to the
+        mesh; hardware A/B pending (wave-3 row staged), which is why
+        "auto" still means the measured-best dense ladder."""
         if self.compact_stages is None or n_particles < 1024:
             return None
         if isinstance(self.compact_stages, str):
-            if self.compact_stages != "auto":
-                raise ValueError(
-                    "unknown compact_stages string "
-                    f"{self.compact_stages!r}; expected 'auto' or an "
-                    "explicit ((start, size[, unroll]), ...) schedule"
+            density = (
+                (max(ntet, 1) / 998250.0) ** (1.0 / 3.0)
+                if ntet is not None
+                else 1.0
+            )
+            if self.compact_stages == "auto":
+                scale = max(1.0, density)
+                return tuple(
+                    (int(round(start * scale)), *rest)
+                    for start, *rest in dense_ladder(n_particles)
                 )
-            return dense_ladder(n_particles)
+            if self.compact_stages == "plan":
+                from .ladder import plan_stages
+
+                # 14.9 = measured mean crossings/move at the bench
+                # workload (55-cell unit box, mean_path 0.08).
+                return plan_stages(
+                    n_particles, 14.9 * density, unroll=self.unroll
+                ) or None
+            raise ValueError(
+                "unknown compact_stages string "
+                f"{self.compact_stages!r}; expected 'auto', 'plan' or "
+                "an explicit ((start, size[, unroll]), ...) schedule"
+            )
         return tuple(
             (int(start), min(max(int(size), 1), n_particles),
              *(int(u) for u in rest))
