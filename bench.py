@@ -50,6 +50,7 @@ def run(
     fused: bool = True,
     repeats: int = 2,
     flat_flux: bool = True,
+    sd_mode: str = "segment",
 ) -> dict:
     import jax  # noqa: F401 — must import before the backend pin
 
@@ -60,6 +61,7 @@ def run(
     import jax.numpy as jnp
 
     from pumiumtally_tpu import build_box, make_flux
+    from pumiumtally_tpu.core.tally import accumulate_batch_squares
     from pumiumtally_tpu.ops.walk import resolve_tally_scatter, trace_impl
 
     # Resolve 'auto' here (post backend pin) so the detail record names
@@ -108,6 +110,16 @@ def run(
 
     import functools
 
+    if sd_mode not in ("segment", "batch", "none"):
+        raise ValueError(f"BENCH_SD must be segment|batch|none: {sd_mode!r}")
+    # "segment" scatters (c, c²) per crossing (reference parity);
+    # "batch" scatters only c and folds ONE squared per-bin delta per
+    # step (TallyConfig sd_mode="batch" — the −20% nosq lever with the
+    # sd retained at batch statistics); "none" drops squares entirely
+    # (the pure nosq A/B bound).
+    if sd_mode == "batch" and not flat_flux:
+        raise ValueError("BENCH_SD=batch requires the flat flux layout")
+
     def one_step(key, origin, elem, flux):
         kd, kl = jax.random.split(key)
         direction = jax.random.normal(kd, (n_particles, 3), dtype)
@@ -121,7 +133,7 @@ def run(
             flux,
             initial=False,
             max_crossings=mesh.ntet + 64,
-            score_squares=True,
+            score_squares=sd_mode == "segment",
             tolerance=1e-6,
             compact_after=compact_after,
             compact_size=compact_size,
@@ -148,17 +160,26 @@ def run(
         import jax.lax as lax
 
         def body(i, c):
-            origin, elem, flux, tot, _ = c
+            origin, elem, flux, prev_even, tot, _ = c
             pos, el, fl, nseg, ncross = one_step(keys[i], origin, elem, flux)
-            return pos, el, fl, tot + nseg, ncross
+            if sd_mode == "batch":
+                # ONE definition of the fold (jit-in-jit inlines), so
+                # the benchmark measures exactly the production math.
+                fl, prev_even = accumulate_batch_squares(fl, prev_even)
+            return pos, el, fl, prev_even, tot + nseg, ncross
 
         nseg_dtype = (
             jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
         )  # matches trace_impl's n_segments carry dtype
-        return lax.fori_loop(
-            0, keys.shape[0], body,
-            (origin, elem, flux, jnp.zeros((), nseg_dtype), jnp.int32(0)),
+        prev0 = jnp.zeros(
+            flux.size // 2 if sd_mode == "batch" else 0, dtype
         )
+        out = lax.fori_loop(
+            0, keys.shape[0], body,
+            (origin, elem, flux, prev0, jnp.zeros((), nseg_dtype),
+             jnp.int32(0)),
+        )
+        return out[0], out[1], out[2], out[4], out[5]
 
     key = jax.random.key(seed)
     keys = jax.random.split(key, steps + 2)
@@ -215,12 +236,17 @@ def run(
         windows = []
         for _ in range(repeats):
             pos, elem_c, flux = fresh_state()
+            prev_even = jnp.zeros(flux.size // 2, dtype)
             total_segments = 0
             t0 = time.perf_counter()
             for i in range(steps):
                 pos, elem_c, flux, nseg, ncross = step(
                     keys[2 + i], pos, elem_c, flux
                 )
+                if sd_mode == "batch":
+                    flux, prev_even = accumulate_batch_squares(
+                        flux, prev_even
+                    )
                 total_segments += nseg  # device-side accumulate; read at end
             # Host readback of a value depending on every step — a
             # stricter fence than block_until_ready on one output buffer
@@ -278,6 +304,7 @@ def run(
             "ledger": ledger,
             "fused_steps": fused,
             "flat_flux": flat_flux,
+            "sd_mode": sd_mode,
             # Per-window (segments, seconds) for every measurement
             # repeat; the headline is the best window (tunnel noise is
             # one-sided — interference only subtracts).
@@ -592,6 +619,9 @@ def main() -> None:
         fused=os.environ.get("BENCH_FUSED", "1") == "1",
         repeats=int(os.environ.get("BENCH_REPEAT", "2")),
         flat_flux=os.environ.get("BENCH_FLAT", "1") == "1",
+        # segment (reference parity) | batch (cheap sd: −20% step-time
+        # squares share folded into one pass per step) | none (nosq A/B)
+        sd_mode=os.environ.get("BENCH_SD", "segment"),
     )
     print(
         f"[bench] {result['detail']}", file=sys.stderr

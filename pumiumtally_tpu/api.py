@@ -39,7 +39,11 @@ import jax
 import jax.numpy as jnp
 
 from .core.state import ParticleState, make_particle_state, seed_at_element_centroid
-from .core.tally import make_flux, normalize_flux_host
+from .core.tally import (
+    accumulate_batch_squares,
+    make_flux,
+    normalize_flux_host,
+)
 from .io.vtk import write_flux_vtk
 from .mesh.core import TetMesh
 from .ops.walk import trace
@@ -121,6 +125,19 @@ class PumiTally:
             # dim 2 → 128 under the (8,128) tile (64× HBM; see make_flux).
             self.flux = make_flux(
                 mesh.ntet, cfg.n_groups, dtype=cfg.dtype, flat=True
+            )
+            if cfg.sd_mode not in ("segment", "batch"):
+                raise ValueError(
+                    f"sd_mode must be 'segment' or 'batch': {cfg.sd_mode!r}"
+                )
+            # sd_mode="batch": snapshot of the even (Σc) entries as of
+            # the previous move, for the per-move squared-delta fold
+            # (core.tally.accumulate_batch_squares). score_squares=False
+            # still means NO squares work at all, in either mode.
+            self._prev_even = (
+                jnp.zeros(mesh.ntet * cfg.n_groups, cfg.dtype)
+                if cfg.sd_mode == "batch" and cfg.score_squares
+                else None
             )
             self.iter_count = 0
             self.total_segments = 0
@@ -285,7 +302,12 @@ class PumiTally:
                 self.flux,
                 initial=False,
                 max_crossings=self._max_crossings,
-                score_squares=cfg.score_squares,
+                # sd_mode="batch" skips the per-segment squares rows
+                # entirely (the −20% step-time share) and folds one
+                # squared per-move delta below instead.
+                score_squares=(
+                    cfg.score_squares and cfg.sd_mode == "segment"
+                ),
                 tolerance=cfg.tolerance,
                 compact_after=self._compact[0],
                 compact_size=self._compact[1],
@@ -299,6 +321,10 @@ class PumiTally:
                 n_groups=cfg.n_groups,
             )
             self.flux = result.flux
+            if self._prev_even is not None:
+                self.flux, self._prev_even = accumulate_batch_squares(
+                    self.flux, self._prev_even
+                )
             self.state = s._replace(
                 origin=result.position,
                 dest=dest,
@@ -389,6 +415,7 @@ class PumiTally:
             self.mesh.volumes,
             self.num_particles,
             max(self.iter_count, 1),
+            sd_mode=self.config.sd_mode,
         )
 
     def reaction_rate(self, sigma: np.ndarray) -> np.ndarray:
@@ -398,6 +425,17 @@ class PumiTally:
         Host-side for the same padded-layout reason as normalized_flux."""
         from .core.tally import reaction_rate_host
 
+        if self.config.sd_mode != "segment":
+            # The derived squares column is σ²·(slot 1), which is only
+            # the documented Σ(w·l·σ)² when slot 1 holds per-SEGMENT
+            # squares; in batch mode slot 1 is Σ(per-move bin totals)²
+            # and the product would silently be ~N× the per-segment
+            # statistic.
+            raise NotImplementedError(
+                "reaction_rate requires sd_mode='segment' (batch mode's "
+                "slot 1 holds per-move batch squares, not per-segment "
+                f"squares); config has sd_mode={self.config.sd_mode!r}"
+            )
         return reaction_rate_host(
             self.raw_flux,
             np.asarray(self.mesh.class_id),

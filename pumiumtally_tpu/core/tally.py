@@ -13,6 +13,8 @@ move/batch count when provided (the fix the in-code FIXME asks for).
 """
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -37,22 +39,46 @@ def make_flux(
     return jnp.zeros((ntet, n_groups, 2), dtype=dtype)
 
 
-def _normalize_flux_impl(xp, flux, volumes, n_particles, n_iterations):
+def _normalize_flux_impl(
+    xp, flux, volumes, n_particles, n_iterations, sd_mode="segment"
+):
     vol = volumes[:, None]
     n = xp.asarray(n_particles, flux.dtype)
     m = xp.maximum(xp.asarray(n_iterations, flux.dtype), 1.0)
     m1 = flux[..., 0] / (vol * n)
     m2 = flux[..., 1] / (vol * vol * n)
-    h = n * m  # total samples
-    var_y = xp.maximum(
-        flux[..., 1] - flux[..., 0] * flux[..., 0] / h, 0.0
-    ) / xp.maximum(h - 1.0, 1.0)
-    sd = xp.sqrt(m * var_y / n) / vol
+    if sd_mode == "segment":
+        h = n * m  # total samples: per-(particle, move) scores
+        var_y = xp.maximum(
+            flux[..., 1] - flux[..., 0] * flux[..., 0] / h, 0.0
+        ) / xp.maximum(h - 1.0, 1.0)
+        sd = xp.sqrt(m * var_y / n) / vol
+    elif sd_mode == "batch":
+        # Slot 1 holds Σ T² of per-MOVE bin totals T (TallyConfig
+        # sd_mode="batch": the walk skips per-segment squares and the
+        # facade squares each move's bin delta once — one elementwise
+        # pass over the accumulator per move instead of doubling the
+        # per-crossing scatter rows). Samples are the M move totals:
+        #   s²_T  = (ΣT² − (ΣT)²/M) / (M − 1)
+        #   flux  = ΣT/(vol·N);  Var(flux) = M·s²_T/(vol²·N²)
+        #   sd    = sqrt(M·s²_T)/(vol·N)
+        # Same estimand as the segment form when particle scores are
+        # independent; the estimator itself is noisier (M−1 degrees of
+        # freedom instead of N·M−1 — relative sd-of-sd ~ 1/sqrt(2(M−1))).
+        var_t = xp.maximum(
+            flux[..., 1] - flux[..., 0] * flux[..., 0] / m, 0.0
+        ) / xp.maximum(m - 1.0, 1.0)
+        sd = xp.sqrt(m * var_t) / (vol * n)
+    else:
+        raise ValueError(
+            f"sd_mode must be 'segment' or 'batch': {sd_mode!r}"
+        )
     return xp.stack([m1, m2, sd], axis=-1)
 
 
-@jax.jit
-def normalize_flux(flux, volumes, n_particles, n_iterations=1):
+@functools.partial(jax.jit, static_argnames=("sd_mode",))
+def normalize_flux(flux, volumes, n_particles, n_iterations=1,
+                   sd_mode="segment"):
     """Normalize raw tallies by element volume and particle count, with a
     statistically correct standard deviation of the flux estimate.
 
@@ -78,20 +104,47 @@ def normalize_flux(flux, volumes, n_particles, n_iterations=1):
     against an analytic known-variance oracle in
     tests/test_tally_oracle.py::test_sd_matches_analytic_variance.
 
+    ``sd_mode="batch"`` reads slot 1 as Σ(per-move bin totals)² instead
+    of per-segment squares (see _normalize_flux_impl) — the cheap-tally
+    mode's estimator, pinned against the same analytic oracle.
+
     Returns [ntet, n_groups, 3]: (mean flux, second moment, sd).
     """
-    return _normalize_flux_impl(jnp, flux, volumes, n_particles, n_iterations)
+    return _normalize_flux_impl(
+        jnp, flux, volumes, n_particles, n_iterations, sd_mode
+    )
 
 
-def normalize_flux_host(flux, volumes, n_particles, n_iterations=1):
+def normalize_flux_host(flux, volumes, n_particles, n_iterations=1,
+                        sd_mode="segment"):
     """normalize_flux on HOST numpy arrays — identical math, no device
     round-trip. The write path uses this so the one-shot [ntet,n_groups,2]
     view never materializes in the TPU's padded tile layout (see
     make_flux). Pinned equal to normalize_flux in tests/test_flat_flux.py.
     """
     return _normalize_flux_impl(
-        np, np.asarray(flux), np.asarray(volumes), n_particles, n_iterations
+        np, np.asarray(flux), np.asarray(volumes), n_particles,
+        n_iterations, sd_mode,
     )
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def accumulate_batch_squares(flux, prev_even):
+    """Fold one move's batch-level squared contribution into the tally
+    (TallyConfig ``sd_mode="batch"``).
+
+    ``flux`` is the FLAT stride-2 accumulator whose even entries hold
+    Σc INCLUDING the move just walked (with ``score_squares=False`` the
+    walk writes only even keys); ``prev_even`` is the even-entry
+    snapshot from before it. Adds the squared per-bin delta (this
+    move's bin total T, squared) into the odd entries and returns the
+    updated (flux, new snapshot): two elementwise passes over the
+    accumulator per MOVE in place of doubling every per-crossing
+    scatter row — the squares rows measured ~20% of TPU step time
+    (round-4 nosq A/B; BENCHMARKS.md "v5e ceiling")."""
+    even = flux[0::2]
+    delta = even - prev_even
+    return flux.at[1::2].add(delta * delta), even
 
 
 @jax.jit

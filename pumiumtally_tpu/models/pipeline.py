@@ -69,6 +69,13 @@ class StreamingTallyPipeline:
     ):
         self.mesh = mesh
         self.config = config or TallyConfig()
+        if self.config.sd_mode != "segment":
+            raise NotImplementedError(
+                "StreamingTallyPipeline supports sd_mode='segment' only "
+                "(batches overlap in flight, so a per-move even-entry "
+                "snapshot would serialize the pipeline); use PumiTally "
+                f"for sd_mode={self.config.sd_mode!r}"
+            )
         self.depth = max(1, int(depth))
         self.want_outputs = want_outputs
         self.flux = make_flux(

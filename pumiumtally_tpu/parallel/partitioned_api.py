@@ -71,6 +71,13 @@ class PartitionedTally:
         self.mesh = mesh
         self.num_particles = int(num_particles)
         self.config = config if config is not None else TallyConfig()
+        if self.config.sd_mode != "segment":
+            raise NotImplementedError(
+                "PartitionedTally supports sd_mode='segment' only (the "
+                "batch fold would need per-move deltas of the halo-"
+                "folded owner slabs); use PumiTally for sd_mode="
+                f"{self.config.sd_mode!r}"
+            )
         if mesh.dtype != jnp.dtype(self.config.dtype):
             raise ValueError(
                 f"mesh dtype {mesh.dtype} != config dtype "

@@ -50,6 +50,9 @@ def save_checkpoint(filename: str, tally) -> None:
         "total_segments": tally.total_segments,
         "initialized": tally._initialized,
         "dtype": str(np.dtype(tally.config.dtype)),
+        # Slot-1 statistic: per-segment squares vs per-move batch
+        # squares are NOT mixable — validated on restore.
+        "sd_mode": tally.config.sd_mode,
     }
     np.savez_compressed(
         filename,
@@ -119,6 +122,14 @@ def restore_checkpoint(filename: str, tally) -> None:
     with np.load(_normalize(filename)) as z:
         meta = json.loads(bytes(z["meta"].tobytes()).decode())
         _validate_meta(meta, tally, expected_kind=None)
+        ck_sd = meta.get("sd_mode", "segment")  # pre-r5 files: segment
+        if ck_sd != getattr(tally.config, "sd_mode", "segment"):
+            raise ValueError(
+                f"checkpoint slot-1 statistic is sd_mode={ck_sd!r} but "
+                f"this tally is configured sd_mode="
+                f"{tally.config.sd_mode!r}; per-segment and per-move "
+                "batch squares cannot be mixed"
+            )
         dtype = tally.config.dtype
         # Device accumulator is flat (api make_flux flat=True); accept
         # both 3-D (canonical/older) and flat on-disk arrays.
@@ -138,6 +149,11 @@ def restore_checkpoint(filename: str, tally) -> None:
         tally._initialized = bool(meta["initialized"])
         perm = z["perm"]
         tally._perm = None if perm.size == 0 else perm.astype(np.int64)
+        if getattr(tally, "_prev_even", None) is not None:
+            # sd_mode="batch": the even-entry snapshot is derived state —
+            # the per-move fold runs after every move, so at any
+            # checkpoint boundary it equals the current even entries.
+            tally._prev_even = tally.flux[0::2]
 
 
 def save_partitioned_checkpoint(filename: str, tally) -> None:

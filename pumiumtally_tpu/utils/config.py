@@ -99,6 +99,20 @@ class TallyConfig:
         consistency assert). One elementwise op per crossing — off only
         when squeezing the last percent from the hot loop.
 
+    sd_mode: standard-deviation accumulation strategy.
+        "segment" (default, reference parity): the walk scatters (c, c²)
+        per scored segment — slot 1 is Σc².
+        "batch": the walk scatters only c (score_squares path measured
+        −20% TPU step time, round-4 nosq A/B) and the facade folds ONE
+        squared per-bin delta per MOVE into slot 1
+        (core.tally.accumulate_batch_squares), so slot 1 is Σ(per-move
+        bin totals)². The sd estimand is the same when particle scores
+        are independent; the estimator has M−1 degrees of freedom
+        (M = moves) instead of N·M−1, i.e. a noisier sd-of-sd by
+        ~sqrt((N·M)/M) — quantified against the analytic variance
+        oracle in tests/test_tally_oracle.py. Honored by PumiTally;
+        PartitionedTally and StreamingTallyPipeline reject it for now.
+
     Scope: ``ledger`` and ``gathers`` are honored by the single-chip and
     streaming-pipeline walks only. The partitioned walk
     (ops/walk_partitioned.py) always accumulates and migrates the ledger
@@ -125,6 +139,7 @@ class TallyConfig:
     tally_scatter: str = "auto"
     gathers: str = "merged"
     ledger: bool = True
+    sd_mode: str = "segment"
 
     def resolve_max_crossings(self, ntet: int) -> int:
         if self.max_crossings is not None:

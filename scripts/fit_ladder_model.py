@@ -30,7 +30,6 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from scripts.plan_ladder import (  # noqa: E402
-    final_loop_slots,
     optimize_ladder,
     survivors,
 )

@@ -1,0 +1,42 @@
+#!/bin/bash
+# Round-5 wave-3 TPU rows — the NEW mechanisms, A/B'd against the
+# wave-2 headline (same defaults: flat flux, auto scatter, robust,
+# dense ladder, best-of-N identical-workload windows). Cheapest and
+# highest-information first; every row reuses the wave-2 compile cache.
+#   1. sd-mode ladder: batch (the −20% squares share folded into one
+#      elementwise pass per step, sd retained at batch statistics) and
+#      none (the pure nosq bound) — VERDICT r4 item 2a, BENCHMARKS.md
+#      "v5e ceiling".
+#   2. planner schedule vs the dense default — VERDICT r4 item 3
+#      (utils/ladder.plan_stages; flips TallyConfig "auto" if >= dense).
+#   3. 64-group batch-sd row: the production target where the scatter
+#      share is largest.
+#   4. Mosaic/pallas scatter re-probe on the current stack (r4 item 2b).
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p bench_out
+export JAX_COMPILATION_CACHE_DIR="$PWD/.jax_cache"
+
+run() {
+  name="$1"; shift
+  for attempt in 1 2; do
+    echo "=== $name (attempt $attempt): $* ==="
+    timeout "${CAPTURE_TIMEOUT:-2400}" "$@" \
+      >"bench_out/$name.out" 2>"bench_out/$name.err"
+    rc=$?
+    echo "rc=$rc ($name)"
+    tail -3 "bench_out/$name.out" 2>/dev/null
+    [ "$rc" -eq 0 ] && break
+  done
+}
+
+run bench_w3_sd_batch env BENCH_SD=batch BENCH_EVENT=0 BENCH_PROBE=0 \
+    BENCH_REPEAT=2 python bench.py
+run bench_w3_sd_none env BENCH_SD=none BENCH_EVENT=0 BENCH_PROBE=0 \
+    BENCH_REPEAT=2 python bench.py
+run bench_w3_plan env BENCH_STAGES=plan BENCH_EVENT=0 BENCH_PROBE=0 \
+    BENCH_REPEAT=2 python bench.py
+run bench_w3_64g_batch env BENCH_GROUPS=64 BENCH_SD=batch BENCH_EVENT=0 \
+    BENCH_PROBE=0 python bench.py
+run probe_pallas_w3 python scripts/probe_pallas_gather.py
+echo "=== wave3 rows complete ==="

@@ -278,3 +278,106 @@ def test_intersection_points_no_crossing_and_pre_trace_errors():
     )
     _, counts = t.intersection_points()
     np.testing.assert_array_equal(counts, 0)
+
+
+def test_batch_sd_matches_analytic_variance():
+    """The cheap-tally sd (TallyConfig sd_mode="batch") against the SAME
+    analytic oracle as the segment estimator (VERDICT r4 item 2a).
+
+    Same model: N particles x M moves, per-(particle, move) score
+    y = w·L in one tet. Batch mode accumulates T_m = Σ_particles y (the
+    per-move bin total) and Σ T_m² — what accumulate_batch_squares
+    builds from per-move deltas — and normalize_flux(sd_mode="batch")
+    must (1) satisfy its finite-sample identity exactly, (2) converge
+    to the same analytic sd_true = L·sqrt(M·Var(w)/N)/V (the estimand
+    is identical for independent particle scores), and (3) pay the
+    predicted statistical price: the estimator has M−1 degrees of
+    freedom instead of N·M−1.
+    """
+    import jax.numpy as jnp
+
+    from pumiumtally_tpu.core.tally import normalize_flux
+
+    rng = np.random.default_rng(321)
+    N, M = 40_000, 64
+    L, V = 0.25, 1.0 / 6.0
+    w = rng.uniform(0.5, 1.5, (N, M))  # Var(w) = 1/12
+    t = (w * L).sum(axis=0)  # per-move bin totals, shape [M]
+    flux = np.zeros((1, 1, 2))
+    flux[0, 0, 0] = t.sum()
+    flux[0, 0, 1] = (t * t).sum()
+
+    norm = np.asarray(
+        normalize_flux(
+            jnp.asarray(flux), jnp.asarray([V]), N, M, sd_mode="batch"
+        )
+    )
+    got_sd = norm[0, 0, 2]
+
+    # Exact finite-sample identity: sd = sqrt(M·s²_T)/(V·N).
+    s2t = ((t * t).sum() - t.sum() ** 2 / M) / (M - 1)
+    sd_exact = np.sqrt(M * s2t) / (V * N)
+    assert got_sd == pytest.approx(sd_exact, rel=1e-6)
+
+    # Same estimand as segment mode: sd_true = L·sqrt(M/(12N))/V.
+    # Tolerance is the estimator's own noise: relative sd-of-sd
+    # ~ 1/sqrt(2(M−1)) ≈ 9% at M=64 (the quantified cost of the
+    # cheap mode; segment mode at the same workload sits at
+    # 1/sqrt(2(NM−1)) ≈ 0.04%).
+    sd_true = L * np.sqrt(M / (12 * N)) / V
+    assert got_sd == pytest.approx(sd_true, rel=4 / np.sqrt(2 * (M - 1)))
+
+    # Mean is untouched by the mode.
+    assert norm[0, 0, 0] == pytest.approx(M * 1.0 * L / V, rel=0.01)
+
+
+def test_batch_sd_mode_through_facade():
+    """sd_mode="batch" end-to-end: same mean flux bit-for-bit as
+    segment mode, squares accumulated per move, sd within the batch
+    estimator's noise of the segment sd."""
+    import jax.numpy as jnp
+
+    from pumiumtally_tpu import build_box
+    from pumiumtally_tpu.api import PumiTally, TallyConfig
+
+    mesh = build_box(1.0, 1.0, 1.0, 4, 4, 4, dtype=jnp.float64)
+    cents = np.asarray(mesh.centroids())
+    N, M = 2048, 6
+    runs = {}
+    for mode in ("segment", "batch"):
+        t = PumiTally(
+            mesh, N,
+            TallyConfig(dtype=jnp.float64, n_groups=2, sd_mode=mode),
+        )
+        rng = np.random.default_rng(7)
+        elem = rng.integers(0, mesh.ntet, N).astype(np.int32)
+        pos = cents[elem].astype(np.float64)
+        t.initialize_particle_location(pos.reshape(-1).copy())
+        prev = pos.copy()
+        for _ in range(M):
+            d = rng.normal(0, 1, (N, 3))
+            d /= np.linalg.norm(d, axis=1, keepdims=True)
+            ln = rng.exponential(0.2, (N, 1))
+            buf = np.clip(prev + d * ln, 0.01, 0.99).reshape(-1).copy()
+            fly = np.ones(N, np.int8)
+            t.move_to_next_location(
+                buf, fly, np.ones(N),
+                rng.integers(0, 2, N).astype(np.int32),
+                np.full(N, -1, np.int32),
+            )
+            prev = buf.reshape(N, 3)
+        runs[mode] = (t.raw_flux.copy(), t.normalized_flux())
+
+    seg_raw, seg_norm = runs["segment"]
+    bat_raw, bat_norm = runs["batch"]
+    # Identical walk, identical mean accumulator.
+    np.testing.assert_array_equal(seg_raw[..., 0], bat_raw[..., 0])
+    np.testing.assert_array_equal(seg_norm[..., 0], bat_norm[..., 0])
+    # Squares slots hold different statistics (ΣT² vs Σc²) by design.
+    assert not np.array_equal(seg_raw[..., 1], bat_raw[..., 1])
+    # The sds estimate the same quantity: compare in aggregate over
+    # well-sampled bins (batch has only M-1=5 dof per bin, so compare
+    # the distribution center, not bin-by-bin).
+    mask = seg_raw[..., 0] > np.percentile(seg_raw[..., 0], 90)
+    ratio = bat_norm[..., 2][mask] / seg_norm[..., 2][mask]
+    assert 0.5 < np.median(ratio) < 2.0, np.median(ratio)
