@@ -45,7 +45,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def run_rehearsal(
-    cells: int, n: int, n_steps: int, halo_layers: int = 1
+    cells: int, n: int, n_steps: int, halo_layers: int = 1,
+    n_groups: int = 4,
 ) -> dict:
     """Run the partitioned depletion rehearsal; returns the evidence dict.
     Requires >= 8 JAX devices (virtual CPU mesh in tests/scripts)."""
@@ -68,7 +69,6 @@ def run_rehearsal(
     from pumiumtally_tpu.parallel.particle_sharding import make_device_mesh
 
     n_dev = 8
-    n_groups = 4
     dtype = jnp.float32
     dt = 0.1
 
@@ -218,6 +218,12 @@ def run_rehearsal(
     rec = dict(
         metric="partitioned_depletion_rehearsal",
         halo_layers=halo_layers,
+        n_groups=n_groups,
+        max_local=part.max_local,
+        # The per-chip flat tally key bound the int32 guard protects
+        # (ops/walk_partitioned.py): 2*max_local*n_groups must stay
+        # < 2^31 — the 10M/64-group rung exercises it at ~2e8.
+        flat_key_bound=int(2 * part.max_local * n_groups),
         ntet=mesh.ntet,
         n_parts=n_dev,
         n_particles=n,
@@ -239,7 +245,8 @@ def main():
     n = int(sys.argv[2]) if len(sys.argv) > 2 else 65536
     n_steps = int(sys.argv[3]) if len(sys.argv) > 3 else 3
     halo = int(sys.argv[4]) if len(sys.argv) > 4 else 1
-    print(json.dumps(run_rehearsal(cells, n, n_steps, halo)))
+    n_groups = int(sys.argv[5]) if len(sys.argv) > 5 else 4
+    print(json.dumps(run_rehearsal(cells, n, n_steps, halo, n_groups)))
 
 
 if __name__ == "__main__":
