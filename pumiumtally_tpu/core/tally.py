@@ -141,10 +141,15 @@ def accumulate_batch_squares(flux, prev_even):
     updated (flux, new snapshot): two elementwise passes over the
     accumulator per MOVE in place of doubling every per-crossing
     scatter row — the squares rows measured ~20% of TPU step time
-    (round-4 nosq A/B; BENCHMARKS.md "v5e ceiling")."""
-    even = flux[0::2]
+    (round-4 nosq A/B; BENCHMARKS.md "v5e ceiling").
+
+    The stride-2 split runs on the TRAILING axis, so the same fold
+    serves the 1-D single-chip accumulator and PartitionedTally's 2-D
+    per-chip slabs [n_parts, max_local*n_groups*2] (elementwise per
+    chip — sharding preserved, no collective)."""
+    even = flux[..., 0::2]
     delta = even - prev_even
-    return flux.at[1::2].add(delta * delta), even
+    return flux.at[..., 1::2].add(delta * delta), even
 
 
 @jax.jit

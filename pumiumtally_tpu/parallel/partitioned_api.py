@@ -45,6 +45,7 @@ from ..ops.walk_partitioned import (
     make_partitioned_step,
 )
 from ..utils.config import TallyConfig
+from ..core.tally import accumulate_batch_squares
 from .mesh_partition import assemble_global_flux, partition_mesh
 from .particle_sharding import PARTICLE_AXIS as AXIS, make_device_mesh
 
@@ -71,11 +72,9 @@ class PartitionedTally:
         self.mesh = mesh
         self.num_particles = int(num_particles)
         self.config = config if config is not None else TallyConfig()
-        if self.config.sd_mode != "segment":
-            raise NotImplementedError(
-                "PartitionedTally supports sd_mode='segment' only (the "
-                "batch fold would need per-move deltas of the halo-"
-                "folded owner slabs); use PumiTally for sd_mode="
+        if self.config.sd_mode not in ("segment", "batch"):
+            raise ValueError(
+                f"sd_mode must be 'segment' or 'batch': "
                 f"{self.config.sd_mode!r}"
             )
         if mesh.dtype != jnp.dtype(self.config.dtype):
@@ -110,7 +109,13 @@ class PartitionedTally:
             n_groups=self.config.n_groups,
             max_crossings=self.config.resolve_max_crossings(mesh.ntet),
             tolerance=self.config.tolerance,
-            score_squares=self.config.score_squares,
+            # sd_mode="batch": the walk scatters only Σc; the per-move
+            # squared delta is folded in _run (same contract as
+            # PumiTally / core.tally.accumulate_batch_squares).
+            score_squares=(
+                self.config.score_squares
+                and self.config.sd_mode == "segment"
+            ),
             unroll=self.config.unroll,
             robust=self.config.robust,
             tally_scatter=self.config.tally_scatter,
@@ -152,6 +157,27 @@ class PartitionedTally:
         self.total_rounds = 0
         self._initialized = False
         self._last_xpoints: tuple | None = None
+        # sd_mode="batch": per-chip snapshot of the even (Σc) slab
+        # entries as of the previous move. The halo fold has already
+        # moved guest scores onto owner rows (and zeroed halo rows) by
+        # the time the step returns, so the per-move owned-row delta is
+        # the move's complete bin total — the fold is elementwise per
+        # chip, no extra collective.
+        self._prev_even = (
+            jax.device_put(
+                jnp.zeros(
+                    (
+                        self.n_parts,
+                        self.partition.max_local * self.config.n_groups,
+                    ),
+                    self.config.dtype,
+                ),
+                NamedSharding(device_mesh, P(AXIS)),
+            )
+            if self.config.sd_mode == "batch"
+            and self.config.score_squares
+            else None
+        )
 
     # ------------------------------------------------------------------ #
     def _check_finite(self, name: str, arr: np.ndarray) -> None:
@@ -198,6 +224,13 @@ class PartitionedTally:
             self.flux_slabs,
         )
         self.flux_slabs = res.flux
+        if self._prev_even is not None and not initial:
+            # Trailing-axis stride-2 fold — elementwise per chip, the
+            # guest scores are already on owner rows (halo rows zeroed)
+            # when the step returns.
+            self.flux_slabs, self._prev_even = accumulate_batch_squares(
+                self.flux_slabs, self._prev_even
+            )
         got = collect_by_particle_id(
             res, int(moving.sum()), self.partition
         )
@@ -322,11 +355,19 @@ class PartitionedTally:
             np.asarray(self.mesh.volumes),
             self.num_particles,
             max(self.iter_count, 1),
+            sd_mode=self.config.sd_mode,
         )
 
     def reaction_rate(self, sigma: np.ndarray) -> np.ndarray:
         from ..core.tally import reaction_rate_host
 
+        if self.config.sd_mode != "segment":
+            # Same statistic mismatch as PumiTally.reaction_rate: the
+            # derived squares column assumes per-segment squares.
+            raise NotImplementedError(
+                "reaction_rate requires sd_mode='segment'; config has "
+                f"sd_mode={self.config.sd_mode!r}"
+            )
         return reaction_rate_host(
             self.raw_flux,
             np.asarray(self.mesh.class_id),

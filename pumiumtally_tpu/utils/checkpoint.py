@@ -101,6 +101,13 @@ def _validate_meta(meta: dict, tally, expected_kind: str | None) -> None:
         )
     if meta["mesh_fingerprint"] != mesh_fingerprint(tally.mesh):
         raise ValueError("checkpoint was written against a different mesh")
+    ck_sd = meta.get("sd_mode", "segment")  # pre-r5 files: segment
+    if ck_sd != getattr(tally.config, "sd_mode", "segment"):
+        raise ValueError(
+            f"checkpoint slot-1 statistic is sd_mode={ck_sd!r} but this "
+            f"tally is configured sd_mode={tally.config.sd_mode!r}; "
+            "per-segment and per-move batch squares cannot be mixed"
+        )
     if meta["num_particles"] != tally.num_particles:
         raise ValueError(
             f"checkpoint has {meta['num_particles']} particles, tally "
@@ -122,14 +129,6 @@ def restore_checkpoint(filename: str, tally) -> None:
     with np.load(_normalize(filename)) as z:
         meta = json.loads(bytes(z["meta"].tobytes()).decode())
         _validate_meta(meta, tally, expected_kind=None)
-        ck_sd = meta.get("sd_mode", "segment")  # pre-r5 files: segment
-        if ck_sd != getattr(tally.config, "sd_mode", "segment"):
-            raise ValueError(
-                f"checkpoint slot-1 statistic is sd_mode={ck_sd!r} but "
-                f"this tally is configured sd_mode="
-                f"{tally.config.sd_mode!r}; per-segment and per-move "
-                "batch squares cannot be mixed"
-            )
         dtype = tally.config.dtype
         # Device accumulator is flat (api make_flux flat=True); accept
         # both 3-D (canonical/older) and flat on-disk arrays.
@@ -176,6 +175,7 @@ def save_partitioned_checkpoint(filename: str, tally) -> None:
         "total_rounds": tally.total_rounds,
         "initialized": tally._initialized,
         "dtype": str(np.dtype(tally.config.dtype)),
+        "sd_mode": tally.config.sd_mode,
     }
     np.savez_compressed(
         filename,
@@ -217,3 +217,7 @@ def restore_partitioned_checkpoint(filename: str, tally) -> None:
         tally.total_segments = int(meta["total_segments"])
         tally.total_rounds = int(meta["total_rounds"])
         tally._initialized = bool(meta["initialized"])
+        if getattr(tally, "_prev_even", None) is not None:
+            # Batch-sd snapshot is derived state (== current even
+            # entries at any move boundary), re-slabbed alongside flux.
+            tally._prev_even = tally.flux_slabs[:, 0::2]

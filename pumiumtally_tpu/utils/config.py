@@ -110,8 +110,10 @@ class TallyConfig:
         are independent; the estimator has M−1 degrees of freedom
         (M = moves) instead of N·M−1, i.e. a noisier sd-of-sd by
         ~sqrt((N·M)/M) — quantified against the analytic variance
-        oracle in tests/test_tally_oracle.py. Honored by PumiTally;
-        PartitionedTally and StreamingTallyPipeline reject it for now.
+        oracle in tests/test_tally_oracle.py. Honored by PumiTally and
+        PartitionedTally (per-chip elementwise fold over the owned
+        slabs — halo scores are already on owner rows at step end);
+        StreamingTallyPipeline rejects it (in-flight batches overlap).
 
     Scope: ``ledger`` and ``gathers`` are honored by the single-chip and
     streaming-pipeline walks only. The partitioned walk
