@@ -121,6 +121,14 @@ def allreduce_flux(local_flux, in_program: bool = True) -> np.ndarray:
     allreduce_flux is for the full-mesh-replicated mode, whose flux must
     fit one host — exactly like the reference's full-mesh picparts mode
     (owners all 0, cpp:865-876).
+
+    Slot-1 statistics note: with the default sd_mode="segment" the sum
+    of per-host Σc² is the global Σc² and normalize_flux applies
+    unchanged. Under sd_mode="batch" each host's slot 1 is Σ(per-host
+    per-move totals)²; the reduced slot 1 is then a sum over
+    n_hosts·M batch samples, so pass n_iterations = moves × hosts to
+    normalize_flux(sd_mode="batch") — per-host batches are valid
+    samples of the same estimand, they are just smaller ones.
     """
     from jax.experimental import multihost_utils
 
