@@ -147,10 +147,20 @@ def run_rehearsal(
 
         # Conservation ledger across cuts: scored track length must equal
         # net displacement (all movement rides the origin->dest ray).
+        # Tolerance is the f32 ACCUMULATION envelope, which scales with
+        # crossings/move ∝ cells: measured max err 1.9e-4 at 12 cells,
+        # 2.1e-4 (centroid sources) / 2.4e-3 (off-element sources, long
+        # relocation chases) at 119 cells — and the same workload in
+        # f64 is exact to 8e-7, so this is rounding, not cut-boundary
+        # double-scoring (round-5 discriminator, BENCHMARKS.md).
         disp = np.linalg.norm(got["position"] - src, axis=1)
-        ledger_ok = bool(
-            np.allclose(got["track_length"], disp, atol=2e-3)
-        )
+        ledger_tol = 2e-3 * max(1.0, cells / 55.0)
+        ledger_err = np.abs(got["track_length"] - disp)
+        max_ledger_err = float(ledger_err.max())
+        # NaN-safe: a NaN position/ledger must FAIL the check (a plain
+        # `err > tol` comparison is False for NaN and would pass it).
+        n_ledger_bad = int((~(ledger_err <= ledger_tol)).sum())
+        ledger_ok = n_ledger_bad == 0
         dropped = int(np.asarray(res.n_dropped).sum())
         done = bool(got["done"].all())
 
@@ -197,6 +207,8 @@ def run_rehearsal(
                 n_dropped=dropped,
                 all_done=done,
                 ledger_ok=ledger_ok,
+                max_ledger_err=max_ledger_err,
+                n_ledger_bad=n_ledger_bad,
                 absorption_rate={str(k): v for k, v in burn_out.items()},
                 densities={str(k): density[k] for k in density},
                 total_flux=float(g_flux[..., 0].sum()),
