@@ -678,3 +678,62 @@ def test_partitioned_record_xpoints_matches_single_chip(box, halo):
         g_flux, np.asarray(ref.flux), rtol=0, atol=1e-12
     )
     assert np.asarray(ref.n_xpoints).max() >= 2  # scenario non-trivial
+
+
+def test_ledger_exact_in_f64_under_wrong_parent_relocation():
+    """The conservation-ledger f32 drift discriminator, pinned (round 5).
+
+    Sources deliberately start OUTSIDE their claimed parent element
+    (~2 element sizes off), forcing long relocation chases that cross
+    partition cuts before scoring begins. In f64 the migrated ledger
+    must equal |final - source| within the walk's GEOMETRIC tolerance
+    envelope (the escalated bump's unscored forward nudges are capped
+    at tolerance=1e-8 per bumped crossing — measured max 4.5e-8 here,
+    8 of 2048 lanes): any real cut-boundary double/missed scoring is a
+    whole segment (~1e-2), while the known f32 drift (up to ~2.4e-3 at
+    119 cells, BENCHMARKS.md 'Ledger f32 envelope at scale') is
+    accumulation rounding. 1e-6 splits the three regimes cleanly."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    if not jax.config.jax_enable_x64:
+        pytest.skip("f64 oracle needs x64")
+    dtype = jnp.float64
+    mesh = build_box(1.0, 1.0, 1.0, 10, 10, 10, dtype=dtype)
+    part = partition_mesh(mesh, 8, halo_layers=1)
+    dmesh = make_device_mesh(8)
+    n, n_groups = 2048, 2
+    rng = np.random.default_rng(11)
+    cen = np.asarray(mesh.centroids())
+    elem = rng.integers(0, mesh.ntet, n).astype(np.int32)
+    src = np.clip(cen[elem] + rng.normal(0, 0.2, (n, 3)), 0.002, 0.998)
+    u = rng.normal(size=(n, 3))
+    u /= np.linalg.norm(u, axis=1, keepdims=True)
+    dest = src + u * rng.exponential(0.4, (n, 1))
+    step = make_partitioned_step(
+        dmesh, part, n_groups=n_groups, max_crossings=mesh.ntet + 64,
+        tolerance=1e-8,
+    )
+    placed = distribute_particles(
+        part, dmesh, elem,
+        dict(
+            origin=src, dest=dest, weight=np.ones(n),
+            group=rng.integers(0, n_groups, n).astype(np.int32),
+            material_id=np.full(n, -1, np.int32),
+        ),
+    )
+    flux = jax.device_put(
+        jnp.zeros((8, part.max_local * n_groups * 2), dtype),
+        NamedSharding(dmesh, P("p")),
+    )
+    res = step(
+        placed["origin"].astype(dtype), placed["dest"].astype(dtype),
+        placed["elem"], jnp.zeros_like(placed["valid"]),
+        placed["material_id"], placed["weight"].astype(dtype),
+        placed["group"], placed["particle_id"], placed["valid"], flux,
+    )
+    got = collect_by_particle_id(res, n)
+    assert got["done"].all()
+    assert int(np.asarray(res.n_dropped).sum()) == 0
+    disp = np.linalg.norm(got["position"] - src, axis=1)
+    np.testing.assert_allclose(got["track_length"], disp, atol=1e-6)

@@ -283,3 +283,33 @@ def test_64_group_flat_keys(setup):
         np.asarray(blind.flux)[..., 0].sum(axis=1),
         rtol=1e-6, atol=1e-6,
     )
+
+
+def test_resolve_tally_scatter_uses_array_device():
+    """ADVICE r4: 'auto' must resolve per call against the array that
+    will run the walk, outside the jit cache key — the literal string
+    frozen at first trace mispicks when backends differ."""
+    from pumiumtally_tpu.ops.walk import resolve_tally_scatter
+
+    assert resolve_tally_scatter("pair") == "pair"
+    assert resolve_tally_scatter("interleaved") == "interleaved"
+    # Explicit platform overrides everything.
+    assert resolve_tally_scatter("auto", platform="tpu") == "interleaved"
+    assert resolve_tally_scatter("auto", platform="cpu") == "pair"
+    # The ARRAY's device wins over the default backend: a stub whose
+    # devices() reports a TPU platform must resolve to interleaved even
+    # though this process's default backend is CPU — this is the
+    # regression the fix exists for (the old code always consulted
+    # jax.default_backend()).
+    class _TpuDev:
+        platform = "tpu"
+
+    class _TpuArray:
+        def devices(self):
+            return {_TpuDev()}
+
+    assert resolve_tally_scatter("auto", _TpuArray()) == "interleaved"
+    # A JAX CPU array resolves to the CPU choice.
+    assert resolve_tally_scatter("auto", jnp.zeros(4)) == "pair"
+    # numpy input falls back to the default backend (CPU here).
+    assert resolve_tally_scatter("auto", np.zeros(4)) == "pair"
