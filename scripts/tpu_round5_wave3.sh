@@ -38,5 +38,7 @@ run bench_w3_plan env BENCH_STAGES=plan BENCH_EVENT=0 BENCH_PROBE=0 \
     BENCH_REPEAT=2 python bench.py
 run bench_w3_64g_batch env BENCH_GROUPS=64 BENCH_SD=batch BENCH_EVENT=0 \
     BENCH_PROBE=0 python bench.py
-run probe_pallas_w3 python scripts/probe_pallas_gather.py
+# Lowest-priority row, tightly bounded: the probe is TPU-only (Mosaic
+# lowering checks) and must not eat the window if the stack wedges.
+CAPTURE_TIMEOUT=900 run probe_pallas_w3 python scripts/probe_pallas_gather.py
 echo "=== wave3 rows complete ==="
