@@ -38,6 +38,12 @@ run bench_w3_plan env BENCH_STAGES=plan BENCH_EVENT=0 BENCH_PROBE=0 \
     BENCH_REPEAT=2 python bench.py
 run bench_w3_64g_batch env BENCH_GROUPS=64 BENCH_SD=batch BENCH_EVENT=0 \
     BENCH_PROBE=0 python bench.py
+# In-window r2-schedule control (VERDICT r4 item 1): the round-2
+# headline's own configuration (3-stage schedule). If it reads ~4.8
+# again while the dense headline reads ~7.6 IN THE SAME WINDOW, the
+# 8.53-era gap is proven to be tunnel-epoch drift, not code.
+run bench_w3_r2ctrl env BENCH_STAGES="16:524288,24:262144,40:131072" \
+    BENCH_EVENT=0 BENCH_PROBE=0 BENCH_REPEAT=2 python bench.py
 # Lowest-priority row, tightly bounded: the probe is TPU-only (Mosaic
 # lowering checks) and must not eat the window if the stack wedges.
 CAPTURE_TIMEOUT=900 run probe_pallas_w3 python scripts/probe_pallas_gather.py
