@@ -578,6 +578,32 @@ def main() -> None:
                                 "(cross-epoch tunnel drift — never "
                                 "compare across epochs)."
                             ),
+                            # What this round DID prove without the
+                            # chip, so the record stands alone.
+                            "round5_evidence": {
+                                "tests": "TESTS_r05.json (164 passed)",
+                                "partitioned_1m": (
+                                    "PARTITIONED_1M_r05.json (exact "
+                                    "parity, 3 rounds, warm timings)"
+                                ),
+                                "phase_profile": (
+                                    "PARTITIONED_PROFILE_r05.json"
+                                ),
+                                "depletion_10m_64g": (
+                                    "PARTITIONED_DEPLETION_10M_r05.json "
+                                    "(ok=true)"
+                                ),
+                                "staged_captures": (
+                                    "scripts/tpu_round5_capture.sh = "
+                                    "wave2 (flat-layout headline, 64g, "
+                                    "3-D A/B, 2M, ladder, 10M, event) "
+                                    "+ wave3 (sd batch/none, planner "
+                                    "vs dense, 64g batch, r2-schedule "
+                                    "epoch control, pallas probe) — "
+                                    "armed on the tunnel watcher all "
+                                    "round"
+                                ),
+                            },
                         },
                     }
                 )
