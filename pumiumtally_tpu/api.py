@@ -141,6 +141,7 @@ class PumiTally:
             )
             self.iter_count = 0
             self.total_segments = 0
+            self._replanned = cfg.compact_stages != "adaptive"
             self._initialized = False
             # Host-order permutation: device slot i holds particle
             # _perm[i]; None while the layout is still identity.
@@ -244,6 +245,31 @@ class PumiTally:
             if self.config.measure_time:
                 timer.sync(self.state)
 
+    def _maybe_replan(self, n_segments: int, n_moving: int) -> None:
+        """compact_stages="adaptive": after the FIRST move, re-plan the
+        compaction ladder from the MEASURED crossings/move instead of
+        the mesh-density estimate, which cannot see the move-length
+        statistics. A mover scores crossings+1 segments (the final
+        destination-reach iteration scores too, walk.py), so mean
+        crossings = segments/moving − 1. Later moves reuse the
+        re-planned schedule (one extra trace compile total); results
+        are identical up to fp summation order (schedules group the
+        scatter adds differently — observed ~1e-15 in f64)."""
+        if self._replanned or n_moving == 0:
+            return
+        self._replanned = True
+        if self.num_particles < 1024:
+            # Same policy as resolve_compact_stages/resolve_compaction:
+            # tiny batches stay on the flat loop.
+            return
+        from .utils.ladder import plan_stages
+
+        mean = max(n_segments / n_moving - 1.0, 0.25)
+        planned = plan_stages(
+            self.num_particles, mean, unroll=self.config.unroll
+        )
+        self._compact_stages = planned or None
+
     # ------------------------------------------------------------------ #
     def move_to_next_location(
         self,
@@ -286,6 +312,14 @@ class PumiTally:
             )
             in_flight = jnp.asarray(
                 self._gather_in(flying_flat[:n]) != 0
+            )
+            # Host-side mover count for the one-shot adaptive replan —
+            # counted here (before the flags are zeroed) and only while
+            # a replan is still pending, so the hot path pays nothing.
+            n_moving_h = (
+                int((flying_flat[:n] != 0).sum())
+                if not self._replanned
+                else 0
             )
             weight = jnp.asarray(self._gather_in(weights_h), dtype=cfg.dtype)
             group = jnp.asarray(self._gather_in(groups_h), dtype=jnp.int32)
@@ -349,7 +383,9 @@ class PumiTally:
                 dest_flat[: n * 3].reshape(n, 3)[self._perm] = final_pos
                 mats_flat[:n][self._perm] = final_mats
             flying_flat[:n] = 0
-            self.total_segments += int(result.n_segments)
+            segs = int(result.n_segments)
+            self.total_segments += segs
+            self._maybe_replan(segs, n_moving_h)
             self._store_xpoints(result)
             self._warn_if_truncated(result.done)
 

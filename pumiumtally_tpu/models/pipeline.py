@@ -69,6 +69,12 @@ class StreamingTallyPipeline:
     ):
         self.mesh = mesh
         self.config = config or TallyConfig()
+        if self.config.compact_stages == "adaptive":
+            raise NotImplementedError(
+                "compact_stages='adaptive' replans via PumiTally's "
+                "post-move hook; the pipeline resolves its schedule "
+                "once — use 'plan' or an explicit schedule"
+            )
         if self.config.sd_mode != "segment":
             raise NotImplementedError(
                 "StreamingTallyPipeline supports sd_mode='segment' only "

@@ -72,6 +72,12 @@ class PartitionedTally:
         self.mesh = mesh
         self.num_particles = int(num_particles)
         self.config = config if config is not None else TallyConfig()
+        if self.config.compact_stages == "adaptive":
+            raise NotImplementedError(
+                "compact_stages='adaptive' replans via PumiTally's "
+                "post-move hook, which PartitionedTally does not have; "
+                "use 'plan' (density-estimated) or an explicit schedule"
+            )
         if self.config.sd_mode not in ("segment", "batch"):
             raise ValueError(
                 f"sd_mode must be 'segment' or 'batch': "

@@ -52,9 +52,13 @@ class TallyConfig:
         ``"auto"`` for the slot-planned dense ladder — the best known
         schedule for walks with ~10-20 crossings per move
         (scripts/plan_ladder.py; BENCHMARKS.md "Slot-exact ladder
-        planning"). CAUTION: per-stage unroll >= 16 on a sparse (< 6
-        stage) schedule measured ~35x SLOWER on TPU (round-4 grid);
-        the walk warns when it sees that shape.
+        planning"); ``"plan"`` for the executional planner
+        (utils/ladder.plan_stages) at a mesh-density-estimated mean;
+        ``"adaptive"`` (PumiTally only) to re-plan once from the
+        MEASURED crossings/move after the first move. CAUTION:
+        per-stage unroll >= 16 on a sparse (< 6 stage) schedule
+        measured ~35x SLOWER on TPU (round-4 grid); the walk warns
+        when it sees that shape.
       unroll: boundary crossings advanced per while-loop iteration
         (ops/walk.py). The TPU while_loop is dispatch-bound, so unrolling
         the body ~2x's throughput (scripts/sweep_unroll.py); done lanes
@@ -197,18 +201,27 @@ class TallyConfig:
                     (int(round(start * scale)), *rest)
                     for start, *rest in dense_ladder(n_particles)
                 )
-            if self.compact_stages == "plan":
+            if self.compact_stages in ("plan", "adaptive"):
                 from .ladder import plan_stages
 
                 # 14.9 = measured mean crossings/move at the bench
                 # workload (55-cell unit box, mean_path 0.08).
+                # "adaptive" starts from the same density estimate; the
+                # PumiTally facade then RE-plans from the measured
+                # crossings/move after the first move (_maybe_replan) —
+                # the move-length statistics the density estimate
+                # cannot see. One extra trace compile; results
+                # identical up to fp summation order. Only PumiTally
+                # replans — the other facades REJECT "adaptive" rather
+                # than silently degrading to the static plan.
                 return plan_stages(
                     n_particles, 14.9 * density, unroll=self.unroll
                 ) or None
             raise ValueError(
                 "unknown compact_stages string "
-                f"{self.compact_stages!r}; expected 'auto', 'plan' or "
-                "an explicit ((start, size[, unroll]), ...) schedule"
+                f"{self.compact_stages!r}; expected 'auto', 'plan', "
+                "'adaptive' or an explicit "
+                "((start, size[, unroll]), ...) schedule"
             )
         return tuple(
             (int(start), min(max(int(size), 1), n_particles),
