@@ -52,6 +52,8 @@ def run(
     flat_flux: bool = True,
     sd_mode: str = "segment",
 ) -> dict:
+    import contextlib
+
     import jax  # noqa: F401 — must import before the backend pin
 
     from pumiumtally_tpu.utils.platform import maybe_force_cpu
@@ -62,7 +64,25 @@ def run(
 
     from pumiumtally_tpu import build_box, make_flux
     from pumiumtally_tpu.core.tally import accumulate_batch_squares
+    from pumiumtally_tpu.obs import (
+        WALK_STATS_LEN,
+        reduce_chip_stats,
+        stats_to_dict,
+    )
     from pumiumtally_tpu.ops.walk import resolve_tally_scatter, trace_impl
+    from pumiumtally_tpu.utils.profiling import (
+        annotate,
+        device_memory_stats,
+        profile_trace,
+    )
+
+    # BENCH_TRACE=/path captures an xprof trace of the whole measured
+    # section; the annotate() spans below (and the facade-phase spans in
+    # api.py) show up as named host tracks in the viewer.
+    trace_dir = os.environ.get("BENCH_TRACE")
+    trace_cm = (
+        profile_trace(trace_dir) if trace_dir else contextlib.nullcontext()
+    )
 
     # Resolve 'auto' here (post backend pin) so the detail record names
     # the concrete strategy that actually ran, not the literal 'auto'.
@@ -145,7 +165,10 @@ def run(
             ledger=ledger,
             n_groups=n_groups,
         )
-        return r.position, r.elem, r.flux, r.n_segments, r.n_crossings
+        return (
+            r.position, r.elem, r.flux, r.n_segments, r.n_crossings,
+            r.stats,
+        )
 
     step = functools.partial(jax.jit, donate_argnums=(1, 2, 3))(one_step)
 
@@ -160,13 +183,20 @@ def run(
         import jax.lax as lax
 
         def body(i, c):
-            origin, elem, flux, prev_even, tot, _ = c
-            pos, el, fl, nseg, ncross = one_step(keys[i], origin, elem, flux)
+            origin, elem, flux, prev_even, tot, _, slog = c
+            pos, el, fl, nseg, ncross, sv = one_step(
+                keys[i], origin, elem, flux
+            )
             if sd_mode == "batch":
                 # ONE definition of the fold (jit-in-jit inlines), so
                 # the benchmark measures exactly the production math.
                 fl, prev_even = accumulate_batch_squares(fl, prev_even)
-            return pos, el, fl, prev_even, tot + nseg, ncross
+            # Per-move telemetry log: one [8] row per step, read back
+            # once after the timed window (no readback inside the loop).
+            slog = lax.dynamic_update_slice(
+                slog, sv.astype(slog.dtype)[None], (i, 0)
+            )
+            return pos, el, fl, prev_even, tot + nseg, ncross, slog
 
         nseg_dtype = (
             jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
@@ -174,12 +204,13 @@ def run(
         prev0 = jnp.zeros(
             flux.size // 2 if sd_mode == "batch" else 0, dtype
         )
+        slog0 = jnp.zeros((keys.shape[0], WALK_STATS_LEN), nseg_dtype)
         out = lax.fori_loop(
             0, keys.shape[0], body,
             (origin, elem, flux, prev0, jnp.zeros((), nseg_dtype),
-             jnp.int32(0)),
+             jnp.int32(0), slog0),
         )
-        return out[0], out[1], out[2], out[4], out[5]
+        return out[0], out[1], out[2], out[4], out[5], out[6]
 
     key = jax.random.key(seed)
     keys = jax.random.split(key, steps + 2)
@@ -199,15 +230,21 @@ def run(
         jax.block_until_ready((w_origin, w_elem, w_flux))
         return w_origin, w_elem, w_flux
 
+    # The xprof capture (when BENCH_TRACE is set) brackets compile +
+    # every measurement window; closed right after the windows so the
+    # event-loop section below stays out of the trace.
+    _trace_stack = contextlib.ExitStack()
+    _trace_stack.enter_context(trace_cm)
     if fused:
         # Warmup/compile with a 1-step fused program shape? No — the
         # fused program's shape depends on `steps`, so warm the REAL
         # shape once (its result is discarded) and time the second call.
         t0 = time.perf_counter()
-        pos, elem_c, flux, tot, ncross = run_fused(
-            keys[2:], origin, elem, flux
-        )
-        int(np.asarray(tot))
+        with annotate("bench:compile"):
+            pos, elem_c, flux, tot, ncross, slog = run_fused(
+                keys[2:], origin, elem, flux
+            )
+            int(np.asarray(tot))
         compile_s = time.perf_counter() - t0
         # Repeated measurement windows on the SAME compiled program AND
         # the same initial state (restaged per window, outside the
@@ -216,47 +253,72 @@ def run(
         # window — the closest observable to uncontended device
         # capability. Every window is recorded in detail.windows.
         windows = []
-        for _ in range(repeats):
+        for w_i in range(repeats):
             w_origin, w_elem, w_flux = fresh_state()
-            t0 = time.perf_counter()
-            pos, elem_c, flux, tot, ncross = run_fused(
-                keys[2:], w_origin, w_elem, w_flux
-            )
-            wseg = int(np.asarray(tot))
-            windows.append((wseg, time.perf_counter() - t0))
+            with annotate(f"bench:window{w_i}"):
+                t0 = time.perf_counter()
+                pos, elem_c, flux, tot, ncross, slog = run_fused(
+                    keys[2:], w_origin, w_elem, w_flux
+                )
+                wseg = int(np.asarray(tot))
+                windows.append((wseg, time.perf_counter() - t0))
+        # Per-move stats from the last window (identical workload every
+        # window), fetched AFTER the clock stopped.
+        stats_rows = np.asarray(slog)
     else:
         # Warmup / compile.
         t0 = time.perf_counter()
-        pos, elem_c, flux, nseg, _ = step(keys[0], origin, elem, flux)
-        jax.block_until_ready(pos)
+        with annotate("bench:compile"):
+            pos, elem_c, flux, nseg, _, sv = step(
+                keys[0], origin, elem, flux
+            )
+            jax.block_until_ready(pos)
         compile_s = time.perf_counter() - t0
-        pos, elem_c, flux, nseg, _ = step(keys[1], pos, elem_c, flux)
+        pos, elem_c, flux, nseg, _, sv = step(keys[1], pos, elem_c, flux)
         jax.block_until_ready(pos)
 
         windows = []
-        for _ in range(repeats):
+        for w_i in range(repeats):
             pos, elem_c, flux = fresh_state()
             prev_even = jnp.zeros(flux.size // 2, dtype)
             total_segments = 0
-            t0 = time.perf_counter()
-            for i in range(steps):
-                pos, elem_c, flux, nseg, ncross = step(
-                    keys[2 + i], pos, elem_c, flux
-                )
-                if sd_mode == "batch":
-                    flux, prev_even = accumulate_batch_squares(
-                        flux, prev_even
+            step_stats = []
+            with annotate(f"bench:window{w_i}"):
+                t0 = time.perf_counter()
+                for i in range(steps):
+                    pos, elem_c, flux, nseg, ncross, sv = step(
+                        keys[2 + i], pos, elem_c, flux
                     )
-                total_segments += nseg  # device-side accumulate; read at end
-            # Host readback of a value depending on every step — a
-            # stricter fence than block_until_ready on one output buffer
-            # (which proved unreliable under the remote-TPU runtime; see
-            # scripts/sweep_unroll.py).
-            total_segments = int(np.asarray(total_segments))
-            windows.append((total_segments, time.perf_counter() - t0))
+                    if sd_mode == "batch":
+                        flux, prev_even = accumulate_batch_squares(
+                            flux, prev_even
+                        )
+                    total_segments += nseg  # device-side; read at end
+                    step_stats.append(sv)  # device arrays — no readback
+                # Host readback of a value depending on every step — a
+                # stricter fence than block_until_ready on one output
+                # buffer (which proved unreliable under the remote-TPU
+                # runtime; see scripts/sweep_unroll.py).
+                total_segments = int(np.asarray(total_segments))
+                windows.append(
+                    (total_segments, time.perf_counter() - t0)
+                )
+        stats_rows = np.stack([np.asarray(s) for s in step_stats])
+    _trace_stack.close()
 
     total_segments, elapsed = max(windows, key=lambda w: w[0] / w[1])
     segments_per_sec = total_segments / elapsed
+
+    # ---- telemetry block (acceptance: per-move depth in BENCH JSON) ----
+    # Aggregation via the ONE schema-aware reducer (obs.walk_stats
+    # reduce_chip_stats — sums everywhere, max of max_crossings, derived
+    # occupancy), so the bench totals and the facade telemetry cannot
+    # drift when the stats schema grows.
+    telemetry = {
+        "per_move": [stats_to_dict(row) for row in stats_rows],
+        "totals": reduce_chip_stats(stats_rows),
+        "device_memory": device_memory_stats(),
+    }
 
     # ---- event-loop benchmark (reference §3.3 per-event pattern) -------
     # Drives PumiTally.move_to_next_location with per-event HOST arrays:
@@ -287,6 +349,12 @@ def run(
         "value": round(segments_per_sec, 1),
         "unit": "segments/s",
         "vs_baseline": round(segments_per_sec / per_chip_baseline, 4),
+        # Per-move walk depth (obs/walk_stats.py schema): crossings,
+        # max crossings/particle, chase hops, truncations, compaction
+        # occupancy, segments, loop iters — one row per step of the
+        # measured window, folded on device (schema documented in
+        # BENCHMARKS.md "Telemetry block").
+        "telemetry": telemetry,
         "detail": {
             "ntet": mesh.ntet,
             "n_particles": n_particles,

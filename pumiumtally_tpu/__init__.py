@@ -22,6 +22,7 @@ from .mesh.core import TetMesh
 from .mesh.io import load_mesh, save_npz
 from .models.pipeline import StreamingTallyPipeline
 from .models.transport import Material, SyntheticTransport
+from .obs import FlightRecorder, MetricsRegistry
 from .ops.walk import trace, TraceResult
 from .utils.config import TallyConfig
 from .utils.timing import TallyTimes
@@ -47,6 +48,8 @@ __all__ = [
     "StreamingTallyPipeline",
     "Material",
     "SyntheticTransport",
+    "MetricsRegistry",
+    "FlightRecorder",
     "trace",
     "TraceResult",
     "TallyConfig",

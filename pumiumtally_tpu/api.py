@@ -46,8 +46,10 @@ from .core.tally import (
 )
 from .io.vtk import write_flux_vtk
 from .mesh.core import TetMesh
+from .obs import TallyTelemetry, stats_to_dict
 from .ops.walk import trace
 from .utils.config import TallyConfig
+from .utils.profiling import annotate
 from .utils.timing import TallyTimes, phase_timer
 
 
@@ -100,6 +102,9 @@ class PumiTally:
         self.config = config or TallyConfig()
         cfg = self.config
         self.tally_times = TallyTimes()
+        # Per-tally telemetry (obs/): a private registry + flight
+        # recorder; every trace folds its on-device stats vector here.
+        self._telemetry = TallyTelemetry("PumiTally")
         with phase_timer(
             self.tally_times, "initialization_time", True
         ) as timer:
@@ -148,6 +153,9 @@ class PumiTally:
             self._perm: np.ndarray | None = None
             self._last_xpoints: tuple | None = None
             timer.sync((self.state, self.flux))
+        # Phase-boundary memory sample (HBM peaks where the backend
+        # reports them — construction allocated the mesh tables + flux).
+        self._telemetry.record_memory("initialization")
 
     # ------------------------------------------------------------------ #
     def _trace(self, *args, **kwargs):
@@ -175,8 +183,23 @@ class PumiTally:
         if self.config.checkify_invariants and not np.isfinite(arr).all():
             raise ValueError(f"{name} contains non-finite values")
 
-    def _warn_if_truncated(self, done) -> None:
-        n_lost = int(np.sum(~np.asarray(done)))
+    def _read_stats(self, result) -> dict | None:
+        """Host view of the on-device stats vector: ONE small fetch per
+        move carrying the whole flight-recorder record (crossings,
+        truncations, occupancy, segments — obs/walk_stats.py). None when
+        walk_stats is off."""
+        if result.stats is None:
+            return None
+        return stats_to_dict(result.stats)
+
+    def _n_truncated(self, result, stats_d: dict | None) -> int:
+        """Truncation count from the stats vector; host-scan fallback
+        (the pre-telemetry path) only when walk_stats is off."""
+        if stats_d is not None:
+            return stats_d["truncated"]
+        return int(np.sum(~np.asarray(result.done)))
+
+    def _warn_if_truncated(self, n_lost: int) -> None:
         if n_lost:
             warnings.warn(
                 f"{n_lost} particle walk(s) truncated at max_crossings="
@@ -204,7 +227,8 @@ class PumiTally:
             f"expected {self.num_particles * 3} coordinates, got {size}"
         )
         self._check_finite("init_particle_positions", pos)
-        with phase_timer(
+        t_before = self.tally_times.initialization_time
+        with annotate("PumiTally.initialize_particle_location"), phase_timer(
             self.tally_times, "initialization_time", True
         ) as timer:
             dest_h = self._gather_in(pos[:size].reshape(-1, 3))
@@ -232,6 +256,7 @@ class PumiTally:
                 tally_scatter=self.config.tally_scatter,
                 gathers=self.config.gathers,
                 ledger=self.config.ledger,
+                stats=self.config.walk_stats,
                 record_xpoints=self.config.record_xpoints,
                 n_groups=self.config.n_groups,
             )
@@ -241,9 +266,17 @@ class PumiTally:
             )
             self._store_xpoints(result)
             self._initialized = True
-            self._warn_if_truncated(result.done)
+            stats_d = self._read_stats(result)
+            self._warn_if_truncated(self._n_truncated(result, stats_d))
             if self.config.measure_time:
                 timer.sync(self.state)
+        self._telemetry.record_walk(
+            "initial_search",
+            0,
+            stats_d,
+            seconds=self.tally_times.initialization_time - t_before,
+            synced=self.config.measure_time,
+        )
 
     def _maybe_replan(self, n_segments: int, n_moving: int) -> None:
         """compact_stages="adaptive": after the FIRST move, re-plan the
@@ -302,7 +335,8 @@ class PumiTally:
         self._check_finite("particle_destinations", dest_flat)
         self._check_finite("weights", weights_h)
 
-        with phase_timer(
+        t_before = self.tally_times.total_time_to_tally
+        with annotate("PumiTally.move_to_next_location"), phase_timer(
             self.tally_times, "total_time_to_tally", True
         ) as timer:
             s = self.state
@@ -351,6 +385,7 @@ class PumiTally:
                 tally_scatter=cfg.tally_scatter,
                 gathers=cfg.gathers,
                 ledger=cfg.ledger,
+                stats=cfg.walk_stats,
                 record_xpoints=cfg.record_xpoints,
                 n_groups=cfg.n_groups,
             )
@@ -383,11 +418,18 @@ class PumiTally:
                 dest_flat[: n * 3].reshape(n, 3)[self._perm] = final_pos
                 mats_flat[:n][self._perm] = final_mats
             flying_flat[:n] = 0
-            segs = int(result.n_segments)
+            # ONE stats-vector fetch carries segments + truncations +
+            # crossings (the pre-telemetry path read n_segments AND
+            # host-scanned the whole done array here).
+            stats_d = self._read_stats(result)
+            segs = (
+                stats_d["segments"] if stats_d is not None
+                else int(result.n_segments)
+            )
             self.total_segments += segs
             self._maybe_replan(segs, n_moving_h)
             self._store_xpoints(result)
-            self._warn_if_truncated(result.done)
+            self._warn_if_truncated(self._n_truncated(result, stats_d))
 
             # Periodic locality sort (the migrate-every-100 analog,
             # cpp:256-258).
@@ -402,6 +444,14 @@ class PumiTally:
                 self._perm = np.asarray(self.state.particle_id)
             if cfg.measure_time:
                 timer.sync(self.state)
+        self.tally_times.n_moves += 1
+        self._telemetry.record_walk(
+            "move",
+            self.iter_count,
+            stats_d,
+            seconds=self.tally_times.total_time_to_tally - t_before,
+            synced=cfg.measure_time,
+        )
 
     # ------------------------------------------------------------------ #
     def _store_xpoints(self, result) -> None:
@@ -481,13 +531,29 @@ class PumiTally:
     def write_pumi_tally_mesh(self, filename: str | None = None) -> str:
         """Normalize flux, attach per-group cell fields + volume, write VTK
         (finalizeAndWritePumiFlux, cpp:685-705), print phase times."""
-        with phase_timer(
+        with annotate("PumiTally.write_pumi_tally_mesh"), phase_timer(
             self.tally_times, "vtk_file_write_time", True
         ):
             out = filename or self.config.output_filename
             write_flux_vtk(out, self.mesh, self.normalized_flux())
+        self._telemetry.record_memory("vtk_write")
         self.tally_times.print_times()
         return out
+
+    # ------------------------------------------------------------------ #
+    def telemetry(self) -> dict:
+        """Run-wide telemetry snapshot (obs/): counter totals
+        (segments/crossings/truncations/chase hops), the per-move flight
+        records, phase times (TallyTimes), a fresh per-device memory
+        sample, and the full metrics-registry snapshot. Per-record JSONL
+        streaming: set ``PUMI_TPU_METRICS=jsonl:/path``."""
+        return self._telemetry.snapshot(times=self.tally_times)
+
+    @property
+    def metrics(self):
+        """This tally's MetricsRegistry (Prometheus text via
+        ``tally.metrics.render_prometheus()``)."""
+        return self._telemetry.registry
 
     # ------------------------------------------------------------------ #
     def save_checkpoint(self, filename: str) -> None:

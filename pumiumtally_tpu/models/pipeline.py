@@ -35,7 +35,11 @@ class BatchResult(NamedTuple):
 
     xpoints/n_xpoints carry the per-particle boundary-crossing points
     when the config sets record_xpoints=K (None otherwise — the surface
-    is config-uniform with PumiTally.intersection_points)."""
+    is config-uniform with PumiTally.intersection_points).
+
+    stats is the named per-move telemetry dict (obs/walk_stats.py) when
+    the config keeps walk_stats on; all_done then derives from its
+    on-device truncation counter instead of a host scan of done."""
 
     index: int
     position: np.ndarray
@@ -45,6 +49,7 @@ class BatchResult(NamedTuple):
     all_done: bool
     xpoints: np.ndarray | None = None
     n_xpoints: np.ndarray | None = None
+    stats: dict | None = None
 
 
 class StreamingTallyPipeline:
@@ -143,6 +148,7 @@ class StreamingTallyPipeline:
             tally_scatter=cfg.tally_scatter,
             gathers=cfg.gathers,
             ledger=cfg.ledger,
+            stats=cfg.walk_stats,
             record_xpoints=cfg.record_xpoints,
             n_groups=cfg.n_groups,
         )
@@ -157,6 +163,14 @@ class StreamingTallyPipeline:
     def _drain_one(self) -> None:
         idx, r = self._inflight.popleft()
         if self.want_outputs:
+            if r.stats is not None:
+                from ..obs import stats_to_dict
+
+                stats = stats_to_dict(r.stats)
+                all_done = stats["truncated"] == 0
+            else:
+                stats = None
+                all_done = bool(np.asarray(r.done).all())
             self._results.append(
                 BatchResult(
                     index=idx,
@@ -164,7 +178,7 @@ class StreamingTallyPipeline:
                     elem=np.asarray(r.elem),
                     material_id=np.asarray(r.material_id),
                     n_segments=int(r.n_segments),
-                    all_done=bool(np.asarray(r.done).all()),
+                    all_done=all_done,
                     xpoints=(
                         None if r.xpoints is None else np.asarray(r.xpoints)
                     ),
@@ -173,6 +187,7 @@ class StreamingTallyPipeline:
                         if r.n_xpoints is None
                         else np.asarray(r.n_xpoints)
                     ),
+                    stats=stats,
                 )
             )
 

@@ -1,0 +1,47 @@
+"""Run-wide telemetry subsystem.
+
+Three layers (see each module's docstring):
+  * ``walk_stats`` — schema of the on-device per-move stats vector the
+    walk kernels fold into their jitted programs (one vector readback
+    per move replaces host-side scans);
+  * ``registry`` — labeled counters/gauges/histograms with snapshot(),
+    Prometheus text exposition, and JSONL emission;
+  * ``recorder`` / ``telemetry`` — the per-move flight recorder and the
+    facade helper that feeds it (``PumiTally.telemetry()``,
+    ``PartitionedTally.telemetry()``).
+
+Env knobs: ``PUMI_TPU_METRICS=jsonl:/path`` streams every flight record
+to that file; ``PUMI_TPU_LOG_JSON=1`` renders the debug-level copies the
+recorder sends through the standard logger as JSON.
+"""
+from .recorder import FlightRecorder
+from .registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    default_registry,
+)
+from .telemetry import TallyTelemetry
+from .walk_stats import (
+    IDX,
+    WALK_STATS_FIELDS,
+    WALK_STATS_LEN,
+    reduce_chip_stats,
+    stats_to_dict,
+)
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "default_registry",
+    "FlightRecorder",
+    "TallyTelemetry",
+    "WALK_STATS_FIELDS",
+    "WALK_STATS_LEN",
+    "IDX",
+    "stats_to_dict",
+    "reduce_chip_stats",
+]

@@ -1,0 +1,53 @@
+"""Per-move flight recorder: a bounded in-memory trail of structured
+records plus optional JSONL emission.
+
+Every facade move appends one record (walk stats, phase seconds,
+migration counts); the recorder keeps the last ``capacity`` in a ring
+buffer for ``telemetry()`` and, when ``PUMI_TPU_METRICS=jsonl:/path`` is
+set, streams each record to that file through the same JSON machinery as
+``PUMI_TPU_LOG_JSON`` (utils/log.emit_metric) — so a crashed run leaves
+its whole per-move history on disk, not just whatever the ring held.
+"""
+from __future__ import annotations
+
+import collections
+
+from ..utils.log import emit_metric
+
+
+class FlightRecorder:
+    def __init__(self, capacity: int = 512, sink: str | None = None):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self._records: collections.deque = collections.deque(
+            maxlen=capacity
+        )
+        self._seq = 0
+        # None defers to PUMI_TPU_METRICS at record time (env can change
+        # between moves, e.g. under pytest monkeypatch).
+        self._sink = sink
+
+    def record(self, kind: str, **fields) -> dict:
+        """Append one record; ``kind`` names the event ("move",
+        "initial_search", "memory", ...). Returns the stored record."""
+        rec = {"seq": self._seq, "kind": str(kind), **fields}
+        self._seq += 1
+        self._records.append(rec)
+        emit_metric(rec, path=self._sink)
+        return rec
+
+    def records(self) -> list[dict]:
+        return list(self._records)
+
+    def tail(self, n: int) -> list[dict]:
+        if n <= 0:
+            return []
+        return list(self._records)[-n:]
+
+    def __len__(self) -> int:
+        return len(self._records)
+
+    @property
+    def total_recorded(self) -> int:
+        """Records ever appended (>= len() once the ring wraps)."""
+        return self._seq

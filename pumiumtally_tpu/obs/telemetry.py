@@ -1,0 +1,135 @@
+"""Facade-level telemetry: one registry + one flight recorder per tally.
+
+Shared by PumiTally and PartitionedTally so the two facades cannot drift
+on metric names or record schemas. The facade calls:
+
+  * ``record_walk(kind, move, stats, seconds=..., **extra)`` once per
+    trace (initial search or move) with the host view of the on-device
+    stats vector (obs.walk_stats.stats_to_dict / reduce_chip_stats);
+  * ``record_memory(phase)`` at phase boundaries (construction, VTK
+    write) to capture per-device HBM peaks;
+  * ``snapshot(times=...)`` from ``tally.telemetry()``.
+
+Metric families (private registry per tally by default, so concurrent
+tallies don't interleave):
+  pumi_moves_total, pumi_segments_total, pumi_crossings_total,
+  pumi_truncated_walks_total, pumi_chase_hops_total,
+  pumi_migration_rounds_total, pumi_compaction_occupancy,
+  pumi_move_seconds, pumi_device_peak_bytes{device=...}
+"""
+from __future__ import annotations
+
+import dataclasses
+
+from ..utils.profiling import device_memory_stats
+from .recorder import FlightRecorder
+from .registry import MetricsRegistry
+
+
+class TallyTelemetry:
+    def __init__(
+        self,
+        facade: str,
+        registry: MetricsRegistry | None = None,
+        recorder: FlightRecorder | None = None,
+    ):
+        self.facade = facade
+        self.registry = registry if registry is not None else MetricsRegistry()
+        self.recorder = recorder if recorder is not None else FlightRecorder()
+        r = self.registry
+        self._moves = r.counter(
+            "pumi_moves_total", "facade move_to_next_location calls"
+        )
+        self._segments = r.counter(
+            "pumi_segments_total", "scored particle-segments"
+        )
+        self._crossings = r.counter(
+            "pumi_crossings_total", "real element-boundary crossings"
+        )
+        self._truncated = r.counter(
+            "pumi_truncated_walks_total",
+            "walks not finished within max_crossings / the round bound",
+        )
+        self._chase = r.counter(
+            "pumi_chase_hops_total",
+            "stuck-escape (relocation chase) activations",
+        )
+        self._rounds = r.counter(
+            "pumi_migration_rounds_total",
+            "partitioned walk/exchange rounds executed",
+        )
+        self._occ = r.gauge(
+            "pumi_compaction_occupancy",
+            "mean post-compaction active occupancy of the last trace",
+        )
+        self._move_s = r.histogram(
+            "pumi_move_seconds", "wall-clock seconds per facade move"
+        )
+        self._hbm = r.gauge(
+            "pumi_device_peak_bytes", "peak device memory in use"
+        )
+
+    # ------------------------------------------------------------------ #
+    def record_walk(
+        self,
+        kind: str,
+        move: int,
+        stats: dict | None,
+        seconds: float | None = None,
+        **extra,
+    ) -> dict:
+        """Fold one trace's stats into the counters and the recorder.
+        ``stats`` is the named dict from the on-device stats vector (or
+        None when walk stats are disabled); ``seconds`` is the facade
+        phase time for this call where measured."""
+        fields = dict(extra)
+        fields["move"] = int(move)
+        if seconds is not None:
+            fields["seconds"] = round(float(seconds), 6)
+            if kind == "move":
+                self._move_s.observe(float(seconds))
+        if kind == "move":
+            self._moves.inc()
+        if stats is not None:
+            fields.update(stats)
+            self._segments.inc(stats["segments"])
+            self._crossings.inc(stats["crossings"])
+            self._truncated.inc(stats["truncated"])
+            self._chase.inc(stats["chase_hops"])
+            if stats.get("occupancy") is not None:
+                self._occ.set(stats["occupancy"])
+        if "rounds" in extra:
+            self._rounds.inc(int(extra["rounds"]))
+        return self.recorder.record(kind, **fields)
+
+    def record_memory(self, phase: str) -> dict:
+        """Sample per-device memory at a phase boundary (peak bytes where
+        the backend reports them — TPU does, CPU usually returns {})."""
+        mem = device_memory_stats()
+        for dev, rec in mem.items():
+            if "peak_bytes_in_use" in rec:
+                self._hbm.set(rec["peak_bytes_in_use"], device=dev)
+        return self.recorder.record("memory", phase=phase, devices=mem)
+
+    # ------------------------------------------------------------------ #
+    def snapshot(self, times=None, tail: int = 64) -> dict:
+        """The ``tally.telemetry()`` payload: counter totals, the last
+        ``tail`` flight records, a fresh memory sample, phase times, and
+        the full registry snapshot."""
+        out = {
+            "facade": self.facade,
+            "totals": {
+                "moves": self._moves.value(),
+                "segments": self._segments.value(),
+                "crossings": self._crossings.value(),
+                "truncated": self._truncated.value(),
+                "chase_hops": self._chase.value(),
+                "migration_rounds": self._rounds.value(),
+            },
+            "per_move": self.recorder.tail(tail),
+            "memory": device_memory_stats(),
+            "metrics": self.registry.snapshot(),
+        }
+        if times is not None:
+            out["times"] = dataclasses.asdict(times)
+        return out

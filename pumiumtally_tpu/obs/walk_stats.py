@@ -1,0 +1,85 @@
+"""Schema of the on-device walk stats vector.
+
+The reference library's only per-move observability is the host-side
+"Not all particles are found" printf (pumipic_particle_data_structure
+.cpp:765-768) plus four coarse [TIME] phase timers — it cannot say WHY a
+run is slow. Here every fused trace folds a small vector of counters
+into the jitted program itself (ops/walk.py, ops/walk_partitioned.py):
+one scalar-vector readback per move carries everything the flight
+recorder needs, replacing the per-move host scan of the ``done`` array
+the facade used to do, with zero extra device dispatches.
+
+The vector layout is the single source of truth for both walk kernels;
+``tests/test_obs.py`` pins the field order against the kernels so a
+drift breaks loudly.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+WALK_STATS_FIELDS = (
+    # Real element-boundary crossings summed over all lanes (relocation-
+    # chase hops are bookkeeping and excluded, matching the segment
+    # count's convention in ops/walk.py).
+    "crossings",
+    # Max real crossings by any single lane. For the partitioned walk a
+    # lane is a resident SLOT, so this is a per-chip per-slot maximum
+    # (counters do not migrate with particles across cuts).
+    "max_crossings",
+    # Stuck-escape activations: relocation-chase hops executed
+    # (ops/walk.py "Degeneracy robustness"). Nonzero means grazing-ray
+    # recovery is active — on a clean mesh this should be 0.
+    "chase_hops",
+    # In-flight walks not finished when the trace returned (truncated at
+    # max_crossings / the migration round bound) — the per-particle
+    # analog of the reference's cpp:765-768 error, as one scalar.
+    "truncated",
+    # Straggler-compaction occupancy: active lanes placed into subset
+    # slots, and subset slots swept, summed over every compaction round.
+    # occ_active/occ_slots is the mean post-compaction occupancy; both 0
+    # when compaction never ran.
+    "occ_active",
+    "occ_slots",
+    # Scored particle-segments (duplicates TraceResult.n_segments so ONE
+    # vector fetch serves the whole flight-recorder record).
+    "segments",
+    # While-loop body iterations executed (TraceResult.n_crossings; for
+    # the partitioned walk: phase-1 iters + all follow-up round iters).
+    "loop_iters",
+)
+
+WALK_STATS_LEN = len(WALK_STATS_FIELDS)
+
+IDX = {name: i for i, name in enumerate(WALK_STATS_FIELDS)}
+
+
+def _derived(d: dict) -> dict:
+    d["occupancy"] = (
+        round(d["occ_active"] / d["occ_slots"], 4) if d["occ_slots"] else None
+    )
+    return d
+
+
+def stats_to_dict(vec) -> dict:
+    """Host-side view of one stats vector: named integer fields plus the
+    derived mean compaction ``occupancy`` (None when compaction never
+    ran)."""
+    v = np.asarray(vec)
+    if v.shape != (WALK_STATS_LEN,):
+        raise ValueError(
+            f"expected a [{WALK_STATS_LEN}] stats vector, got {v.shape}"
+        )
+    return _derived({f: int(v[i]) for i, f in enumerate(WALK_STATS_FIELDS)})
+
+
+def reduce_chip_stats(mat) -> dict:
+    """Aggregate a per-chip [n_parts, LEN] stats matrix into one run-level
+    dict: sums everywhere except ``max_crossings`` (max over chips)."""
+    m = np.asarray(mat)
+    if m.ndim != 2 or m.shape[1] != WALK_STATS_LEN:
+        raise ValueError(
+            f"expected [n_parts, {WALK_STATS_LEN}] chip stats, got {m.shape}"
+        )
+    d = {f: int(m[:, i].sum()) for i, f in enumerate(WALK_STATS_FIELDS)}
+    d["max_crossings"] = int(m[:, IDX["max_crossings"]].max(initial=0))
+    return _derived(d)

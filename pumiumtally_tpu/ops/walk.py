@@ -320,6 +320,14 @@ class TraceResult(NamedTuple):
       |position − origin| to fp accumulation (asserted under
       debug_checks, the reference's cpp:618-629 consistency print);
       zeros on initial-search traces (nothing is scored).
+    stats: [8] per-move telemetry vector in the field order of
+      obs/walk_stats.py WALK_STATS_FIELDS — (real crossings, max real
+      crossings per particle, chase hops, truncated walks, compaction
+      occupancy numerator/denominator, segments, loop iterations) —
+      computed inside the jitted program so ONE scalar-vector readback
+      per move carries the whole flight-recorder record (the facade's
+      old per-move host scan of ``done`` goes away). None with
+      stats=False.
     """
 
     position: jax.Array
@@ -332,6 +340,7 @@ class TraceResult(NamedTuple):
     xpoints: jax.Array | None = None
     n_xpoints: jax.Array | None = None
     track_length: jax.Array | None = None
+    stats: jax.Array | None = None
 
 
 def resolve_tally_scatter(
@@ -387,6 +396,7 @@ def trace_impl(
     tally_scatter: str = "auto",
     gathers: str = "merged",
     ledger: bool = True,
+    stats: bool = True,
     debug_checks: bool = False,
     record_xpoints: int | None = None,
     n_groups: int | None = None,
@@ -472,6 +482,15 @@ def trace_impl(
         the in-loop update and returns track_length=None; the
         debug_checks consistency assert requires it. Kept as a knob so
         the hardware A/B grid can price it.
+      stats: fold the per-move telemetry vector (TraceResult.stats;
+        obs/walk_stats.py schema) into the jitted program: two int32
+        per-lane counters (real crossings, chase hops) updated
+        elementwise per crossing — the same cost class as the ledger —
+        plus a [2] occupancy accumulator bumped once per compaction
+        round, reduced to one [8] vector at the end. No extra
+        dispatches, no extra readbacks (the caller fetches the vector
+        INSTEAD of scanning ``done`` host-side). False restores the
+        exact pre-telemetry carry for A/B cost attribution.
       record_xpoints: when set to K, record each particle's first K
         boundary-crossing points into an [n, K, 3] buffer (the tracer's
         getIntersectionPoints() surface, reference test:403-479,
@@ -570,6 +589,33 @@ def trace_impl(
     if gathers not in ("merged", "split"):
         raise ValueError(f"gathers must be 'merged' or 'split': {gathers!r}")
 
+    # Carry layout — ONE definition shared by the walk body, the phase
+    # runner and the compaction rounds: a fixed head (done stays at
+    # index 2 for the loop conds), an optional [2] compaction-occupancy
+    # accumulator when stats is on, then every per-lane extra in static
+    # order — [ncross, nchase] when stats, [xp, kx] when recording — so
+    # compaction can gather/scatter the extras uniformly, and the
+    # iteration counter last.
+    def unpack_carry(c):
+        cur, elem, done, mat, flux, nseg = c[:6]
+        rest = c[6:]
+        if stats:
+            occ, rest = rest[0], rest[1:]
+        else:
+            occ = None
+        prev, stuck, pseg = rest[0], rest[1], rest[2]
+        lanes = list(rest[3:-1])
+        it = rest[-1]
+        return (cur, elem, done, mat, flux, nseg, occ, prev, stuck,
+                pseg, lanes, it)
+
+    def pack_carry(cur, elem, done, mat, flux, nseg, occ, prev, stuck,
+                   pseg, lanes, it):
+        head = (cur, elem, done, mat, flux, nseg)
+        if stats:
+            head = head + (occ,)
+        return head + (prev, stuck, pseg, *lanes, it)
+
     def make_body(dest_a, in_flight_a, weight_a, group_a):
         """One element-boundary crossing for every lane of a (sub)batch.
 
@@ -580,12 +626,10 @@ def trace_impl(
         good_group = (group_a >= 0) & (group_a < n_groups)
 
         def body(carry):
-            if record_xpoints is None:
-                (cur, elem, done, mat, flux, nseg, prev, stuck, pseg,
-                 it) = carry
-            else:
-                (cur, elem, done, mat, flux, nseg, prev, stuck, pseg, xp,
-                 kx, it) = carry
+            (cur, elem, done, mat, flux, nseg, occ, prev, stuck, pseg,
+             lanes, it) = unpack_carry(carry)
+            if record_xpoints is not None:
+                xp, kx = lanes[-2], lanes[-1]
             active = jnp.logical_not(done)
 
             if packed:
@@ -694,14 +738,22 @@ def trace_impl(
                 )
 
             crossed = active & ~reached & has_exit
+            # Genuine boundary crossings only (a lane that reaches its
+            # destination inside the current element crosses nothing, and
+            # relocation-chase hops are bookkeeping, not crossings) —
+            # the convention shared by the telemetry counters and the
+            # recorded intersection points.
+            real_cross = crossed & ~chase if robust else crossed
+            if stats:
+                ncross, nchase = lanes[0], lanes[1]
+                lanes[0] = ncross + real_cross.astype(ncross.dtype)
+                if robust:
+                    lanes[1] = nchase + chase.astype(nchase.dtype)
             if record_xpoints is not None:
-                # Genuine boundary crossings only (a lane that reaches its
-                # destination inside the current element records nothing,
-                # and relocation-chase hops are bookkeeping, not
-                # crossings). Non-crossing lanes row-index OOB (dropped);
-                # lanes past K crossings column-index OOB (dropped).
-                real_cross = crossed & ~chase if robust else crossed
+                # Non-crossing lanes row-index OOB (dropped); lanes past
+                # K crossings column-index OOB (dropped).
                 xp, kx = record_crossing(xp, kx, xpoint, real_cross)
+                lanes[-2], lanes[-1] = xp, kx
             if packed:
                 # Topology came along in the geo20 row: select the exit
                 # face's code locally (no second table gather).
@@ -831,11 +883,8 @@ def trace_impl(
                     continuing[:, None], cur + extra[:, None] * dirv, cur
                 )
             done = done | newly_done
-            if record_xpoints is None:
-                return (cur, elem, done, mat, flux, nseg, prev, stuck,
-                        pseg, it + 1)
-            return (cur, elem, done, mat, flux, nseg, prev, stuck, pseg,
-                    xp, kx, it + 1)
+            return pack_carry(cur, elem, done, mat, flux, nseg, occ,
+                              prev, stuck, pseg, lanes, it + 1)
 
         return body
 
@@ -867,23 +916,29 @@ def trace_impl(
     prev0 = elem * 0 - 1  # device-varying -1: no entry face yet
     stuck0 = elem * 0  # consecutive zero-progress crossings per lane
     pseg0 = weight * 0  # per-lane scored track length (device-varying)
-    carry = (
-        origin, elem, done0, mat0, flux, nseg0, prev0, stuck0, pseg0,
-        jnp.int32(0),
-    )
+    lanes0 = []
+    occ0 = None
+    if stats:
+        # Telemetry lanes (device-varying zeros): per-lane real-crossing
+        # and chase-hop counters, plus the [2] compaction-occupancy
+        # accumulator (active lanes placed, slots swept).
+        lanes0 += [elem * 0, elem * 0]
+        occ0 = jnp.stack([nseg0, nseg0]).astype(jnp.int32)
     if record_xpoints is not None:
         xp0 = jnp.zeros((n, int(record_xpoints), 3), dtype)
         kx0 = elem * 0  # per-lane zero (device-varying under shard_map)
-        carry = carry[:-1] + (xp0, kx0, jnp.int32(0))
-    # Generic unpack: *xpk is () normally, (xp, kx) when recording —
-    # compaction rounds carry the recording lanes like any other
-    # per-particle state, so the two features compose.
+        lanes0 += [xp0, kx0]
+    # The ``lanes`` extras (stats counters, recording buffers) ride the
+    # compaction rounds like any other per-particle state, so the
+    # features compose freely.
     # Static guard: a stage-0 schedule must not compile the dead
     # full-width while_loop at all.
+    carry = pack_carry(origin, elem, done0, mat0, flux, nseg0, occ0,
+                       prev0, stuck0, pseg0, lanes0, jnp.int32(0))
     if phase1_bound > 0:
         carry = run_phase(full_body, carry, phase1_bound)
-    (cur, elem, done, mat, flux, nseg, prev, stuck, pseg, *xpk,
-     it) = carry
+    (cur, elem, done, mat, flux, nseg, occ, prev, stuck, pseg, lanes,
+     it) = unpack_carry(carry)
 
     def compact_round(state, S, bound, stage_unroll=unroll):
         """One compaction round: gather the first S active lanes, advance
@@ -895,29 +950,37 @@ def trace_impl(
         of active lanes gather clamped garbage; they are neutralized by
         forcing their done flag and dropping their write-back rows.
 
-        When intersection-point recording is on, the per-lane xp/kx
-        buffers ride the same gather/scatter-back (garbage lanes never
-        record: their forced done flag keeps real_cross False, and their
-        write-back rows drop), so recording composes with compaction."""
-        (cur, elem, done, mat, flux, nseg, prev, stuck, pseg, *xpk,
-         it) = state
+        When intersection-point recording or walk stats are on, the
+        per-lane extras (xp/kx buffers, crossing/chase counters) ride
+        the same gather/scatter-back (garbage lanes never record or
+        count: their forced done flag keeps real_cross False, and their
+        write-back rows drop), so the features compose with
+        compaction."""
+        (cur, elem, done, mat, flux, nseg, occ, prev, stuck, pseg,
+         lanes, it) = unpack_carry(state)
         active = jnp.logical_not(done)
         idx, n_active = first_k_active(active, S)
         valid = jnp.arange(S) < n_active
+        if stats:
+            # Occupancy telemetry: active lanes placed vs slots swept,
+            # accumulated once per compaction round.
+            occ = occ + jnp.stack(
+                [jnp.minimum(n_active, S), jnp.zeros_like(n_active) + S]
+            ).astype(jnp.int32)
         sub_body = make_body(
             dest[idx],
             jnp.ones(S, bool),  # selected lanes are in flight by definition
             weight[idx],
             group[idx],
         )
-        sub_carry = (
+        sub_carry = pack_carry(
             cur[idx], elem[idx], jnp.logical_not(valid), mat[idx],
-            flux, nseg, prev[idx], stuck[idx], pseg[idx],
-            *(a[idx] for a in xpk), jnp.int32(0),
+            flux, nseg, occ, prev[idx], stuck[idx], pseg[idx],
+            [a[idx] for a in lanes], jnp.int32(0),
         )
-        (scur, selem, sdone, smat, flux, nseg, sprev, sstuck, spseg,
-         *sxpk, sit) = run_phase(
-            sub_body, sub_carry, bound, unroll=stage_unroll
+        (scur, selem, sdone, smat, flux, nseg, occ, sprev, sstuck,
+         spseg, slanes, sit) = unpack_carry(
+            run_phase(sub_body, sub_carry, bound, unroll=stage_unroll)
         )
         idx_sb = jnp.where(valid, idx, n)
         cur = cur.at[idx_sb].set(scur, mode="drop")
@@ -927,15 +990,16 @@ def trace_impl(
         prev = prev.at[idx_sb].set(sprev, mode="drop")
         stuck = stuck.at[idx_sb].set(sstuck, mode="drop")
         pseg = pseg.at[idx_sb].set(spseg, mode="drop")
-        xpk = [
-            a.at[idx_sb].set(s, mode="drop") for a, s in zip(xpk, sxpk)
+        lanes = [
+            a.at[idx_sb].set(s, mode="drop")
+            for a, s in zip(lanes, slanes)
         ]
-        return (cur, elem, done, mat, flux, nseg, prev, stuck, pseg,
-                *xpk, it + sit)
+        return pack_carry(cur, elem, done, mat, flux, nseg, occ, prev,
+                          stuck, pseg, lanes, it + sit)
 
     if compact_stages is not None and phase1_bound < max_crossings:
-        state = (cur, elem, done, mat, flux, nseg, prev, stuck, pseg,
-                 *xpk, it)
+        state = pack_carry(cur, elem, done, mat, flux, nseg, occ, prev,
+                           stuck, pseg, lanes, it)
         for i, (start, size, *rest) in enumerate(compact_stages):
             S = min(n, max(int(size), 1))
             s_unroll = int(rest[0]) if rest else unroll
@@ -971,8 +1035,8 @@ def trace_impl(
                     outer_cond, outer_body, (*state, jnp.int32(0))
                 )
                 state = tuple(state)
-        (cur, elem, done, mat, flux, nseg, prev, stuck, pseg, *xpk,
-         it) = state
+        (cur, elem, done, mat, flux, nseg, occ, prev, stuck, pseg,
+         lanes, it) = unpack_carry(state)
 
     if debug_checks and not initial and ledger:
         from jax.experimental import checkify
@@ -1019,7 +1083,26 @@ def trace_impl(
     else:
         material_id = mat
 
-    xp, kx = xpk if xpk else (None, None)
+    xp, kx = (
+        (lanes[-2], lanes[-1]) if record_xpoints is not None
+        else (None, None)
+    )
+    stats_vec = None
+    if stats:
+        ncross_l, nchase_l = lanes[0], lanes[1]
+        sd_t = nseg.dtype
+        # Field order pinned to obs/walk_stats.py WALK_STATS_FIELDS
+        # (drift breaks tests/test_obs.py).
+        stats_vec = jnp.stack([
+            jnp.sum(ncross_l).astype(sd_t),
+            jnp.max(ncross_l).astype(sd_t),
+            jnp.sum(nchase_l).astype(sd_t),
+            jnp.sum(jnp.logical_not(done)).astype(sd_t),
+            occ[0].astype(sd_t),
+            occ[1].astype(sd_t),
+            nseg,
+            it.astype(sd_t),
+        ])
     return TraceResult(
         position=cur,
         elem=elem,
@@ -1031,6 +1114,7 @@ def trace_impl(
         xpoints=xp,
         n_xpoints=kx,
         track_length=pseg if ledger else None,
+        stats=stats_vec,
     )
 
 
@@ -1095,6 +1179,7 @@ _trace_jit = jax.jit(
         "tally_scatter",
         "gathers",
         "ledger",
+        "stats",
         "debug_checks",
         "record_xpoints",
         "n_groups",

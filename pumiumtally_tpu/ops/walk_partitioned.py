@@ -77,7 +77,10 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..parallel.mesh_partition import MeshPartition
-from ..parallel.particle_sharding import PARTICLE_AXIS as AXIS
+from ..parallel.particle_sharding import (
+    PARTICLE_AXIS as AXIS,
+    shard_map,
+)
 from .geometry import exit_face
 from .walk import (
     chase_face_choice,
@@ -144,16 +147,33 @@ class PartitionedTraceResult(NamedTuple):
     # path order regardless of which chips walked it). None otherwise.
     xpoints: jax.Array | None = None
     n_xpoints: jax.Array | None = None
+    # [n_parts, 8] per-chip telemetry vectors in the
+    # obs/walk_stats.py WALK_STATS_FIELDS order. The crossing/chase
+    # counters are per resident SLOT and do not migrate with particles
+    # (they measure work executed on the chip, not particle identity),
+    # so "max_crossings" is a per-chip per-slot maximum and "crossings"
+    # sums to the global total across chips. "loop_iters" is phase-1
+    # iterations plus every follow-up round's iterations (round_stats
+    # row 5). obs.walk_stats.reduce_chip_stats aggregates the matrix.
+    stats: jax.Array | None = None
 
 
 def _walk_phase(
     tables, cur, dest, elem, done, target, target_elem, material_id,
-    weight, group, flux, nseg, valid, prev, stuck, pseg, *xpk,
+    weight, group, flux, nseg, valid, prev, stuck, pseg, occ, ncross,
+    nchase, *xpk,
     initial, tolerance, score_squares, max_crossings, max_local,
     unroll=1, compact_after=None, compact_size=None, compact_stages=None,
     robust=True, tally_scatter="pair", record_xpoints=None, n_groups=None,
 ):
     """Advance every resident particle until done or pending-migration.
+
+    ``occ``/``ncross``/``nchase`` are the telemetry accumulators of the
+    per-chip stats vector (PartitionedTraceResult.stats;
+    obs/walk_stats.py): the [2] compaction-occupancy accumulator plus
+    per-SLOT real-crossing and chase-hop counters. They ride the walk
+    carry and the compaction rounds exactly like ``pseg`` but do NOT
+    migrate in the exchange — they measure work executed on this chip.
 
     ``prev`` holds the ENC-encoded element the particle last hopped out
     of (local id >= 0, remote code < -1 set by the exchange for
@@ -210,7 +230,8 @@ def _walk_phase(
     def make_body(dest_a, weight_a, group_a, valid_a):
         def body(carry):
             (cur, elem, done, target, target_elem, material_id, flux,
-             nseg, prev, stuck, pseg, *xpk_c, it) = carry
+             nseg, occ, prev, stuck, pseg, ncross, nchase, *xpk_c,
+             it) = carry
             active = valid_a & ~done & (target < 0)
 
             dirv = dest_a - cur
@@ -275,13 +296,15 @@ def _walk_phase(
             remote = crossed & (enc < -1)
             local_hop = crossed & (enc >= 0)
 
+            # Genuine boundary crossings only, exactly as in ops/walk.py
+            # — including the crossing INTO a remote element (the cut
+            # face is an interior mesh face; it is counted/recorded
+            # once, on the sending chip).
+            real_cross = crossed & ~chase if robust else crossed
+            ncross = ncross + real_cross.astype(ncross.dtype)
+            if robust:
+                nchase = nchase + chase.astype(nchase.dtype)
             if record_xpoints is not None:
-                # Genuine boundary crossings only, exactly as in
-                # ops/walk.py — including the crossing INTO a remote
-                # element (the cut face is an interior mesh face; it is
-                # recorded once, on the sending chip, and the buffers
-                # migrate with the particle).
-                real_cross = crossed & ~chase if robust else crossed
                 xpk_c = list(
                     record_crossing(xpk_c[0], xpk_c[1], xpoint, real_cross)
                 )
@@ -366,7 +389,8 @@ def _walk_phase(
                 )
             done = done | newly_done
             return (cur, elem, done, target, target_elem, material_id,
-                    flux, nseg, prev, stuck, pseg, *xpk_c, it + 1)
+                    flux, nseg, occ, prev, stuck, pseg, ncross, nchase,
+                    *xpk_c, it + 1)
 
         return body
 
@@ -399,7 +423,7 @@ def _walk_phase(
     )
     carry = (
         cur, elem, done, target, target_elem, material_id, flux, nseg,
-        prev, stuck, pseg, *xpk, jnp.int32(0),
+        occ, prev, stuck, pseg, ncross, nchase, *xpk, jnp.int32(0),
     )
     # Static guard: a stage-0 schedule (the follow-up phases) must not
     # compile the dead full-width while_loop at all.
@@ -411,21 +435,26 @@ def _walk_phase(
             """Gather the first S active lanes, advance them until done or
             pending, scatter back (first_k_active, shared with walk.py)."""
             (cur, elem, done, target, target_elem, material_id, flux,
-             nseg, prev, stuck, pseg, *xpk_s, it) = state
+             nseg, occ, prev, stuck, pseg, ncross, nchase, *xpk_s,
+             it) = state
             active = valid & ~done & (target < 0)
             idx, n_active = first_k_active(active, S)
             sub_ok = jnp.arange(S) < n_active
+            # Occupancy telemetry: active lanes placed vs slots swept.
+            occ = occ + jnp.stack(
+                [jnp.minimum(n_active, S), jnp.zeros_like(n_active) + S]
+            ).astype(jnp.int32)
             sub_body = make_body(
                 dest[idx], weight[idx], group[idx], sub_ok
             )
             sub_carry = (
                 cur[idx], elem[idx], jnp.logical_not(sub_ok), target[idx],
-                target_elem[idx], material_id[idx], flux, nseg,
-                prev[idx], stuck[idx], pseg[idx],
-                *(a[idx] for a in xpk_s), jnp.int32(0),
+                target_elem[idx], material_id[idx], flux, nseg, occ,
+                prev[idx], stuck[idx], pseg[idx], ncross[idx],
+                nchase[idx], *(a[idx] for a in xpk_s), jnp.int32(0),
             )
-            (scur, selem, sdone, star, stare, smat, flux, nseg, sprev,
-             sstuck, spseg, *sxpk, sit) = run(
+            (scur, selem, sdone, star, stare, smat, flux, nseg, occ,
+             sprev, sstuck, spseg, sncross, snchase, *sxpk, sit) = run(
                 sub_body, sub_ok, sub_carry, bound, unroll=stage_unroll
             )
             idx_sb = jnp.where(sub_ok, idx, cap)
@@ -438,12 +467,15 @@ def _walk_phase(
             prev = prev.at[idx_sb].set(sprev, mode="drop")
             stuck = stuck.at[idx_sb].set(sstuck, mode="drop")
             pseg = pseg.at[idx_sb].set(spseg, mode="drop")
+            ncross = ncross.at[idx_sb].set(sncross, mode="drop")
+            nchase = nchase.at[idx_sb].set(snchase, mode="drop")
             xpk_s = [
                 a.at[idx_sb].set(v, mode="drop")
                 for a, v in zip(xpk_s, sxpk)
             ]
             return (cur, elem, done, target, target_elem, material_id,
-                    flux, nseg, prev, stuck, pseg, *xpk_s, it + sit)
+                    flux, nseg, occ, prev, stuck, pseg, ncross, nchase,
+                    *xpk_s, it + sit)
 
         def any_active(c):
             done, target = c[2], c[3]
@@ -688,7 +720,7 @@ def make_partitioned_step(
         def exchange(carry):
             (cur, dest, elem, done, target, target_elem, material_id,
              weight, group, pid, valid, prev, stuck, pseg, flux_l, nseg,
-             dropped, *xpk) = carry
+             dropped, occ, ncross, nchase, *xpk) = carry
             emig = valid & (target >= 0)
 
             # Bucket emigrants by destination chip: each destination's
@@ -831,26 +863,34 @@ def make_partitioned_step(
             )
             return (cur, dest, elem, done, target, target_elem, material_id,
                     weight, group, pid, valid, prev, stuck, pseg, flux_l,
-                    nseg, dropped, *xpk), stats
+                    nseg, dropped, occ, ncross, nchase, *xpk), stats
 
         def run_walk(carry, walk_fn):
             (cur, dest, elem, done, target, target_elem, material_id,
              weight, group, pid, valid, prev, stuck, pseg, flux_l, nseg,
-             dropped, *xpk) = carry
+             dropped, occ, ncross, nchase, *xpk) = carry
             (cur, elem, done, target, target_elem, material_id, flux_l,
-             nseg, prev, stuck, pseg, *xpk, w_iters) = walk_fn(
+             nseg, occ, prev, stuck, pseg, ncross, nchase, *xpk,
+             w_iters) = walk_fn(
                 tables_l, cur, dest, elem, done, target, target_elem,
                 material_id, weight, group, flux_l, nseg, valid, prev,
-                stuck, pseg, *xpk,
+                stuck, pseg, occ, ncross, nchase, *xpk,
             )
             return (cur, dest, elem, done, target, target_elem, material_id,
                     weight, group, pid, valid, prev, stuck, pseg, flux_l,
-                    nseg, dropped, *xpk), w_iters
+                    nseg, dropped, occ, ncross, nchase, *xpk), w_iters
 
+        # Telemetry accumulators (per-chip stats vector): [2] compaction
+        # occupancy + per-slot crossing/chase counters. Resident — they
+        # never ride the exchange payload (they measure THIS chip's
+        # work; an adopted slot keeps counting where its last occupant
+        # left off, which is exactly the per-chip total).
+        occ0 = jnp.stack([vzero[0], vzero[0]]) * 0
         carry = (
             cur, dest, elem, done, target0, vzero * 0,
             material_id, weight, group, pid, valid, target0 + 0, vzero * 0,
-            weight * 0, flux_l, nseg0, nseg0 * 0,
+            weight * 0, flux_l, nseg0, nseg0 * 0, occ0, vzero * 0,
+            vzero * 0,
         )
         if record_xpoints is not None:
             # Device-varying zeros (shard_map vma rule), like the other
@@ -860,7 +900,7 @@ def make_partitioned_step(
                 + cur[:, :1, None] * 0
             )
             carry = carry + (xp0, vzero * 0)
-        carry, _ = run_walk(carry, walk_first)
+        carry, w0_iters = run_walk(carry, walk_first)
 
         def pending_somewhere(carry):
             target, valid = carry[4], carry[10]
@@ -896,7 +936,7 @@ def make_partitioned_step(
             n_rounds, round_stats = nseg0 * 0, stats0
         (cur, dest, elem, done, target, target_elem, material_id,
          weight, group, pid, valid, prev, stuck, pseg, flux_l, nseg,
-         dropped, *xpk) = carry
+         dropped, occ, ncross, nchase, *xpk) = carry
 
         if has_halo:
             # Fold guest-scored flux back onto owner rows: ONE static
@@ -930,6 +970,21 @@ def make_partitioned_step(
                 else flux2.reshape(max_local, n_groups, 2)
             )
 
+        # Per-chip telemetry vector (obs/walk_stats.py field order —
+        # pinned by tests/test_obs.py). loop_iters = phase-1 iterations
+        # plus every follow-up round's iterations (round_stats row 5).
+        sd_t = nseg.dtype
+        svec = jnp.stack([
+            jnp.sum(ncross).astype(sd_t),
+            jnp.max(ncross).astype(sd_t),
+            jnp.sum(nchase).astype(sd_t),
+            jnp.sum(valid & ~done).astype(sd_t),
+            occ[0].astype(sd_t),
+            occ[1].astype(sd_t),
+            nseg,
+            (w0_iters + jnp.sum(round_stats[5])).astype(sd_t),
+        ])
+
         return PartitionedTraceResult(
             position=cur,
             dest=dest,
@@ -948,11 +1003,12 @@ def make_partitioned_step(
             round_stats=round_stats[None],
             xpoints=xpk[0] if xpk else None,
             n_xpoints=xpk[1] if xpk else None,
+            stats=svec[None],
         )
 
     table_specs = tuple(P(AXIS) for _ in (*tables, *halo_tables))
     particle_spec = P(AXIS)
-    mapped = jax.shard_map(
+    mapped = shard_map(
         shard_body,
         mesh=device_mesh,
         in_specs=table_specs + (particle_spec,) * 9 + (P(AXIS),),
@@ -976,6 +1032,7 @@ def make_partitioned_step(
             n_xpoints=(
                 particle_spec if record_xpoints is not None else None
             ),
+            stats=P(AXIS),
         ),
     )
     jitted = jax.jit(

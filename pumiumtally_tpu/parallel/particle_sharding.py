@@ -26,6 +26,21 @@ from ..ops.walk import TraceResult, trace_impl
 
 PARTICLE_AXIS = "p"
 
+# jax.shard_map graduated from jax.experimental in newer releases; the
+# fallback keeps the whole parallel layer importable (and testable on
+# the virtual CPU mesh) on runtimes where it still lives in experimental.
+# The experimental version has no replication rule for while_loop, so it
+# needs check_rep=False — semantics are unchanged, only the (conserva-
+# tive) replication verifier is skipped.
+try:
+    shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover - depends on installed jax
+    import functools as _functools
+
+    from jax.experimental.shard_map import shard_map as _exp_shard_map
+
+    shard_map = _functools.partial(_exp_shard_map, check_rep=False)
+
 
 def make_device_mesh(n_devices: int | None = None) -> Mesh:
     """1-D device mesh over the particle axis.
@@ -136,9 +151,10 @@ def make_sharded_trace(
             n_crossings=r.n_crossings[None],
             done=r.done,
             track_length=r.track_length,
+            stats=r.stats[None],
         )
 
-    mapped = jax.shard_map(
+    mapped = shard_map(
         shard_body,
         mesh=device_mesh,
         in_specs=(
@@ -161,6 +177,7 @@ def make_sharded_trace(
             n_crossings=P(PARTICLE_AXIS),
             done=P(PARTICLE_AXIS),
             track_length=P(PARTICLE_AXIS),
+            stats=P(PARTICLE_AXIS),  # [n_dev, 8] per-shard stats vectors
         ),
     )
     return jax.jit(mapped, donate_argnums=(8,))

@@ -39,12 +39,15 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 import warnings
 
 from ..api import _check_group_range, _out_param
+from ..obs import IDX, TallyTelemetry, reduce_chip_stats
 from ..ops.walk_partitioned import (
     collect_by_particle_id,
     distribute_particles,
     make_partitioned_step,
 )
 from ..utils.config import TallyConfig
+from ..utils.profiling import annotate
+from ..utils.timing import TallyTimes, phase_timer
 from ..core.tally import accumulate_batch_squares
 from .mesh_partition import assemble_global_flux, partition_mesh
 from .particle_sharding import PARTICLE_AXIS as AXIS, make_device_mesh
@@ -72,6 +75,10 @@ class PartitionedTally:
         self.mesh = mesh
         self.num_particles = int(num_particles)
         self.config = config if config is not None else TallyConfig()
+        # Telemetry + phase times: the PumiTally observability surface
+        # (tally.telemetry(), TallyTimes) over the partitioned walk.
+        self.tally_times = TallyTimes()
+        self._telemetry = TallyTelemetry("PartitionedTally")
         if self.config.compact_stages == "adaptive":
             raise NotImplementedError(
                 "compact_stages='adaptive' replans via PumiTally's "
@@ -184,6 +191,8 @@ class PartitionedTally:
             and self.config.score_squares
             else None
         )
+        # Phase-boundary memory sample (tables + flux slabs are placed).
+        self._telemetry.record_memory("initialization")
 
     # ------------------------------------------------------------------ #
     def _check_finite(self, name: str, arr: np.ndarray) -> None:
@@ -203,6 +212,30 @@ class PartitionedTally:
         return self._steps[key]
 
     def _run(self, dest, in_flight, weight, group, initial):
+        field = (
+            "initialization_time" if initial else "total_time_to_tally"
+        )
+        t_before = getattr(self.tally_times, field)
+        with annotate(
+            "PartitionedTally."
+            + ("initial_search" if initial else "move")
+        ), phase_timer(self.tally_times, field, True) as timer:
+            got, moving, stats = self._run_inner(
+                dest, in_flight, weight, group, initial
+            )
+            if self.config.measure_time:
+                timer.sync(self.flux_slabs)
+        self._telemetry.record_walk(
+            "initial_search" if initial else "move",
+            self.iter_count + (0 if initial else 1),
+            stats.pop("agg"),
+            seconds=getattr(self.tally_times, field) - t_before,
+            synced=self.config.measure_time,
+            **stats,
+        )
+        return got, moving
+
+    def _run_inner(self, dest, in_flight, weight, group, initial):
         moving = in_flight != 0
         placed = distribute_particles(
             self.partition,
@@ -240,7 +273,8 @@ class PartitionedTally:
         got = collect_by_particle_id(
             res, int(moving.sum()), self.partition
         )
-        if int(np.asarray(res.n_dropped).sum()) != 0:
+        n_dropped = int(np.asarray(res.n_dropped).sum())
+        if n_dropped != 0:
             raise RuntimeError(
                 "partitioned walk dropped immigrants: raise cap"
             )
@@ -249,8 +283,26 @@ class PartitionedTally:
         self.elem_global[moving] = got["elem_global"]
         if not initial:
             self.material_id[moving] = got["material_id"]
-        self.total_segments += int(np.asarray(res.n_segments).sum())
-        self.total_rounds += int(np.asarray(res.n_rounds)[0])
+        # Telemetry: the per-chip stats matrix (ONE [n_parts, 8] fetch
+        # carrying segments/crossings/truncations/occupancy) plus the
+        # per-shard migration counts from round_stats.
+        sv = np.asarray(res.stats)
+        agg = reduce_chip_stats(sv)
+        rs = np.asarray(res.round_stats)  # [n_parts, 6, rounds_bound]
+        n_rounds = int(np.asarray(res.n_rounds)[0])
+        stats = {
+            "agg": agg,
+            "rounds": n_rounds,
+            "dropped": n_dropped,
+            # Emigrants actually sent / immigrants adopted, summed over
+            # chips and rounds (round_stats rows 1 and 4).
+            "migrated": int(rs[:, 1].sum()),
+            "adopted": int(rs[:, 4].sum()),
+            "per_chip_segments": sv[:, IDX["segments"]].tolist(),
+            "per_chip_crossings": sv[:, IDX["crossings"]].tolist(),
+        }
+        self.total_segments += agg["segments"]
+        self.total_rounds += n_rounds
         if self.config.record_xpoints is not None:
             # Full host order; parked lanes record nothing (count 0).
             n = self.num_particles
@@ -261,7 +313,9 @@ class PartitionedTally:
             xp[moving] = got["xpoints"]
             counts[moving] = got["n_xpoints"]
             self._last_xpoints = (xp, counts)
-        n_lost = int(np.sum(~got["done"]))
+        # Truncation count from the on-device stats vector (valid slots
+        # not done — the same population as a host scan of got["done"]).
+        n_lost = agg["truncated"]
         if n_lost:
             warnings.warn(
                 f"{n_lost} partitioned walk(s) truncated (max_crossings="
@@ -269,9 +323,9 @@ class PartitionedTally:
                 "round bound); tallies for them are incomplete. Raise "
                 "TallyConfig.max_crossings / max_rounds.",
                 RuntimeWarning,
-                stacklevel=3,
+                stacklevel=4,
             )
-        return got, moving
+        return got, moving, stats
 
     # ------------------------------------------------------------------ #
     def initialize_particle_location(
@@ -333,6 +387,7 @@ class PartitionedTally:
             dest, flying_flat[:n], weights_h, groups_h, initial=False
         )
         self.iter_count += 1
+        self.tally_times.n_moves += 1
         # Copy-back contract, including parked lanes: a flying=0 particle
         # is not advanced and reports its HELD position and material (the
         # single-chip facade's in_flight semantics, ops/walk.py).
@@ -418,9 +473,28 @@ class PartitionedTally:
 
     def write_pumi_tally_mesh(self, filename: str | None = None) -> str:
         """Single-file VTK of the assembled normalized flux (PumiTally
-        contract); per-host PVTU pieces live in parallel/multihost.py."""
+        contract, including the phase-time report); per-host PVTU pieces
+        live in parallel/multihost.py."""
         from ..io.vtk import write_flux_vtk
 
-        name = filename or self.config.output_filename
-        write_flux_vtk(name, self.mesh, self.normalized_flux())
+        with annotate("PartitionedTally.write_pumi_tally_mesh"), \
+                phase_timer(self.tally_times, "vtk_file_write_time", True):
+            name = filename or self.config.output_filename
+            write_flux_vtk(name, self.mesh, self.normalized_flux())
+        self._telemetry.record_memory("vtk_write")
+        self.tally_times.print_times()
         return name
+
+    # ------------------------------------------------------------------ #
+    def telemetry(self) -> dict:
+        """Run-wide telemetry snapshot — the PumiTally.telemetry()
+        contract over the partitioned walk, with per-move migration
+        extras in the flight records (rounds, emigrants sent, immigrants
+        adopted, per-chip segment/crossing splits)."""
+        return self._telemetry.snapshot(times=self.tally_times)
+
+    @property
+    def metrics(self):
+        """This tally's MetricsRegistry (Prometheus text via
+        ``tally.metrics.render_prometheus()``)."""
+        return self._telemetry.registry

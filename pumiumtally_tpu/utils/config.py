@@ -102,6 +102,15 @@ class TallyConfig:
         ledger (TraceResult.track_length; required by the debug_checks
         consistency assert). One elementwise op per crossing — off only
         when squeezing the last percent from the hot loop.
+      walk_stats: fold the per-move telemetry vector into the jitted
+        walk (TraceResult.stats; obs/walk_stats.py schema — crossings,
+        max crossings/particle, chase hops, truncations, compaction
+        occupancy, segments, loop iters). The facade then reads ONE
+        small vector per move instead of scanning the ``done`` array
+        host-side, and feeds the flight recorder / ``telemetry()``.
+        Cost is two int32 lanes updated elementwise per crossing (the
+        ledger's cost class). False restores the pre-telemetry walk
+        carry and the host-side truncation scan.
 
     sd_mode: standard-deviation accumulation strategy.
         "segment" (default, reference parity): the walk scatters (c, c²)
@@ -124,6 +133,9 @@ class TallyConfig:
     (ops/walk_partitioned.py) always accumulates and migrates the ledger
     (it is the cross-cut conservation check) and always uses its own
     table layout; ``ledger=False`` / ``gathers`` are ignored there.
+    ``walk_stats=False`` is likewise single-chip only: the partitioned
+    walk always folds its per-chip stats vector (the counters double as
+    the migration/truncation diagnostics).
     """
 
     n_groups: int = 2
@@ -145,6 +157,7 @@ class TallyConfig:
     tally_scatter: str = "auto"
     gathers: str = "merged"
     ledger: bool = True
+    walk_stats: bool = True
     sd_mode: str = "segment"
 
     def resolve_max_crossings(self, ntet: int) -> int:

@@ -93,6 +93,53 @@ def log_error(msg: str, **fields) -> None:
     get_logger().error(msg, extra={"fields": fields} if fields else None)
 
 
+def metrics_path() -> str | None:
+    """Path of the JSONL metrics sink, from ``PUMI_TPU_METRICS=jsonl:/path``
+    (the obs flight recorder's emission channel). None when unset or when
+    the spec names an unknown scheme — metric emission is best-effort and
+    must never take a run down."""
+    spec = os.environ.get("PUMI_TPU_METRICS", "")
+    if spec.startswith("jsonl:"):
+        return spec[len("jsonl:"):] or None
+    return None
+
+
+_metric_sink_warned: set = set()
+
+
+def emit_metric(fields: dict, path: str | None = None) -> None:
+    """Emit one metrics record: a debug-level record through the logger
+    (so ``PUMI_TPU_LOG_JSON=1`` renders it with the same JSON machinery
+    as every other record), plus one appended JSON line to the
+    ``PUMI_TPU_METRICS=jsonl:<path>`` sink when configured. The JSONL
+    payload mirrors the log formatter's shape: ts + level + msg, then
+    the flat fields. Best-effort: an unwritable sink logs one warning
+    per path and never takes the run down."""
+    kind = str(fields.get("kind", "metric"))
+    get_logger().debug(
+        kind, extra={"fields": fields, "tag": "[METRIC]"}
+    )
+    path = path or metrics_path()
+    if not path:
+        return
+    payload = {
+        "ts": round(time.time(), 3),
+        "level": "metric",
+        "msg": kind,
+        **fields,
+    }
+    try:
+        with open(path, "a") as f:
+            f.write(json.dumps(payload, default=str) + "\n")
+    except OSError as e:
+        if path not in _metric_sink_warned:
+            _metric_sink_warned.add(path)
+            get_logger().warning(
+                f"metrics sink {path!r} unwritable ({e}); dropping "
+                "metric records for this path"
+            )
+
+
 def log_time(phase: str, seconds: float, **fields) -> None:
     """[TIME]-tagged record (TallyTimes print parity, reference .cpp:26-33).
     The phase/seconds fields feed the JSON mode; the text mode already has

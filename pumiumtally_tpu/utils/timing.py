@@ -20,6 +20,10 @@ class TallyTimes:
     initialization_time: float = 0.0
     total_time_to_tally: float = 0.0
     vtk_file_write_time: float = 0.0
+    # Moves accumulated into total_time_to_tally — the reference prints
+    # its iteration count with the timers (cpp:923-957); carrying it
+    # here closes that parity gap and prices the per-move cost directly.
+    n_moves: int = 0
 
     def print_times(self) -> None:
         from .log import log_time
@@ -30,7 +34,13 @@ class TallyTimes:
             + self.vtk_file_write_time
         )
         log_time("initialization", self.initialization_time)
-        log_time("tally", self.total_time_to_tally)
+        log_time("tally", self.total_time_to_tally, n_moves=self.n_moves)
+        if self.n_moves:
+            log_time(
+                "tally_per_move",
+                self.total_time_to_tally / self.n_moves,
+                n_moves=self.n_moves,
+            )
         log_time("vtk_write", self.vtk_file_write_time)
         log_time("total", total)
 

@@ -10,6 +10,12 @@ compile time is excluded. The full−phase1 delta is the migration
 rounds' total cost; phase1−single is the partitioned walk body's
 overhead at equal work.
 
+With BENCH_TRACE=/path set, the whole measured section is captured as
+an xprof trace (utils/profiling.profile_trace) and every variant runs
+inside a named annotate() span ("profile:single", "profile:phase1",
+...), so the per-phase cost split is visible kernel-by-kernel in the
+trace viewer, not just as wall-clock deltas.
+
 Usage: python scripts/profile_partitioned.py [cells] [n] [halo]
 """
 from __future__ import annotations
@@ -39,6 +45,14 @@ def main():
     )
     from pumiumtally_tpu.parallel.mesh_partition import partition_mesh
     from pumiumtally_tpu.parallel.particle_sharding import make_device_mesh
+    from pumiumtally_tpu.utils.profiling import annotate, profile_trace
+
+    import contextlib
+
+    trace_dir = os.environ.get("BENCH_TRACE")
+    trace_cm = (
+        profile_trace(trace_dir) if trace_dir else contextlib.nullcontext()
+    )
 
     cells = int(sys.argv[1]) if len(sys.argv) > 1 else 55
     n = int(sys.argv[2]) if len(sys.argv) > 2 else 65536
@@ -57,7 +71,7 @@ def main():
     weight = rng.uniform(0.5, 2.0, n)
     group = rng.integers(0, n_groups, n).astype(np.int32)
 
-    def time_single():
+    def time_single(span="profile:single"):
         def call():
             r = trace_impl(
                 mesh,
@@ -78,12 +92,13 @@ def main():
 
         call()
         t0 = time.perf_counter()
-        r = call()
+        with annotate(span):
+            r = call()
         return time.perf_counter() - t0, int(r.n_segments)
 
     dmesh = make_device_mesh(n_dev)
 
-    def time_step(max_rounds, **kw):
+    def time_step(max_rounds, span="profile:step", **kw):
         step = make_partitioned_step(
             dmesh, part, n_groups=n_groups, max_crossings=mesh.ntet + 64,
             tolerance=1e-6, max_rounds=max_rounds, **kw,
@@ -115,15 +130,20 @@ def main():
 
         call()
         t0 = time.perf_counter()
-        res = call()
+        with annotate(span):
+            res = call()
         dt = time.perf_counter() - t0
         return dt, int(np.asarray(res.n_segments).sum()), int(
             np.asarray(res.n_rounds)[0]
         )
 
+    # xprof capture (BENCH_TRACE) brackets every measured variant; the
+    # ExitStack keeps the unmeasured JSON assembly out of the trace.
+    _ts = contextlib.ExitStack()
+    _ts.enter_context(trace_cm)
     single_s, nseg = time_single()
-    p1_s, p1_seg, _ = time_step(0)
-    full_s, full_seg, rounds = time_step(None)
+    p1_s, p1_seg, _ = time_step(0, span="profile:phase1")
+    full_s, full_seg, rounds = time_step(None, span="profile:full")
     # Production-shaped variants: unroll 8 (the single-chip default) and
     # the density-scaled dense ladder on phase 1 — the dispatch-
     # amortizing machinery the bare steps above don't use. On the
@@ -137,14 +157,20 @@ def main():
         (int(round(s * scale)), min(w, cap), *r)
         for s, w, *r in dense_ladder(cap)
     )
-    u8_s, _, _ = time_step(None, unroll=8)
-    u8l_s, _, _ = time_step(None, unroll=8, compact_stages=ladder)
+    u8_s, _, _ = time_step(None, span="profile:full_u8", unroll=8)
+    u8l_s, _, _ = time_step(
+        None, span="profile:full_u8_ladder", unroll=8,
+        compact_stages=ladder,
+    )
     # No-tally walk (initial=True): same loop structure and iteration
     # counts, zero flux scatters — if the gap collapses here, the
     # overhead is the scatter/flux path (e.g. lost in-place aliasing of
     # the carried slab), not per-iteration fixed cost.
-    init_s, _, _ = time_step(None, initial=True)
-    sq1_s, _, _ = time_step(None, score_squares=False)
+    init_s, _, _ = time_step(None, span="profile:full_notally", initial=True)
+    sq1_s, _, _ = time_step(
+        None, span="profile:full_nosq", score_squares=False
+    )
+    _ts.close()
 
     rec = {
         "metric": "partitioned_phase_profile",
