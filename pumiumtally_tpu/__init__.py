@@ -24,6 +24,7 @@ from .models.pipeline import StreamingTallyPipeline
 from .models.transport import Material, SyntheticTransport
 from .obs import FlightRecorder, MetricsRegistry
 from .ops.walk import trace, TraceResult
+from .resilience import CheckpointStore, FaultInjector, ResilientRunner
 from .utils.config import TallyConfig
 from .utils.timing import TallyTimes
 
@@ -50,6 +51,9 @@ __all__ = [
     "SyntheticTransport",
     "MetricsRegistry",
     "FlightRecorder",
+    "ResilientRunner",
+    "CheckpointStore",
+    "FaultInjector",
     "trace",
     "TraceResult",
     "TallyConfig",

@@ -152,6 +152,13 @@ class PumiTally:
             # _perm[i]; None while the layout is still identity.
             self._perm: np.ndarray | None = None
             self._last_xpoints: tuple | None = None
+            # Bad-particle quarantine (resilience/quarantine.py):
+            # cumulative per-lane counts + the out-of-mesh threshold.
+            self._quarantined: np.ndarray | None = None
+            if cfg.quarantine:
+                from .resilience.quarantine import setup
+
+                setup(self, mesh.coords, self.num_particles)
             timer.sync((self.state, self.flux))
         # Phase-boundary memory sample (HBM peaks where the backend
         # reports them — construction allocated the mesh tables + flux).
@@ -204,10 +211,57 @@ class PumiTally:
             warnings.warn(
                 f"{n_lost} particle walk(s) truncated at max_crossings="
                 f"{self._max_crossings}; tallies for them are incomplete. "
-                "Raise TallyConfig.max_crossings.",
+                "Raise TallyConfig.max_crossings or set "
+                "truncation_retries for bounded re-walk escalation.",
                 RuntimeWarning,
                 stacklevel=3,
             )
+
+    # ------------------------------------------------------------------ #
+    def _quarantine(self, dest3, weights, move):
+        """Bad-particle quarantine for one call (TallyConfig.quarantine)
+        — delegates to the shared resilience/quarantine.py apply() so
+        both facades keep identical semantics. Returns
+        ``(dest3_for_staging, mask_or_None)``: on a hit the first is a
+        sanitized COPY (the caller's buffer is never mutated — a
+        supervisor retry must re-see the original inputs); ``weights``
+        must already be a facade copy or None."""
+        if not self.config.quarantine:
+            return dest3, None
+        from .resilience import quarantine
+
+        return quarantine.apply(self, dest3, weights, move)
+
+    def quarantined_lanes(self) -> np.ndarray:
+        """Cumulative per-lane quarantine counts, host pid order (the
+        degraded-mode per-lane report; resilience/quarantine.py)."""
+        from .resilience.quarantine import lanes
+
+        return lanes(self)
+
+    def _escalate_truncated(
+        self, result, dest, weight, group, stats_d, tkw, move
+    ):
+        """Truncation escalation (TallyConfig.truncation_retries): re-walk
+        only the truncated lanes with doubled max_crossings before
+        declaring them lost (ops/walk.py rewalk_truncated). Returns the
+        (possibly merged) result, refreshed stats, and the lost count."""
+        n_tr = self._n_truncated(result, stats_d)
+        if not n_tr:
+            return result, stats_d, 0
+        n_lost, n_retried = n_tr, 0
+        if self.config.truncation_retries > 0:
+            from .ops.walk import rewalk_truncated
+
+            result, n_retried, n_lost = rewalk_truncated(
+                self.mesh, result, dest, weight, group,
+                retries=self.config.truncation_retries,
+                trace_fn=self._trace, **tkw,
+            )
+            stats_d = self._read_stats(result)
+        if n_retried or n_lost:
+            self._telemetry.record_rewalk(move, n_retried, n_lost)
+        return result, stats_d, n_lost
 
     # ------------------------------------------------------------------ #
     def initialize_particle_location(
@@ -226,24 +280,21 @@ class PumiTally:
         assert size == self.num_particles * 3, (
             f"expected {self.num_particles * 3} coordinates, got {size}"
         )
-        self._check_finite("init_particle_positions", pos)
+        n = self.num_particles
+        fly_h = np.ones(n, bool)
+        pos3 = pos[:size].reshape(-1, 3)
+        pos3, qmask = self._quarantine(pos3, None, 0)
+        if qmask is not None:
+            fly_h &= ~qmask  # masked lanes stay at the seed
+        self._check_finite("init_particle_positions", pos3)
         t_before = self.tally_times.initialization_time
         with annotate("PumiTally.initialize_particle_location"), phase_timer(
             self.tally_times, "initialization_time", True
         ) as timer:
-            dest_h = self._gather_in(pos[:size].reshape(-1, 3))
+            dest_h = self._gather_in(pos3)
             dest = jnp.asarray(dest_h, dtype=self.config.dtype)
             s = self.state
-            result = self._trace(
-                self.mesh,
-                s.origin,
-                dest,
-                s.elem,
-                jnp.ones_like(s.in_flight),
-                s.weight,
-                s.group,
-                s.material_id,
-                self.flux,
+            tkw = dict(
                 initial=True,
                 max_crossings=self._max_crossings,
                 score_squares=self.config.score_squares,
@@ -260,14 +311,29 @@ class PumiTally:
                 record_xpoints=self.config.record_xpoints,
                 n_groups=self.config.n_groups,
             )
+            result = self._trace(
+                self.mesh,
+                s.origin,
+                dest,
+                s.elem,
+                jnp.asarray(self._gather_in(fly_h)),
+                s.weight,
+                s.group,
+                s.material_id,
+                self.flux,
+                **tkw,
+            )
+            stats_d = self._read_stats(result)
+            result, stats_d, n_lost = self._escalate_truncated(
+                result, dest, s.weight, s.group, stats_d, tkw, 0
+            )
             self.flux = result.flux
             self.state = s._replace(
                 origin=result.position, dest=dest, elem=result.elem
             )
             self._store_xpoints(result)
             self._initialized = True
-            stats_d = self._read_stats(result)
-            self._warn_if_truncated(self._n_truncated(result, stats_d))
+            self._warn_if_truncated(n_lost)
             if self.config.measure_time:
                 timer.sync(self.state)
         self._telemetry.record_walk(
@@ -332,7 +398,18 @@ class PumiTally:
         weights_h = np.asarray(weights, dtype=np.float64).reshape(-1)[:n]
         groups_h = np.asarray(groups, dtype=np.int32).reshape(-1)[:n]
         self._check_groups(groups_h)
-        self._check_finite("particle_destinations", dest_flat)
+        fly_h = flying_flat[:n] != 0
+        dest3_h = dest_flat[: n * 3].reshape(n, 3)
+        if cfg.quarantine:
+            # weights_h may alias the caller's array (asarray no-copies
+            # a matching dtype); sanitize must not write through it.
+            weights_h = weights_h.copy()
+            dest3_h, qmask = self._quarantine(
+                dest3_h, weights_h, self.iter_count + 1
+            )
+            if qmask is not None:
+                fly_h = fly_h & ~qmask  # quarantined lanes are parked
+        self._check_finite("particle_destinations", dest3_h)
         self._check_finite("weights", weights_h)
 
         t_before = self.tally_times.total_time_to_tally
@@ -341,33 +418,19 @@ class PumiTally:
         ) as timer:
             s = self.state
             dest = jnp.asarray(
-                self._gather_in(dest_flat[: n * 3].reshape(-1, 3)),
-                dtype=cfg.dtype,
+                self._gather_in(dest3_h), dtype=cfg.dtype
             )
-            in_flight = jnp.asarray(
-                self._gather_in(flying_flat[:n]) != 0
-            )
+            in_flight = jnp.asarray(self._gather_in(fly_h))
             # Host-side mover count for the one-shot adaptive replan —
             # counted here (before the flags are zeroed) and only while
             # a replan is still pending, so the hot path pays nothing.
             n_moving_h = (
-                int((flying_flat[:n] != 0).sum())
-                if not self._replanned
-                else 0
+                int(fly_h.sum()) if not self._replanned else 0
             )
             weight = jnp.asarray(self._gather_in(weights_h), dtype=cfg.dtype)
             group = jnp.asarray(self._gather_in(groups_h), dtype=jnp.int32)
 
-            result = self._trace(
-                self.mesh,
-                s.origin,
-                dest,
-                s.elem,
-                in_flight,
-                weight,
-                group,
-                s.material_id,
-                self.flux,
+            tkw = dict(
                 initial=False,
                 max_crossings=self._max_crossings,
                 # sd_mode="batch" skips the per-segment squares rows
@@ -388,6 +451,23 @@ class PumiTally:
                 stats=cfg.walk_stats,
                 record_xpoints=cfg.record_xpoints,
                 n_groups=cfg.n_groups,
+            )
+            result = self._trace(
+                self.mesh,
+                s.origin,
+                dest,
+                s.elem,
+                in_flight,
+                weight,
+                group,
+                s.material_id,
+                self.flux,
+                **tkw,
+            )
+            stats_d = self._read_stats(result)
+            result, stats_d, n_lost = self._escalate_truncated(
+                result, dest, weight, group, stats_d, tkw,
+                self.iter_count + 1,
             )
             self.flux = result.flux
             if self._prev_even is not None:
@@ -418,10 +498,10 @@ class PumiTally:
                 dest_flat[: n * 3].reshape(n, 3)[self._perm] = final_pos
                 mats_flat[:n][self._perm] = final_mats
             flying_flat[:n] = 0
-            # ONE stats-vector fetch carries segments + truncations +
-            # crossings (the pre-telemetry path read n_segments AND
-            # host-scanned the whole done array here).
-            stats_d = self._read_stats(result)
+            # ONE stats-vector fetch (taken above, refreshed by any
+            # escalation re-walk) carries segments + truncations +
+            # crossings — the pre-telemetry path read n_segments AND
+            # host-scanned the whole done array here.
             segs = (
                 stats_d["segments"] if stats_d is not None
                 else int(result.n_segments)
@@ -429,7 +509,7 @@ class PumiTally:
             self.total_segments += segs
             self._maybe_replan(segs, n_moving_h)
             self._store_xpoints(result)
-            self._warn_if_truncated(self._n_truncated(result, stats_d))
+            self._warn_if_truncated(n_lost)
 
             # Periodic locality sort (the migrate-every-100 analog,
             # cpp:256-258).

@@ -16,6 +16,12 @@ tallies don't interleave):
   pumi_truncated_walks_total, pumi_chase_hops_total,
   pumi_migration_rounds_total, pumi_compaction_occupancy,
   pumi_move_seconds, pumi_device_peak_bytes{device=...}
+
+Resilience families (fed by the quarantine / truncation-escalation
+paths, resilience/):
+  pumi_quarantined_lanes_total (deduplicated lanes),
+  pumi_quarantine_reasons_total{reason=...},
+  pumi_rewalked_lanes_total, pumi_lost_walks_total
 """
 from __future__ import annotations
 
@@ -68,6 +74,26 @@ class TallyTelemetry:
         self._hbm = r.gauge(
             "pumi_device_peak_bytes", "peak device memory in use"
         )
+        self._quarantined = r.counter(
+            "pumi_quarantined_lanes_total",
+            "lanes masked out of the walk by the bad-particle "
+            "quarantine (each lane once per move, however many "
+            "reasons it trips)",
+        )
+        self._quarantine_reasons = r.counter(
+            "pumi_quarantine_reasons_total",
+            "quarantine verdicts by reason (a lane tripping several "
+            "reasons counts once per reason)",
+        )
+        self._rewalked = r.counter(
+            "pumi_rewalked_lanes_total",
+            "truncated lanes re-walked by the escalation policy",
+        )
+        self._lost = r.counter(
+            "pumi_lost_walks_total",
+            "walks declared lost after bounded re-walk retries (or "
+            "immediately, with the escalation policy off)",
+        )
 
     # ------------------------------------------------------------------ #
     def record_walk(
@@ -102,6 +128,33 @@ class TallyTelemetry:
             self._rounds.inc(int(extra["rounds"]))
         return self.recorder.record(kind, **fields)
 
+    def record_quarantine(
+        self, move: int, lanes: int, reasons: dict
+    ) -> dict:
+        """Fold one move's quarantine verdicts: ``lanes`` is the
+        DEDUPLICATED parked-lane count (the headline number, agrees
+        with ``quarantined_lanes()``); ``reasons`` maps reason name →
+        verdict count (resilience/quarantine.py REASONS)."""
+        self._quarantined.inc(lanes)
+        for reason, count in reasons.items():
+            if count:
+                self._quarantine_reasons.inc(count, reason=reason)
+        return self.recorder.record(
+            "quarantine", move=int(move), lanes=int(lanes), **reasons
+        )
+
+    def record_rewalk(self, move: int, retried: int, lost: int) -> dict:
+        """Fold one move's truncation-escalation outcome: lanes
+        re-walked (summed over attempts) and lanes finally lost."""
+        if retried:
+            self._rewalked.inc(retried)
+        if lost:
+            self._lost.inc(lost)
+        return self.recorder.record(
+            "rewalk", move=int(move), retried=int(retried),
+            lost=int(lost),
+        )
+
     def record_memory(self, phase: str) -> dict:
         """Sample per-device memory at a phase boundary (peak bytes where
         the backend reports them — TPU does, CPU usually returns {})."""
@@ -116,6 +169,7 @@ class TallyTelemetry:
         """The ``tally.telemetry()`` payload: counter totals, the last
         ``tail`` flight records, a fresh memory sample, phase times, and
         the full registry snapshot."""
+        quarantined = self._quarantined.value()
         out = {
             "facade": self.facade,
             "totals": {
@@ -125,7 +179,13 @@ class TallyTelemetry:
                 "truncated": self._truncated.value(),
                 "chase_hops": self._chase.value(),
                 "migration_rounds": self._rounds.value(),
+                "quarantined": quarantined,
+                "rewalked": self._rewalked.value(),
+                "lost": self._lost.value(),
             },
+            # Headline resilience count, also at the top level: the
+            # acceptance surface is telemetry()["quarantined"].
+            "quarantined": quarantined,
             "per_move": self.recorder.tail(tail),
             "memory": device_memory_stats(),
             "metrics": self.registry.snapshot(),

@@ -118,6 +118,7 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .geometry import exit_face
 
@@ -1193,3 +1194,141 @@ def trace(*args, **kwargs):
 
 
 trace.__doc__ = trace_impl.__doc__
+
+
+# --------------------------------------------------------------------- #
+# Truncated-lane escalation (resilience)
+# --------------------------------------------------------------------- #
+def merge_recorded_xpoints(xa, ka, xb, kb, rows_a, rows_b) -> None:
+    """Append re-walk crossing points after a prior attempt's, IN PLACE:
+    for each pair (rows_a[j], rows_b[j]), ``xb``'s recorded points go
+    after ``xa``'s, capped at the K-point buffer; counts keep
+    incrementing past K (the caller-visible truncation signal). The ONE
+    definition of the cap/overflow semantics for both the single-chip
+    and partitioned escalation paths. Host-side numpy — cold path."""
+    K = xa.shape[1]
+    for ra, rb in zip(rows_a, rows_b):
+        kept = min(int(ka[ra]), K)
+        take = min(int(kb[rb]), K - kept)
+        if take > 0:
+            xa[ra, kept:kept + take] = xb[rb, :take]
+    ka[rows_a] += kb[rows_b]
+
+
+def _merge_xpoints(a, b, todo):
+    """TraceResult-level wrapper over merge_recorded_xpoints for the
+    single-chip re-walk (both buffers are full lane width)."""
+    xa = np.asarray(a.xpoints).copy()
+    ka = np.asarray(a.n_xpoints).copy()
+    rows = np.nonzero(todo)[0]
+    merge_recorded_xpoints(
+        xa, ka, np.asarray(b.xpoints), np.asarray(b.n_xpoints),
+        rows, rows,
+    )
+    return jnp.asarray(xa), jnp.asarray(ka)
+
+
+def _merge_rewalk(a: TraceResult, b: TraceResult, todo) -> TraceResult:
+    """Fold a re-walk result ``b`` (only ``todo`` lanes were in flight)
+    into the prior attempt ``a``. Per-lane outputs come wholesale from
+    ``b`` — parked lanes pass through trace untouched (position=origin,
+    material/elem preserved) — while run totals (segments, crossings,
+    stats, ledger) accumulate."""
+    stats = None
+    if a.stats is not None and b.stats is not None:
+        stats = a.stats + b.stats
+        # max_crossings is a max, not a sum; truncated is the FINAL
+        # count (b saw every still-unfinished lane as in flight).
+        from ..obs import IDX
+
+        stats = stats.at[IDX["max_crossings"]].set(
+            jnp.maximum(a.stats[IDX["max_crossings"]],
+                        b.stats[IDX["max_crossings"]])
+        )
+        stats = stats.at[IDX["truncated"]].set(b.stats[IDX["truncated"]])
+    xp, kx = b.xpoints, b.n_xpoints
+    if a.xpoints is not None:
+        xp, kx = _merge_xpoints(a, b, todo)
+    track = None
+    if a.track_length is not None and b.track_length is not None:
+        track = a.track_length + b.track_length
+    return TraceResult(
+        position=b.position,
+        elem=b.elem,
+        material_id=b.material_id,
+        flux=b.flux,
+        n_segments=a.n_segments + b.n_segments,
+        n_crossings=a.n_crossings + b.n_crossings,
+        done=b.done,
+        xpoints=xp,
+        n_xpoints=kx,
+        track_length=track,
+        stats=stats,
+    )
+
+
+def rewalk_truncated(
+    mesh,
+    result: TraceResult,
+    dest,
+    weight,
+    group,
+    *,
+    retries: int,
+    trace_fn=None,
+    **trace_kwargs,
+):
+    """Escalation policy for truncated walks: re-walk ONLY the truncated
+    lanes with doubled ``max_crossings``, up to ``retries`` attempts,
+    before declaring them lost.
+
+    A truncated lane holds a mid-walk position and parent element, and
+    flux is additive per segment, so continuing the walk from where it
+    stopped scores exactly the segments the truncation dropped — no
+    rescoring, no gaps. Each attempt doubles the static crossing bound
+    (one extra compile per new bound, cold path only) and puts ONLY the
+    still-unfinished lanes in flight; everything else rides through as
+    parked.
+
+    Args:
+      result: the truncated TraceResult (``done`` has False lanes).
+      dest, weight, group: the move's per-lane inputs (device order).
+      retries: max re-walk attempts (bounded — this must terminate).
+      trace_fn: the trace callable (default ``trace``; facades pass
+        their checkify-routing ``_trace``).
+      trace_kwargs: the original trace kwargs including
+        ``max_crossings`` (the doubling base) and ``initial``.
+
+    Returns ``(merged TraceResult, n_retried, n_lost)`` where
+    ``n_retried`` sums lanes over attempts and ``n_lost`` counts lanes
+    still unfinished after the last attempt.
+    """
+    if trace_fn is None:
+        trace_fn = trace
+    kwargs = dict(trace_kwargs)
+    max_crossings = kwargs.pop("max_crossings")
+    n_retried = 0
+    for _ in range(retries):
+        done_h = np.asarray(result.done)
+        todo = np.logical_not(done_h)
+        n_todo = int(todo.sum())
+        if n_todo == 0:
+            break
+        n_retried += n_todo
+        max_crossings *= 2
+        r2 = trace_fn(
+            mesh,
+            result.position,
+            dest,
+            result.elem,
+            jnp.asarray(todo),
+            weight,
+            group,
+            result.material_id,
+            result.flux,
+            max_crossings=max_crossings,
+            **kwargs,
+        )
+        result = _merge_rewalk(result, r2, todo)
+    n_lost = int(np.sum(np.logical_not(np.asarray(result.done))))
+    return result, n_retried, n_lost

@@ -39,7 +39,12 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 import warnings
 
 from ..api import _check_group_range, _out_param
-from ..obs import IDX, TallyTelemetry, reduce_chip_stats
+from ..obs import (
+    IDX,
+    TallyTelemetry,
+    WALK_STATS_FIELDS,
+    reduce_chip_stats,
+)
 from ..ops.walk_partitioned import (
     collect_by_particle_id,
     distribute_particles,
@@ -51,6 +56,43 @@ from ..utils.timing import TallyTimes, phase_timer
 from ..core.tally import accumulate_batch_squares
 from .mesh_partition import assemble_global_flux, partition_mesh
 from .particle_sharding import PARTICLE_AXIS as AXIS, make_device_mesh
+
+
+def _merge_agg(a: dict, b: dict) -> dict:
+    """Fold a re-walk attempt's aggregated chip stats into the move's
+    running totals: sums everywhere except ``max_crossings`` (max over
+    attempts) and ``truncated`` (the LATEST attempt saw every
+    still-unfinished lane, so its count is the final one)."""
+    out = {f: a[f] + b[f] for f in WALK_STATS_FIELDS}
+    out["max_crossings"] = max(a["max_crossings"], b["max_crossings"])
+    out["truncated"] = b["truncated"]
+    out["occupancy"] = (
+        round(out["occ_active"] / out["occ_slots"], 4)
+        if out["occ_slots"]
+        else None
+    )
+    return out
+
+
+def _merge_got(got: dict, sub_trunc: np.ndarray, got2: dict) -> None:
+    """Fold a re-walk attempt's collected outputs (rows = the retried
+    lanes, ascending pid order — the same order ``sub_trunc`` selects)
+    into the move's ``got`` dict IN PLACE."""
+    for name in ("position", "material_id", "elem", "done"):
+        got[name][sub_trunc] = got2[name]
+    if "elem_global" in got:
+        got["elem_global"][sub_trunc] = got2["elem_global"]
+    if "track_length" in got:
+        got["track_length"][sub_trunc] += got2["track_length"]
+    if "xpoints" in got:
+        from ..ops.walk import merge_recorded_xpoints
+
+        rows_a = np.nonzero(sub_trunc)[0]
+        merge_recorded_xpoints(
+            got["xpoints"], got["n_xpoints"],
+            got2["xpoints"], got2["n_xpoints"],
+            rows_a, np.arange(rows_a.size),
+        )
 
 
 class PartitionedTally:
@@ -170,6 +212,13 @@ class PartitionedTally:
         self.total_rounds = 0
         self._initialized = False
         self._last_xpoints: tuple | None = None
+        # Bad-particle quarantine (resilience/quarantine.py): same
+        # contract as PumiTally — parked, counted, reported per-lane.
+        self._quarantined: np.ndarray | None = None
+        if self.config.quarantine:
+            from ..resilience.quarantine import setup
+
+            setup(self, mesh.coords, self.num_particles)
         # sd_mode="batch": per-chip snapshot of the even (Σc) slab
         # entries as of the previous move. The halo fold has already
         # moved guest scores onto owner rows (and zeroed halo rows) by
@@ -199,6 +248,24 @@ class PartitionedTally:
         # Same opt-in host-side validation as PumiTally (api.py).
         if self.config.checkify_invariants and not np.isfinite(arr).all():
             raise ValueError(f"{name} contains non-finite values")
+
+    def _quarantine(self, dest3, weights, move):
+        """Bad-particle quarantine for one call — the PumiTally contract
+        (api.py _quarantine) via the same shared
+        resilience/quarantine.py apply(). Returns
+        ``(dest3_for_staging, mask_or_None)``; never mutates the
+        caller's buffers."""
+        if not self.config.quarantine:
+            return dest3, None
+        from ..resilience import quarantine
+
+        return quarantine.apply(self, dest3, weights, move)
+
+    def quarantined_lanes(self) -> np.ndarray:
+        """Cumulative per-lane quarantine counts, host pid order."""
+        from ..resilience.quarantine import lanes
+
+        return lanes(self)
 
     def _step(self, initial: bool):
         key = bool(initial)
@@ -237,6 +304,73 @@ class PartitionedTally:
 
     def _run_inner(self, dest, in_flight, weight, group, initial):
         moving = in_flight != 0
+        got, stats = self._walk_once(dest, moving, weight, group, initial)
+        n_lost = stats["agg"]["truncated"]
+        n_re = 0
+        retries = self.config.truncation_retries
+        n = self.num_particles
+        while n_lost and retries > 0:
+            # Truncation escalation over the partitioned walk: re-walk
+            # ONLY the truncated lanes. Each attempt re-arms the SAME
+            # compiled step (an additive crossing/round budget) instead
+            # of doubling the static bound, which would compile a fresh
+            # partitioned program per attempt (TallyConfig docstring).
+            # Positions/elements were already folded back mid-walk, so
+            # the re-walk continues exactly where truncation stopped.
+            retries -= 1
+            sub_trunc = ~got["done"].astype(bool)
+            trunc = np.zeros(n, bool)
+            trunc[np.nonzero(moving)[0][sub_trunc]] = True
+            n_re += int(trunc.sum())
+            got2, stats2 = self._walk_once(
+                dest, trunc, weight, group, initial
+            )
+            _merge_got(got, sub_trunc, got2)
+            stats["agg"] = _merge_agg(stats["agg"], stats2["agg"])
+            for f in ("rounds", "dropped", "migrated", "adopted"):
+                stats[f] += stats2[f]
+            for f in ("per_chip_segments", "per_chip_crossings"):
+                stats[f] = [
+                    x + y for x, y in zip(stats[f], stats2[f])
+                ]
+            n_lost = stats2["agg"]["truncated"]
+        if self._prev_even is not None and not initial:
+            # sd_mode="batch": ONE squared per-move delta, folded after
+            # any escalation re-walks so the move's full bin total (not
+            # per-attempt splits) enters slot 1 — trailing-axis stride-2,
+            # elementwise per chip; guest scores are already on owner
+            # rows (halo rows zeroed) when each step returns.
+            self.flux_slabs, self._prev_even = accumulate_batch_squares(
+                self.flux_slabs, self._prev_even
+            )
+        if n_re or n_lost:
+            self._telemetry.record_rewalk(
+                self.iter_count + (0 if initial else 1), n_re, n_lost
+            )
+        if self.config.record_xpoints is not None:
+            # Full host order; parked lanes record nothing (count 0).
+            xp = np.zeros(
+                (n, int(self.config.record_xpoints), 3), np.float64
+            )
+            counts = np.zeros(n, np.int32)  # PumiTally contract dtype
+            xp[moving] = got["xpoints"]
+            counts[moving] = got["n_xpoints"]
+            self._last_xpoints = (xp, counts)
+        if n_lost:
+            warnings.warn(
+                f"{n_lost} partitioned walk(s) truncated (max_crossings="
+                f"{self._step_kwargs['max_crossings']} or the migration "
+                "round bound); tallies for them are incomplete. Raise "
+                "TallyConfig.max_crossings / max_rounds or set "
+                "truncation_retries for bounded re-walk escalation.",
+                RuntimeWarning,
+                stacklevel=4,
+            )
+        return got, moving, stats
+
+    def _walk_once(self, dest, moving, weight, group, initial):
+        """One distribute → partitioned step → collect/fold pass over
+        the ``moving`` subset (the pre-escalation ``_run_inner`` body)."""
         placed = distribute_particles(
             self.partition,
             self.device_mesh,
@@ -263,13 +397,6 @@ class PartitionedTally:
             self.flux_slabs,
         )
         self.flux_slabs = res.flux
-        if self._prev_even is not None and not initial:
-            # Trailing-axis stride-2 fold — elementwise per chip, the
-            # guest scores are already on owner rows (halo rows zeroed)
-            # when the step returns.
-            self.flux_slabs, self._prev_even = accumulate_batch_squares(
-                self.flux_slabs, self._prev_even
-            )
         got = collect_by_particle_id(
             res, int(moving.sum()), self.partition
         )
@@ -303,29 +430,7 @@ class PartitionedTally:
         }
         self.total_segments += agg["segments"]
         self.total_rounds += n_rounds
-        if self.config.record_xpoints is not None:
-            # Full host order; parked lanes record nothing (count 0).
-            n = self.num_particles
-            xp = np.zeros(
-                (n, int(self.config.record_xpoints), 3), np.float64
-            )
-            counts = np.zeros(n, np.int32)  # PumiTally contract dtype
-            xp[moving] = got["xpoints"]
-            counts[moving] = got["n_xpoints"]
-            self._last_xpoints = (xp, counts)
-        # Truncation count from the on-device stats vector (valid slots
-        # not done — the same population as a host scan of got["done"]).
-        n_lost = agg["truncated"]
-        if n_lost:
-            warnings.warn(
-                f"{n_lost} partitioned walk(s) truncated (max_crossings="
-                f"{self._step_kwargs['max_crossings']} or the migration "
-                "round bound); tallies for them are incomplete. Raise "
-                "TallyConfig.max_crossings / max_rounds.",
-                RuntimeWarning,
-                stacklevel=4,
-            )
-        return got, moving, stats
+        return got, stats
 
     # ------------------------------------------------------------------ #
     def initialize_particle_location(
@@ -340,11 +445,14 @@ class PartitionedTally:
         if size is None:
             size = pos.size
         assert size == n * 3
-        self._check_finite("init_particle_positions", pos)
-        dest = pos[:size].reshape(-1, 3)
+        flags = np.ones(n, np.int8)
+        dest, qmask = self._quarantine(pos[:size].reshape(-1, 3), None, 0)
+        if qmask is not None:
+            flags[qmask] = 0  # masked lanes stay at the seed
+        self._check_finite("init_particle_positions", dest)
         self._run(
             dest,
-            np.ones(n, np.int8),
+            flags,
             np.ones(n),
             np.zeros(n, np.int32),
             initial=True,
@@ -379,12 +487,23 @@ class PartitionedTally:
         weights_h = np.asarray(weights, np.float64).reshape(-1)[:n]
         groups_h = np.asarray(groups, np.int32).reshape(-1)[:n]
         _check_group_range(groups_h, self.config.n_groups)
-        self._check_finite("particle_destinations", dest_flat)
+        fly = flying_flat[:n]
+        dest = dest_flat[: n * 3].reshape(n, 3)
+        if self.config.quarantine:
+            # weights_h may alias the caller's array; sanitize must not
+            # write through it (and a supervisor retry must re-see the
+            # original destinations, so dest is staged via a copy too).
+            weights_h = weights_h.copy()
+            dest, qmask = self._quarantine(
+                dest, weights_h, self.iter_count + 1
+            )
+            if qmask is not None:
+                fly = np.where(qmask, np.int8(0), fly)
+        self._check_finite("particle_destinations", dest)
         self._check_finite("weights", weights_h)
 
-        dest = dest_flat[: n * 3].reshape(n, 3)
         got, moving = self._run(
-            dest, flying_flat[:n], weights_h, groups_h, initial=False
+            dest, fly, weights_h, groups_h, initial=False
         )
         self.iter_count += 1
         self.tally_times.n_moves += 1

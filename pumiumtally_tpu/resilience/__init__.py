@@ -1,0 +1,58 @@
+"""Resilience subsystem: survive preemption, bad particles, and bit-rot.
+
+Production-scale Monte Carlo campaigns on preemptible TPU fleets need
+what the reference library lacks entirely (SURVEY.md §5 "Checkpoint /
+resume. Absent."; PUMI-Tally arXiv:2504.19048 treats checkpoint/restart
+as first-class):
+
+  * ``CheckpointStore`` — rotating generations of durable checkpoints
+    (atomic tmp+fsync+rename writes, per-array sha256 verified on
+    load, keep-N rotation, corrupt-generation fallback);
+  * ``ResilientRunner`` — the run supervisor: auto-checkpoint every K
+    moves / T seconds, SIGTERM/SIGINT preemption flush, startup
+    auto-resume, bounded exponential-backoff retry of transient move
+    failures;
+  * ``quarantine`` — bad-particle masking (``TallyConfig(quarantine=
+    True)``): non-finite / out-of-mesh inputs are parked and counted
+    instead of raising or poisoning the additive flux;
+  * ``faultinject`` — the ``PUMI_TPU_FAULTS`` harness that proves each
+    failure mode recovers (NaN sources, kill-at-move, transient device
+    errors, checkpoint corruption).
+
+Truncated-walk escalation (re-walk only the truncated lanes with a
+doubled crossing budget before declaring them lost) lives with the
+kernels — ``ops/walk.py rewalk_truncated`` — and is switched by
+``TallyConfig(truncation_retries=N)``.
+"""
+from .faultinject import (
+    FaultInjector,
+    FaultPlan,
+    InjectedFault,
+    InjectedKill,
+    InjectedTransientFault,
+    parse_faults,
+    plan_from_env,
+)
+from .quarantine import (
+    REASONS as QUARANTINE_REASONS,
+    QuarantineReport,
+    inflated_bounds,
+)
+from .runner import RETRYABLE, ResilientRunner
+from .store import CheckpointStore
+
+__all__ = [
+    "CheckpointStore",
+    "ResilientRunner",
+    "RETRYABLE",
+    "FaultInjector",
+    "FaultPlan",
+    "InjectedFault",
+    "InjectedKill",
+    "InjectedTransientFault",
+    "parse_faults",
+    "plan_from_env",
+    "QuarantineReport",
+    "QUARANTINE_REASONS",
+    "inflated_bounds",
+]

@@ -1,0 +1,179 @@
+"""Fault-injection harness: prove each failure mode recovers.
+
+A resilience subsystem that is only exercised by real preemptions is
+untested code on the critical path. This module injects the failure
+modes the ``ResilientRunner`` claims to survive, deterministically,
+from one env knob::
+
+    PUMI_TPU_FAULTS=nan_src:0.01,die_at_move:3,corrupt_ckpt
+
+Grammar: comma-separated ``name[:value]`` clauses —
+
+  ``nan_src:P``           each move, each lane's destination is NaN'd
+                          with probability P (deterministic per
+                          (seed, move) — replays reproduce the faults);
+  ``die_at_move:K``       the K-th facade move (1-based over the run,
+                          i.e. ``iter_count + 1 == K``) raises
+                          ``InjectedKill`` BEFORE the walk runs — a
+                          preemption mid-campaign. Fires once per
+                          injector (the resumed process is a new one);
+  ``transient_at_move:K`` the K-th move raises
+                          ``InjectedTransientFault`` once — the
+                          retry-with-backoff path must absorb it;
+  ``corrupt_ckpt``        every checkpoint the supervisor writes is
+                          bit-flipped right after the write — the
+                          ``find_latest`` fallback must skip it;
+  ``seed:S``              rng seed for nan_src lane choice (default 0).
+
+The injector is a no-op when the plan is empty, so production code can
+call its hooks unconditionally.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+
+import numpy as np
+
+
+class InjectedFault(RuntimeError):
+    """Base class for injected failures."""
+
+
+class InjectedKill(InjectedFault):
+    """Simulated preemption: NOT retryable — the supervisor must let it
+    propagate (the process is 'dead'); recovery is the next process's
+    auto-resume."""
+
+
+class InjectedTransientFault(InjectedFault):
+    """Simulated transient device/runtime error: retryable — the
+    supervisor's backoff path must absorb it."""
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    nan_src: float = 0.0
+    die_at_move: int | None = None
+    transient_at_move: int | None = None
+    corrupt_ckpt: bool = False
+    seed: int = 0
+
+    def any(self) -> bool:
+        return bool(
+            self.nan_src
+            or self.die_at_move is not None
+            or self.transient_at_move is not None
+            or self.corrupt_ckpt
+        )
+
+
+def parse_faults(spec: str) -> FaultPlan:
+    """Parse the ``PUMI_TPU_FAULTS`` grammar (module docstring). Raises
+    ``ValueError`` on unknown clauses or malformed values — a typo'd
+    fault spec silently injecting nothing would defeat the tests."""
+    fields: dict = {}
+    for clause in filter(None, (c.strip() for c in spec.split(","))):
+        name, _, value = clause.partition(":")
+        if name == "nan_src":
+            fields["nan_src"] = float(value)
+            if not 0.0 <= fields["nan_src"] <= 1.0:
+                raise ValueError(
+                    f"nan_src must be a probability: {value!r}"
+                )
+        elif name == "die_at_move":
+            fields["die_at_move"] = int(value)
+        elif name == "transient_at_move":
+            fields["transient_at_move"] = int(value)
+        elif name == "corrupt_ckpt":
+            if value:
+                raise ValueError("corrupt_ckpt takes no value")
+            fields["corrupt_ckpt"] = True
+        elif name == "seed":
+            fields["seed"] = int(value)
+        else:
+            raise ValueError(
+                f"unknown fault {name!r} in PUMI_TPU_FAULTS "
+                f"(known: nan_src, die_at_move, transient_at_move, "
+                f"corrupt_ckpt, seed)"
+            )
+    return FaultPlan(**fields)
+
+
+def plan_from_env() -> FaultPlan:
+    return parse_faults(os.environ.get("PUMI_TPU_FAULTS", ""))
+
+
+class FaultInjector:
+    """Stateful per-process injector over a FaultPlan.
+
+    ``die_at_move`` / ``transient_at_move`` fire at most once per
+    injector instance — the model is one failure per process life, and
+    a resumed run constructs a fresh injector (usually with a fresh
+    env)."""
+
+    def __init__(self, plan: FaultPlan | None = None):
+        self.plan = plan if plan is not None else plan_from_env()
+        self._died = False
+        self._transient_fired = False
+
+    # ------------------------------------------------------------------ #
+    def maybe_die(self, move: int) -> None:
+        if (
+            self.plan.die_at_move is not None
+            and move == self.plan.die_at_move
+            and not self._died
+        ):
+            self._died = True
+            raise InjectedKill(
+                f"injected preemption at move {move} "
+                f"(PUMI_TPU_FAULTS die_at_move)"
+            )
+
+    def maybe_transient(self, move: int) -> None:
+        if (
+            self.plan.transient_at_move is not None
+            and move == self.plan.transient_at_move
+            and not self._transient_fired
+        ):
+            self._transient_fired = True
+            raise InjectedTransientFault(
+                f"injected transient device error at move {move} "
+                f"(PUMI_TPU_FAULTS transient_at_move)"
+            )
+
+    def corrupt_destinations(self, dest, move: int) -> int:
+        """NaN destination lanes IN PLACE with probability ``nan_src``,
+        deterministically per (seed, move). ``dest`` must be the
+        caller's float64 destination buffer (an out-param — the facade
+        overwrites it at copy-back). Returns the lane count hit."""
+        p = self.plan.nan_src
+        if not p:
+            return 0
+        d = np.asarray(dest)
+        if d.dtype != np.float64:
+            # asarray would silently copy, NaN the copy, and report
+            # lanes the caller's buffer never saw — refuse instead.
+            raise TypeError(
+                "nan_src needs the float64 destination out-param "
+                f"buffer (in-place injection); got dtype {d.dtype}"
+            )
+        d = d.reshape(-1, 3)
+        rng = np.random.default_rng([self.plan.seed, int(move)])
+        bad = rng.random(d.shape[0]) < p
+        d[bad] = np.nan
+        return int(bad.sum())
+
+    def corrupt_file(self, path: str) -> bool:
+        """``corrupt_ckpt``: flip bytes in the middle of the file (past
+        the zip header, inside a compressed member) so the container
+        still opens but the payload fails its digest/CRC."""
+        if not self.plan.corrupt_ckpt:
+            return False
+        size = os.path.getsize(path)
+        with open(path, "r+b") as f:
+            f.seek(size // 2)
+            chunk = f.read(16)
+            f.seek(size // 2)
+            f.write(bytes(b ^ 0xFF for b in chunk))
+        return True

@@ -1,0 +1,158 @@
+"""Bad-particle quarantine: mask poisonous inputs out of the walk.
+
+The flux accumulator is additive — ONE NaN source particle scattered
+into it poisons every later read of its bins, and the facades' only
+defenses today are all-or-nothing: ``checkify_invariants`` raises
+(killing a multi-hour run for one bad lane) or the garbage scores.
+Production MC practice (PUMI-Tally, arXiv:2504.19048 §its degraded-mode
+notes) wants the third option: park the bad lane, keep the run, report.
+
+With ``TallyConfig(quarantine=True)`` both facades scan each call's
+host inputs BEFORE anything reaches the device:
+
+  * non-finite destination coordinates (``nonfinite_dest``),
+  * non-finite statistical weights (``nonfinite_weight``),
+  * destinations absurdly far outside the mesh — beyond the bounding
+    box inflated by one diagonal (``out_of_mesh``; legitimate
+    out-of-domain destinations that merely clip at the boundary pass).
+
+Quarantined lanes are parked exactly like ``flying=0`` lanes: not
+walked, not scored, position held, and the caller's out-params get the
+held position back. Counts flow per-lane (``tally.quarantined_lanes``)
+and per-reason into the obs registry (``pumi_quarantined_lanes_total``)
+and ``telemetry()["quarantined"]``.
+
+Host-side glue on the facade path — one vectorized isfinite/compare
+pass over arrays the facade already touches; the device hot path pays
+nothing.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+REASONS = ("nonfinite_dest", "nonfinite_weight", "out_of_mesh")
+
+
+@dataclasses.dataclass
+class QuarantineReport:
+    """One call's quarantine verdicts.
+
+    mask: [n] bool — True where the lane must be parked this move.
+    reasons: reason name → lane count (a lane bad for several reasons
+      counts once per reason; ``count`` deduplicates).
+    """
+
+    mask: np.ndarray
+    reasons: dict
+
+    @property
+    def count(self) -> int:
+        return int(self.mask.sum())
+
+
+def inflated_bounds(coords) -> tuple[np.ndarray, np.ndarray]:
+    """Mesh bounding box inflated by one diagonal on every side — the
+    out-of-mesh threshold. Anything a caller legitimately sends (even
+    destinations that overshoot the domain and clip at the boundary)
+    lands well inside; only garbage coordinates land outside."""
+    c = np.asarray(coords, np.float64)
+    lo, hi = c.min(axis=0), c.max(axis=0)
+    diag = float(np.linalg.norm(hi - lo)) or 1.0
+    return lo - diag, hi + diag
+
+
+def scan(
+    dest3: np.ndarray,
+    weights: np.ndarray | None,
+    bounds: tuple[np.ndarray, np.ndarray],
+) -> QuarantineReport | None:
+    """Scan one call's inputs; returns None when everything is clean
+    (the common case allocates nothing beyond the finite checks).
+    ``weights`` is None on the initial location search (nothing is
+    scored there, so only the coordinates can poison anything)."""
+    lo, hi = bounds
+    finite_dest = np.isfinite(dest3).all(axis=1)
+    bad_dest = ~finite_dest
+    bad_w = (
+        ~np.isfinite(np.asarray(weights))
+        if weights is not None
+        else np.zeros(dest3.shape[0], bool)
+    )
+    oob = finite_dest & (
+        (dest3 < lo) | (dest3 > hi)
+    ).any(axis=1)
+    mask = bad_dest | bad_w | oob
+    if not mask.any():
+        return None
+    return QuarantineReport(
+        mask=mask,
+        reasons={
+            "nonfinite_dest": int(bad_dest.sum()),
+            "nonfinite_weight": int(bad_w.sum()),
+            "out_of_mesh": int(oob.sum()),
+        },
+    )
+
+
+def sanitize(
+    report: QuarantineReport,
+    dest3: np.ndarray,
+    weights: np.ndarray | None,
+) -> None:
+    """Overwrite quarantined rows with inert finite values IN PLACE so
+    nothing non-finite ever reaches a device array (NaNs on parked
+    lanes are provably inert in the walk, but keeping device state
+    finite makes checkpoints and ``checkify_invariants`` compose).
+    Both arrays must be facade STAGING COPIES, never the caller's
+    buffers — a supervisor retrying the move must re-see the original
+    bad inputs, not the sanitized ones (resilience/runner.py)."""
+    dest3[report.mask] = 0.0
+    if weights is not None:
+        weights[report.mask] = 0.0
+
+
+def setup(tally, coords, num_particles: int) -> None:
+    """Constructor hook shared by both facades
+    (``TallyConfig.quarantine``): the out-of-mesh threshold and the
+    per-lane count array live on the tally; the logic lives here once."""
+    tally._qbounds = inflated_bounds(coords)
+    tally._quarantined = np.zeros(int(num_particles), np.int64)
+
+
+def lanes(tally) -> np.ndarray:
+    """``quarantined_lanes()`` body shared by both facades: cumulative
+    per-lane counts, host pid order."""
+    if tally._quarantined is None:
+        raise ValueError(
+            "set TallyConfig(quarantine=True) to track quarantined "
+            "lanes (off by default: parity runs fail loudly)"
+        )
+    return tally._quarantined.copy()
+
+
+def apply(tally, dest3, weights, move):
+    """The shared facade entry point (PumiTally and PartitionedTally
+    delegate here so the quarantine semantics cannot drift): scan one
+    call's inputs against ``tally._qbounds``; on a hit, sanitize a
+    STAGING COPY of ``dest3`` (the caller's buffer keeps its original
+    values until the facade's own copy-back), fold per-lane counts into
+    ``tally._quarantined`` and the telemetry counters.
+
+    ``weights`` must already be a facade copy (sanitized in place) or
+    None. Returns ``(dest3_for_staging, mask_or_None)``.
+
+    Counter semantics under the supervisor's transient retry: the
+    per-lane ``_quarantined`` array is part of the resumable state and
+    rolls back with it, while the registry counters are monotonic event
+    counts (a retried scan records again — standard counter practice).
+    """
+    rep = scan(dest3, weights, tally._qbounds)
+    if rep is None:
+        return dest3, None
+    dest3 = dest3.copy()
+    sanitize(rep, dest3, weights)
+    tally._quarantined += rep.mask
+    tally._telemetry.record_quarantine(move, rep.count, rep.reasons)
+    return dest3, rep.mask

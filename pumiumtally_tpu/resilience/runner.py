@@ -1,0 +1,351 @@
+"""ResilientRunner: the auto-checkpointing run supervisor.
+
+Wraps a ``PumiTally`` or ``PartitionedTally`` behind the same
+``initialize_particle_location`` / ``move_to_next_location`` surface and
+adds the fault-tolerance loop production preemptible fleets need
+(ROADMAP north star; the reference library has none, SURVEY.md §5):
+
+  * **auto-checkpoint** every ``every_moves`` moves or
+    ``every_seconds`` seconds into a rotating ``CheckpointStore``
+    (atomic writes, per-array sha256, keep-N);
+  * **auto-resume**: construction restores the newest VALID generation
+    (corrupt ones are skipped) and the driver replays from
+    ``tally.iter_count`` — a replayed run is bitwise identical to an
+    uninterrupted one because checkpoint round-trips are exact;
+  * **preemption flush**: SIGTERM/SIGINT trigger one final checkpoint
+    before the process dies, so at most the in-flight move is lost;
+  * **transient retry**: a retryable error from a move (injected
+    transients, JAX runtime errors) rolls the tally back to the last
+    good in-memory snapshot and retries with exponential backoff,
+    bounded by ``max_retries``;
+  * **fault injection**: every hook of ``faultinject.py`` threads
+    through here, so the tests can prove each failure mode recovers.
+
+Driver shape (the resume-aware loop)::
+
+    t = PumiTally(mesh, n, TallyConfig(quarantine=True))
+    with ResilientRunner(t, "ckpts/", every_moves=25) as run:
+        run.initialize_particle_location(pos)   # no-op after a resume
+        for i in range(1, n_moves + 1):
+            if t.iter_count >= i:
+                continue                         # already replayed
+            run.move_to_next_location(*inputs(i))
+"""
+from __future__ import annotations
+
+import signal
+import time
+
+import numpy as np
+
+from ..utils.checkpoint import restore_state, snapshot_state
+from ..utils.log import log_info, log_warn
+from .faultinject import (
+    FaultInjector,
+    InjectedTransientFault,
+)
+from .store import CheckpointStore
+
+try:  # pragma: no cover - depends on installed jax
+    from jax.errors import JaxRuntimeError as _JaxRuntimeError
+except ImportError:  # pragma: no cover
+    class _JaxRuntimeError(Exception):
+        """Placeholder when jax.errors lacks JaxRuntimeError."""
+
+
+#: Error types a move retry can plausibly fix: injected transients and
+#: JAX runtime errors (preempted device, RESOURCE_EXHAUSTED, collective
+#: timeouts). Anything else — including InjectedKill — propagates.
+RETRYABLE = (InjectedTransientFault, _JaxRuntimeError)
+
+
+class ResilientRunner:
+    def __init__(
+        self,
+        tally,
+        store: CheckpointStore | str,
+        *,
+        every_moves: int | None = 25,
+        every_seconds: float | None = None,
+        keep: int = 3,
+        max_retries: int = 3,
+        backoff_base: float = 0.25,
+        backoff_max: float = 8.0,
+        resume: bool = True,
+        handle_signals: bool = True,
+        retry_snapshots: bool = True,
+        faults: FaultInjector | None = None,
+        sleep=time.sleep,
+    ):
+        self.tally = tally
+        self.store = (
+            store if isinstance(store, CheckpointStore)
+            else CheckpointStore(store, keep=keep)
+        )
+        self.every_moves = every_moves
+        self.every_seconds = every_seconds
+        self.max_retries = int(max_retries)
+        self.backoff_base = float(backoff_base)
+        self.backoff_max = float(backoff_max)
+        # The retry anchor costs one full host readback of the flux (+
+        # global assembly on the partitioned facade) per move. That is
+        # the price of exact transient-rollback; production runs that
+        # would rather lose the window since the last on-disk
+        # generation can turn it off — transient errors then propagate
+        # like any other (the next process auto-resumes).
+        self.retry_snapshots = bool(retry_snapshots)
+        self.faults = faults if faults is not None else FaultInjector()
+        self._sleep = sleep
+        self._prev_handlers: dict = {}
+        self._in_move = False
+        self._pending_signal: int | None = None
+        r = tally.metrics
+        self._c_ckpt = r.counter(
+            "pumi_checkpoints_total",
+            "checkpoint generations written by the supervisor",
+        )
+        self._c_retry = r.counter(
+            "pumi_move_retries_total",
+            "transient move failures retried by the supervisor",
+        )
+        self._c_resume = r.counter(
+            "pumi_resumes_total",
+            "startup auto-resumes from a checkpoint generation",
+        )
+        self._c_fault = r.counter(
+            "pumi_injected_faults_total",
+            "faults injected through PUMI_TPU_FAULTS (labeled by kind)",
+        )
+
+        self.resumed_from: int | None = None
+        if resume:
+            it = self.store.restore_latest(tally)
+            if it is not None:
+                self.resumed_from = it
+                self._c_resume.inc()
+        # Last good state: the transient-retry anchor. Taken whenever
+        # the tally holds a consistent post-move (or restored) state.
+        self._good = (
+            snapshot_state(tally) if self._want_snapshot() else None
+        )
+        self._last_ckpt_iter = tally.iter_count
+        self._last_ckpt_time = time.monotonic()
+        if handle_signals:
+            self._install_signal_handlers()
+
+    # ------------------------------------------------------------------ #
+    # Facade surface
+    # ------------------------------------------------------------------ #
+    def initialize_particle_location(self, positions, size=None) -> None:
+        """Delegates the initial parent-element search; after a resume
+        this is a NO-OP (the restored state already holds located
+        particles — re-searching would clobber it), so drivers can call
+        it unconditionally."""
+        if self.resumed_from is not None and self.tally._initialized:
+            log_info(
+                "initialize_particle_location skipped: resumed from "
+                f"iteration {self.resumed_from}"
+            )
+            return
+        self.tally.initialize_particle_location(positions, size)
+        if self._want_snapshot():
+            self._good = snapshot_state(self.tally)
+        # Generation 0: guarantees auto-resume has a base to fall back
+        # to even if the run dies before the first cadence checkpoint.
+        self.checkpoint()
+
+    def move_to_next_location(
+        self, particle_destinations, flying, weights, groups,
+        material_ids, size=None,
+    ) -> None:
+        move = self.tally.iter_count + 1
+        self.faults.maybe_die(move)
+        n_nan = self.faults.corrupt_destinations(
+            particle_destinations, move
+        )
+        if n_nan:
+            self._c_fault.inc(n_nan, kind="nan_src")
+        self._in_move = True
+        try:
+            self._move_with_retry(
+                move, particle_destinations, flying, weights, groups,
+                material_ids, size,
+            )
+            if self._want_snapshot():
+                self._good = snapshot_state(self.tally)
+            self._maybe_checkpoint()
+        finally:
+            self._in_move = False
+            if self._pending_signal is not None:
+                # A preemption signal landed mid-move: flush and die at
+                # the move boundary — whether the move completed (a
+                # consistent post-move state) or raised (the last good
+                # generation still stands). Swallowing the signal on
+                # the error path would leave a process that ignores
+                # SIGTERM forever.
+                sig, self._pending_signal = self._pending_signal, None
+                self._on_signal(sig, None)
+
+    def _move_with_retry(
+        self, move, particle_destinations, flying, weights, groups,
+        material_ids, size,
+    ) -> None:
+        attempt = 0
+        # The facade mutates the caller's out-params (copy-back writes
+        # dest/material_ids, zeroes flying) BEFORE its last device
+        # fetches can fail — a retry must re-see the ORIGINAL inputs or
+        # it would walk zero particles and silently drop the move.
+        saved = (
+            tuple(
+                np.array(a, copy=True)
+                for a in (particle_destinations, flying, material_ids)
+            )
+            if self._good is not None
+            else None
+        )
+        while True:
+            try:
+                self.faults.maybe_transient(move)
+                self.tally.move_to_next_location(
+                    particle_destinations, flying, weights, groups,
+                    material_ids, size,
+                )
+                break
+            except RETRYABLE as e:
+                attempt += 1
+                if isinstance(e, InjectedTransientFault):
+                    self._c_fault.inc(kind="transient")
+                if attempt > self.max_retries or self._good is None:
+                    # No anchor to roll back to (retry_snapshots off,
+                    # or nothing completed yet): an in-place retry
+                    # could silently run on a donated/half-updated
+                    # accumulator — propagate instead; the next
+                    # process's auto-resume is the recovery path.
+                    raise
+                self._c_retry.inc()
+                delay = min(
+                    self.backoff_base * 2 ** (attempt - 1),
+                    self.backoff_max,
+                )
+                log_warn(
+                    f"move {move} failed transiently ({e}); restoring "
+                    f"last good state and retrying in {delay:.2f}s "
+                    f"(attempt {attempt}/{self.max_retries})"
+                )
+                restore_state(self.tally, self._good)
+                for dst, src in zip(
+                    (particle_destinations, flying, material_ids),
+                    saved, strict=True,
+                ):
+                    np.copyto(np.asarray(dst), src)
+                self._sleep(delay)
+
+    def _want_snapshot(self) -> bool:
+        return (
+            self.retry_snapshots
+            and self.max_retries > 0
+            and self.tally._initialized
+        )
+
+    # ------------------------------------------------------------------ #
+    # Checkpointing
+    # ------------------------------------------------------------------ #
+    def checkpoint(self) -> str:
+        """Write one generation now (cadence-independent)."""
+        path = self.store.save(self.tally)
+        if self.faults.corrupt_file(path):
+            self._c_fault.inc(kind="corrupt_ckpt")
+        self._c_ckpt.inc()
+        self._last_ckpt_iter = self.tally.iter_count
+        self._last_ckpt_time = time.monotonic()
+        return path
+
+    def _maybe_checkpoint(self) -> None:
+        due = (
+            self.every_moves is not None
+            and self.tally.iter_count - self._last_ckpt_iter
+            >= self.every_moves
+        ) or (
+            self.every_seconds is not None
+            and time.monotonic() - self._last_ckpt_time
+            >= self.every_seconds
+        )
+        if due:
+            self.checkpoint()
+
+    # ------------------------------------------------------------------ #
+    # Preemption handling
+    # ------------------------------------------------------------------ #
+    def _install_signal_handlers(self) -> None:
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                self._prev_handlers[sig] = signal.signal(
+                    sig, self._on_signal
+                )
+            except ValueError:
+                # Not the main thread: signal delivery belongs to the
+                # embedding application; the cadence checkpoints still
+                # bound the loss window.
+                log_warn(
+                    "ResilientRunner: cannot install signal handlers "
+                    "outside the main thread; preemption flush disabled"
+                )
+                return
+
+    def _uninstall_signal_handlers(self) -> None:
+        for sig, prev in self._prev_handlers.items():
+            signal.signal(sig, prev)
+        self._prev_handlers = {}
+
+    def _on_signal(self, signum, frame) -> None:
+        """Preemption flush: one final checkpoint, then die the way the
+        process would have died without us. Mid-move delivery defers to
+        the move boundary so the flushed generation is consistent."""
+        if self._in_move:
+            self._pending_signal = signum
+            return
+        try:
+            path = self.checkpoint()
+            log_info(
+                f"preemption flush: checkpoint {path} written on "
+                f"signal {signum}"
+            )
+        except Exception as e:  # pragma: no cover - flush best-effort
+            log_warn(f"preemption flush failed: {e}")
+        prev = self._prev_handlers.get(signum)
+        self._uninstall_signal_handlers()
+        if callable(prev):
+            prev(signum, frame)
+        elif prev == signal.SIG_IGN:
+            return
+        else:
+            raise SystemExit(128 + signum)
+
+    # ------------------------------------------------------------------ #
+    def close(self, final_checkpoint: bool = True) -> None:
+        """Flush a final generation (when anything advanced since the
+        last one) and release the signal handlers."""
+        if final_checkpoint and self.tally._initialized and (
+            self.tally.iter_count != self._last_ckpt_iter
+            or not self.store.entries()
+        ):
+            self.checkpoint()
+        self._uninstall_signal_handlers()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        # On an exception the tally state may be mid-move; the cadence
+        # checkpoints are the trustworthy generations — flush only on
+        # clean exit.
+        self.close(final_checkpoint=exc_type is None)
+        return False
+
+    # ------------------------------------------------------------------ #
+    def __getattr__(self, name):
+        """Everything else (telemetry, write_pumi_tally_mesh, raw_flux,
+        ...) passes through to the wrapped tally."""
+        if name == "tally":  # guard pre-__init__ access recursion
+            raise AttributeError(name)
+        return getattr(self.tally, name)

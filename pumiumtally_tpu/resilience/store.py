@@ -1,0 +1,127 @@
+"""Rotating generations of durable checkpoints.
+
+One directory holds the run's checkpoint history as
+``ckpt-<iteration>.npz`` files (atomic writes + per-array sha256, see
+utils/checkpoint.py). The store keeps the newest ``keep`` generations,
+and ``find_latest``/``restore_latest`` walk newest→oldest SKIPPING
+corrupt files — a torn write or bit-rot in the newest generation falls
+back to the previous one instead of killing the resume. A genuinely
+mismatched checkpoint (wrong mesh/config) still raises: that is a
+caller bug, not corruption, and silently skipping it would resume the
+wrong run.
+"""
+from __future__ import annotations
+
+import os
+import re
+
+from ..utils.checkpoint import (
+    CheckpointIntegrityError,
+    verify_checkpoint,
+)
+from ..utils.log import log_info, log_warn
+
+_NAME_RE = re.compile(r"^(?P<prefix>.+)-(?P<it>\d+)\.npz$")
+
+
+class CheckpointStore:
+    def __init__(self, directory: str, keep: int = 3,
+                 prefix: str = "ckpt"):
+        if keep < 1:
+            raise ValueError(f"keep must be >= 1, got {keep}")
+        self.directory = directory
+        self.keep = int(keep)
+        self.prefix = prefix
+        os.makedirs(directory, exist_ok=True)
+        self._sweep_orphaned_tmp()
+
+    def _sweep_orphaned_tmp(self) -> None:
+        """A SIGKILL/power-loss mid-write leaves atomic_savez's temp
+        file behind (in-process cleanup never ran); rotation ignores
+        non-generation names, so sweep them here or they accumulate
+        forever across preemption cycles."""
+        for name in os.listdir(self.directory):
+            if name.startswith(f"{self.prefix}-") and ".tmp-" in name:
+                try:
+                    os.unlink(os.path.join(self.directory, name))
+                except OSError:
+                    pass
+
+    # ------------------------------------------------------------------ #
+    def path_for(self, iteration: int) -> str:
+        return os.path.join(
+            self.directory, f"{self.prefix}-{int(iteration):08d}.npz"
+        )
+
+    def entries(self) -> list[tuple[int, str]]:
+        """(iteration, path) pairs sorted oldest→newest."""
+        out = []
+        for name in os.listdir(self.directory):
+            m = _NAME_RE.match(name)
+            if m and m.group("prefix") == self.prefix:
+                out.append(
+                    (int(m.group("it")),
+                     os.path.join(self.directory, name))
+                )
+        return sorted(out)
+
+    # ------------------------------------------------------------------ #
+    def save(self, tally) -> str:
+        """Write the tally's checkpoint as the next generation
+        (``ckpt-<iter_count>.npz``) and rotate old generations out."""
+        path = self.path_for(tally.iter_count)
+        tally.save_checkpoint(path)
+        self._rotate()
+        return path
+
+    def _rotate(self) -> None:
+        for _, path in self.entries()[: -self.keep]:
+            try:
+                os.unlink(path)
+            except OSError as e:
+                log_warn(
+                    f"checkpoint rotation could not remove {path}: {e}"
+                )
+
+    # ------------------------------------------------------------------ #
+    def find_latest(self) -> tuple[int, str] | None:
+        """Newest generation that passes the integrity check; corrupt
+        files are skipped with a warning (the fallback contract). The
+        same mismatch-vs-corruption rule as ``restore_latest``: an
+        INTACT file of another format/shape raises instead of being
+        skipped, so the two lookups always agree on a directory."""
+        for it, path in reversed(self.entries()):
+            try:
+                verify_checkpoint(path)
+                return it, path
+            except CheckpointIntegrityError as e:
+                log_warn(f"skipping corrupt checkpoint {path}: {e}")
+            except ValueError:
+                raise
+            except Exception as e:
+                log_warn(f"skipping unreadable checkpoint {path}: {e}")
+        return None
+
+    def restore_latest(self, tally) -> int | None:
+        """Restore the newest VALID generation into ``tally``; returns
+        its iteration, or None when no restorable generation exists.
+        Corruption (bad container, failed digest) falls back to the
+        previous generation; a clean-but-mismatched checkpoint raises —
+        see the module docstring for why the two differ."""
+        for it, path in reversed(self.entries()):
+            try:
+                tally.restore_checkpoint(path)
+                log_info(
+                    f"resumed from checkpoint {path}", iteration=it
+                )
+                return it
+            except CheckpointIntegrityError as e:
+                log_warn(f"skipping corrupt checkpoint {path}: {e}")
+            except ValueError:
+                # Intact but incompatible (mesh/dtype/shape): caller bug.
+                raise
+            except Exception as e:
+                # Unreadable container (truncated zip, zlib error, OS
+                # error): corruption by another name — fall back.
+                log_warn(f"skipping unreadable checkpoint {path}: {e}")
+        return None

@@ -6,17 +6,46 @@ checkpoint is exactly (flux accumulator, particle state, iteration counter).
 This module saves/restores that as a single compressed ``.npz`` with a mesh
 fingerprint so a checkpoint can never be resumed against a different mesh.
 
-Used by ``PumiTally.save_checkpoint`` / ``PumiTally.restore_checkpoint``;
-host-side glue, not a hot path.
+Durability contract (the resilience subsystem's foundation,
+``resilience/``):
+
+  * every write is ATOMIC — serialized to a same-directory temp file,
+    fsync'd, then ``os.replace``d over the target, so a crash or ENOSPC
+    mid-write can never leave a truncated ``.npz`` under the real name;
+  * every array carries a sha256 digest in the meta block, verified on
+    load BEFORE any tally state is overwritten (``verify_checkpoint`` /
+    ``CheckpointIntegrityError``), so silent bit-rot or a torn copy is
+    detected instead of resumed;
+  * restore validates format/kind/mesh/dtype/sd_mode/run-shape and
+    raises on any mismatch rather than silently resuming (or silently
+    CASTING — an f64 checkpoint into an f32 tally would lose the
+    precision contract) a different run.
+
+``snapshot_state``/``restore_state`` expose the same payload as
+in-memory host copies — the ``ResilientRunner``'s retry anchor, no
+serialization.
+
+Used by ``PumiTally.save_checkpoint`` / ``PumiTally.restore_checkpoint``
+(and the partitioned equivalents); host-side glue, not a hot path.
 """
 from __future__ import annotations
 
 import hashlib
 import json
+import os
+import tempfile
 
 import numpy as np
 
 FORMAT_VERSION = 1
+
+
+class CheckpointIntegrityError(ValueError):
+    """A checkpoint file failed its integrity check (truncated container,
+    missing array, or per-array sha256 mismatch). Distinct from the
+    plain ``ValueError`` of a *mismatched* (wrong mesh/config) but
+    intact checkpoint: the resilience layer skips corrupt generations
+    and falls back, while a genuine mismatch propagates to the caller."""
 
 
 def mesh_fingerprint(mesh) -> str:
@@ -31,50 +60,114 @@ def mesh_fingerprint(mesh) -> str:
     return h.hexdigest()
 
 
+def _array_digest(arr) -> str:
+    """sha256 over dtype + shape + raw bytes — the per-array integrity
+    unit stored in the meta block and re-checked on load."""
+    a = np.ascontiguousarray(np.asarray(arr))
+    h = hashlib.sha256()
+    h.update(str(a.dtype).encode())
+    h.update(str(a.shape).encode())
+    h.update(a.tobytes())
+    return h.hexdigest()
+
+
 def _normalize(filename: str) -> str:
     # np.savez_compressed silently appends ".npz"; normalize on both the
     # save and load side so any filename round-trips.
     return filename if filename.endswith(".npz") else filename + ".npz"
 
 
-def save_checkpoint(filename: str, tally) -> None:
-    """Serialize a PumiTally's resumable state."""
+def atomic_savez(filename: str, **arrays) -> str:
+    """``np.savez_compressed`` with crash-safe semantics: write to a
+    same-directory temp file, flush + fsync, then ``os.replace`` over
+    the target (and fsync the directory so the rename itself is
+    durable). A crash/ENOSPC at any point leaves either the old file or
+    nothing — never a truncated ``.npz`` under the real name."""
     filename = _normalize(filename)
-    s = tally.state
-    meta = {
-        "format_version": FORMAT_VERSION,
-        "mesh_fingerprint": mesh_fingerprint(tally.mesh),
-        "num_particles": tally.num_particles,
-        "n_groups": tally.config.n_groups,
-        "iter_count": tally.iter_count,
-        "total_segments": tally.total_segments,
-        "initialized": tally._initialized,
-        "dtype": str(np.dtype(tally.config.dtype)),
-        # Slot-1 statistic: per-segment squares vs per-move batch
-        # squares are NOT mixable — validated on restore.
-        "sd_mode": tally.config.sd_mode,
-    }
-    np.savez_compressed(
+    directory = os.path.dirname(os.path.abspath(filename)) or "."
+    fd, tmp = tempfile.mkstemp(
+        dir=directory, prefix=os.path.basename(filename) + ".tmp-"
+    )
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez_compressed(f, **arrays)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, filename)
+        try:
+            dfd = os.open(directory, os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+        except OSError:
+            # Directory fsync is best-effort (unsupported on some
+            # filesystems); the data fsync + rename already rule out a
+            # truncated file.
+            pass
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return filename
+
+
+def _write_checkpoint(filename: str, meta: dict, arrays: dict) -> str:
+    meta = dict(
+        meta,
+        array_sha256={k: _array_digest(v) for k, v in arrays.items()},
+    )
+    return atomic_savez(
         filename,
         meta=np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8),
-        # Canonical on-disk shape is [ntet, n_groups, 2] regardless of the
-        # device layout (flat since round 4), so checkpoints stay portable
-        # across layout changes.
-        flux=np.asarray(tally.raw_flux),
-        origin=np.asarray(s.origin),
-        dest=np.asarray(s.dest),
-        elem=np.asarray(s.elem),
-        in_flight=np.asarray(s.in_flight),
-        weight=np.asarray(s.weight),
-        group=np.asarray(s.group),
-        material_id=np.asarray(s.material_id),
-        particle_id=np.asarray(s.particle_id),
-        perm=(
-            np.asarray(tally._perm)
-            if tally._perm is not None
-            else np.empty(0, np.int64)
-        ),
+        **arrays,
     )
+
+
+def _verify_integrity(arrays: dict, meta: dict, filename: str) -> None:
+    """Re-hash every loaded array against the meta block's digests
+    (arrays are hashed in memory — each member is decompressed exactly
+    once per restore). Pre-digest files (no ``array_sha256`` key) pass
+    — their container CRC is the only protection they ever had."""
+    digests = meta.get("array_sha256")
+    if digests is None:
+        return
+    for name, want in digests.items():
+        if name not in arrays:
+            raise CheckpointIntegrityError(
+                f"checkpoint {filename}: array {name!r} missing"
+            )
+        got = _array_digest(arrays[name])
+        if got != want:
+            raise CheckpointIntegrityError(
+                f"checkpoint {filename}: array {name!r} sha256 mismatch "
+                f"(stored {want[:12]}…, recomputed {got[:12]}…) — the "
+                "file is corrupt; falling back to an older generation "
+                "is the resilience layer's job (CheckpointStore)"
+            )
+
+
+def verify_checkpoint(filename: str) -> dict:
+    """Standalone integrity check: load the meta block and re-hash every
+    array. Returns the meta dict on success; raises
+    ``CheckpointIntegrityError`` (or the container's own zip/OS errors)
+    on corruption. Does not touch any tally."""
+    filename = _normalize(filename)
+    with np.load(filename) as z:
+        meta = json.loads(bytes(z["meta"].tobytes()).decode())
+        if meta.get("format_version") != FORMAT_VERSION:
+            # An intact file of another format is a MISMATCH, not
+            # corruption — plain ValueError, so CheckpointStore's
+            # lookup rules treat it exactly like restore would.
+            raise ValueError(
+                f"checkpoint {filename}: format "
+                f"{meta.get('format_version')} != {FORMAT_VERSION}"
+            )
+        arrays = {k: z[k] for k in z.files if k != "meta"}
+        _verify_integrity(arrays, meta, filename)
+    return meta
 
 
 def load_meta(filename: str) -> dict:
@@ -83,8 +176,8 @@ def load_meta(filename: str) -> dict:
 
 
 def _validate_meta(meta: dict, tally, expected_kind: str | None) -> None:
-    """Shared restore-side validation: format, kind, mesh identity, run
-    shape. Raises on any mismatch rather than silently resuming a
+    """Shared restore-side validation: format, kind, mesh identity, dtype,
+    run shape. Raises on any mismatch rather than silently resuming a
     different run (both facades)."""
     if meta["format_version"] != FORMAT_VERSION:
         raise ValueError(
@@ -101,6 +194,17 @@ def _validate_meta(meta: dict, tally, expected_kind: str | None) -> None:
         )
     if meta["mesh_fingerprint"] != mesh_fingerprint(tally.mesh):
         raise ValueError("checkpoint was written against a different mesh")
+    ck_dt = meta.get("dtype")
+    if ck_dt is not None and np.dtype(ck_dt) != np.dtype(
+        tally.config.dtype
+    ):
+        raise ValueError(
+            f"checkpoint dtype is {ck_dt} but this tally is configured "
+            f"dtype={np.dtype(tally.config.dtype)}; restoring would "
+            "silently cast the accumulator (e.g. f64 → f32 loses the "
+            "precision contract) — rebuild the tally with the "
+            "checkpoint's dtype"
+        )
     ck_sd = meta.get("sd_mode", "segment")  # pre-r5 files: segment
     if ck_sd != getattr(tally.config, "sd_mode", "segment"):
         raise ValueError(
@@ -120,50 +224,144 @@ def _validate_meta(meta: dict, tally, expected_kind: str | None) -> None:
         )
 
 
-def restore_checkpoint(filename: str, tally) -> None:
-    """Restore state saved by save_checkpoint into a PumiTally constructed
-    with the same mesh and config. Raises on any mismatch rather than
-    silently resuming a different run."""
+# --------------------------------------------------------------------- #
+# Plain (single-chip) facade payload
+# --------------------------------------------------------------------- #
+def _plain_payload(tally) -> tuple[dict, dict]:
+    s = tally.state
+    meta = {
+        "format_version": FORMAT_VERSION,
+        "mesh_fingerprint": mesh_fingerprint(tally.mesh),
+        "num_particles": tally.num_particles,
+        "n_groups": tally.config.n_groups,
+        "iter_count": tally.iter_count,
+        "total_segments": tally.total_segments,
+        "initialized": tally._initialized,
+        "dtype": str(np.dtype(tally.config.dtype)),
+        # Slot-1 statistic: per-segment squares vs per-move batch
+        # squares are NOT mixable — validated on restore.
+        "sd_mode": tally.config.sd_mode,
+        # Adaptive-replan state: compact_stages='adaptive' replans the
+        # ladder once from the FIRST move's measured stats; a resumed
+        # run must reuse that ladder, not replan from a later move's
+        # stats (different ladder -> different scatter grouping ->
+        # ~1e-15 flux drift, breaking the bitwise-resume guarantee).
+        "replanned": bool(tally._replanned),
+        "compact_stages_planned": (
+            [list(s) for s in tally._compact_stages]
+            if tally._replanned and tally._compact_stages is not None
+            else None
+        ),
+    }
+    arrays = {
+        # Canonical on-disk shape is [ntet, n_groups, 2] regardless of the
+        # device layout (flat since round 4), so checkpoints stay portable
+        # across layout changes.
+        #
+        # Every device-derived array is COPIED, never viewed:
+        # np.asarray of a jax array can be a zero-copy view of the
+        # device buffer on CPU, and the flux buffer is DONATED to the
+        # next trace — a viewed "snapshot" would silently morph into
+        # the post-move flux, doubling the move on a retry rollback
+        # (snapshot_state is the ResilientRunner's retry anchor).
+        "flux": np.array(tally.raw_flux, copy=True),
+        "origin": np.array(s.origin, copy=True),
+        "dest": np.array(s.dest, copy=True),
+        "elem": np.array(s.elem, copy=True),
+        "in_flight": np.array(s.in_flight, copy=True),
+        "weight": np.array(s.weight, copy=True),
+        "group": np.array(s.group, copy=True),
+        "material_id": np.array(s.material_id, copy=True),
+        "particle_id": np.array(s.particle_id, copy=True),
+        "perm": (
+            np.asarray(tally._perm)
+            if tally._perm is not None
+            else np.empty(0, np.int64)
+        ),
+        # Per-lane quarantine counts are resumable state: a resumed (or
+        # retry-rolled-back) run must not lose or double its degraded-
+        # mode report. Empty when the quarantine is off.
+        "quarantined": (
+            tally._quarantined.copy()
+            if getattr(tally, "_quarantined", None) is not None
+            else np.empty(0, np.int64)
+        ),
+    }
+    return meta, arrays
+
+
+def _apply_plain(tally, meta: dict, arrays: dict) -> None:
     import jax.numpy as jnp
 
+    dtype = tally.config.dtype
+    # Device accumulator is flat (api make_flux flat=True); accept
+    # both 3-D (canonical/older) and flat on-disk arrays.
+    tally.flux = jnp.asarray(arrays["flux"], dtype).reshape(-1)
+    tally.state = tally.state._replace(
+        origin=jnp.asarray(arrays["origin"], dtype),
+        dest=jnp.asarray(arrays["dest"], dtype),
+        elem=jnp.asarray(arrays["elem"], jnp.int32),
+        in_flight=jnp.asarray(arrays["in_flight"], bool),
+        weight=jnp.asarray(arrays["weight"], dtype),
+        group=jnp.asarray(arrays["group"], jnp.int32),
+        material_id=jnp.asarray(arrays["material_id"], jnp.int32),
+        particle_id=jnp.asarray(arrays["particle_id"], jnp.int32),
+    )
+    tally.iter_count = int(meta["iter_count"])
+    tally.total_segments = int(meta["total_segments"])
+    tally._initialized = bool(meta["initialized"])
+    perm = arrays["perm"]
+    tally._perm = None if perm.size == 0 else perm.astype(np.int64)
+    if "replanned" in meta:
+        tally._replanned = bool(meta["replanned"])
+        planned = meta.get("compact_stages_planned")
+        if tally._replanned and planned is not None:
+            tally._compact_stages = tuple(
+                tuple(int(x) for x in s) for s in planned
+            )
+    _apply_quarantined(tally, arrays)
+    if getattr(tally, "_prev_even", None) is not None:
+        # sd_mode="batch": the even-entry snapshot is derived state —
+        # the per-move fold runs after every move, so at any
+        # checkpoint boundary it equals the current even entries.
+        tally._prev_even = tally.flux[0::2]
+
+
+def save_checkpoint(filename: str, tally) -> None:
+    """Serialize a PumiTally's resumable state (atomic write + per-array
+    digests, see module docstring)."""
+    meta, arrays = _plain_payload(tally)
+    _write_checkpoint(_normalize(filename), meta, arrays)
+
+
+def restore_checkpoint(filename: str, tally) -> None:
+    """Restore state saved by save_checkpoint into a PumiTally constructed
+    with the same mesh and config. Raises on any mismatch or integrity
+    failure BEFORE overwriting any tally state."""
     with np.load(_normalize(filename)) as z:
         meta = json.loads(bytes(z["meta"].tobytes()).decode())
         _validate_meta(meta, tally, expected_kind=None)
-        dtype = tally.config.dtype
-        # Device accumulator is flat (api make_flux flat=True); accept
-        # both 3-D (canonical/older) and flat on-disk arrays.
-        tally.flux = jnp.asarray(z["flux"], dtype).reshape(-1)
-        tally.state = tally.state._replace(
-            origin=jnp.asarray(z["origin"], dtype),
-            dest=jnp.asarray(z["dest"], dtype),
-            elem=jnp.asarray(z["elem"], jnp.int32),
-            in_flight=jnp.asarray(z["in_flight"], bool),
-            weight=jnp.asarray(z["weight"], dtype),
-            group=jnp.asarray(z["group"], jnp.int32),
-            material_id=jnp.asarray(z["material_id"], jnp.int32),
-            particle_id=jnp.asarray(z["particle_id"], jnp.int32),
-        )
-        tally.iter_count = int(meta["iter_count"])
-        tally.total_segments = int(meta["total_segments"])
-        tally._initialized = bool(meta["initialized"])
-        perm = z["perm"]
-        tally._perm = None if perm.size == 0 else perm.astype(np.int64)
-        if getattr(tally, "_prev_even", None) is not None:
-            # sd_mode="batch": the even-entry snapshot is derived state —
-            # the per-move fold runs after every move, so at any
-            # checkpoint boundary it equals the current even entries.
-            tally._prev_even = tally.flux[0::2]
+        arrays = {k: z[k] for k in z.files if k != "meta"}
+        _verify_integrity(arrays, meta, filename)
+    _apply_plain(tally, meta, arrays)
 
 
-def save_partitioned_checkpoint(filename: str, tally) -> None:
-    """Serialize a PartitionedTally's resumable state.
+def _apply_quarantined(tally, arrays: dict) -> None:
+    """Restore the per-lane quarantine counts where both sides track
+    them (quarantine on, payload carries a matching array)."""
+    q = arrays.get("quarantined")
+    if (
+        getattr(tally, "_quarantined", None) is not None
+        and q is not None
+        and q.size == tally._quarantined.size
+    ):
+        tally._quarantined = np.asarray(q, np.int64).copy()
 
-    The flux is stored ASSEMBLED (global element order), so a checkpoint
-    is partition-layout independent: it can resume under a different
-    part count or halo depth (the owned-slab layout is derived state).
-    Particle state is the facade's host-side arrays.
-    """
-    filename = _normalize(filename)
+
+# --------------------------------------------------------------------- #
+# Partitioned facade payload
+# --------------------------------------------------------------------- #
+def _partitioned_payload(tally) -> tuple[dict, dict]:
     meta = {
         "format_version": FORMAT_VERSION,
         "kind": "partitioned",
@@ -177,47 +375,99 @@ def save_partitioned_checkpoint(filename: str, tally) -> None:
         "dtype": str(np.dtype(tally.config.dtype)),
         "sd_mode": tally.config.sd_mode,
     }
-    np.savez_compressed(
-        filename,
-        meta=np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8),
-        flux=np.asarray(tally.raw_flux),
-        positions=tally.positions,
-        elem_global=tally.elem_global,
-        material_id=tally.material_id,
-    )
+    arrays = {
+        # raw_flux assembles a fresh host array, but copy defensively
+        # for the same donation-aliasing reason as the plain payload.
+        "flux": np.array(tally.raw_flux, copy=True),
+        "positions": tally.positions.copy(),
+        "elem_global": tally.elem_global.copy(),
+        "material_id": tally.material_id.copy(),
+        "quarantined": (
+            tally._quarantined.copy()
+            if getattr(tally, "_quarantined", None) is not None
+            else np.empty(0, np.int64)
+        ),
+    }
+    return meta, arrays
 
 
-def restore_partitioned_checkpoint(filename: str, tally) -> None:
-    """Restore state saved by save_partitioned_checkpoint into a
-    PartitionedTally on the same mesh (any partition layout)."""
+def _apply_partitioned(tally, meta: dict, arrays: dict) -> None:
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    from ..parallel.mesh_partition import disassemble_global_flux
     from ..parallel.particle_sharding import PARTICLE_AXIS
 
+    slabs = disassemble_global_flux(
+        tally.partition,
+        np.asarray(arrays["flux"]).astype(np.dtype(tally.config.dtype)),
+    )
+    # Device slabs are FLAT per chip (partitioned_api flux_slabs).
+    tally.flux_slabs = jax.device_put(
+        jnp.asarray(slabs.reshape(slabs.shape[0], -1)),
+        NamedSharding(tally.device_mesh, P(PARTICLE_AXIS)),
+    )
+    tally.positions = np.asarray(arrays["positions"]).copy()
+    tally.elem_global = np.asarray(arrays["elem_global"]).copy()
+    tally.material_id = np.asarray(arrays["material_id"]).copy()
+    tally.iter_count = int(meta["iter_count"])
+    tally.total_segments = int(meta["total_segments"])
+    tally.total_rounds = int(meta["total_rounds"])
+    tally._initialized = bool(meta["initialized"])
+    _apply_quarantined(tally, arrays)
+    if getattr(tally, "_prev_even", None) is not None:
+        # Batch-sd snapshot is derived state (== current even
+        # entries at any move boundary), re-slabbed alongside flux.
+        tally._prev_even = tally.flux_slabs[:, 0::2]
+
+
+def save_partitioned_checkpoint(filename: str, tally) -> None:
+    """Serialize a PartitionedTally's resumable state.
+
+    The flux is stored ASSEMBLED (global element order), so a checkpoint
+    is partition-layout independent: it can resume under a different
+    part count or halo depth (the owned-slab layout is derived state).
+    Particle state is the facade's host-side arrays. Atomic write +
+    per-array digests like the plain facade.
+    """
+    meta, arrays = _partitioned_payload(tally)
+    _write_checkpoint(_normalize(filename), meta, arrays)
+
+
+def restore_partitioned_checkpoint(filename: str, tally) -> None:
+    """Restore state saved by save_partitioned_checkpoint into a
+    PartitionedTally on the same mesh (any partition layout). Validation
+    and integrity checks run BEFORE any state is overwritten."""
     with np.load(_normalize(filename)) as z:
         meta = json.loads(bytes(z["meta"].tobytes()).decode())
         _validate_meta(meta, tally, expected_kind="partitioned")
-        from ..parallel.mesh_partition import disassemble_global_flux
+        arrays = {k: z[k] for k in z.files if k != "meta"}
+        _verify_integrity(arrays, meta, filename)
+    _apply_partitioned(tally, meta, arrays)
 
-        slabs = disassemble_global_flux(
-            tally.partition,
-            z["flux"].astype(np.dtype(tally.config.dtype)),
-        )
-        # Device slabs are FLAT per chip (partitioned_api flux_slabs).
-        tally.flux_slabs = jax.device_put(
-            jnp.asarray(slabs.reshape(slabs.shape[0], -1)),
-            NamedSharding(tally.device_mesh, P(PARTICLE_AXIS)),
-        )
-        tally.positions = z["positions"].copy()
-        tally.elem_global = z["elem_global"].copy()
-        tally.material_id = z["material_id"].copy()
-        tally.iter_count = int(meta["iter_count"])
-        tally.total_segments = int(meta["total_segments"])
-        tally.total_rounds = int(meta["total_rounds"])
-        tally._initialized = bool(meta["initialized"])
-        if getattr(tally, "_prev_even", None) is not None:
-            # Batch-sd snapshot is derived state (== current even
-            # entries at any move boundary), re-slabbed alongside flux.
-            tally._prev_even = tally.flux_slabs[:, 0::2]
+
+# --------------------------------------------------------------------- #
+# In-memory snapshots (the ResilientRunner's retry anchor)
+# --------------------------------------------------------------------- #
+def snapshot_state(tally) -> tuple:
+    """Host-side copy of the resumable state — the same payload a
+    checkpoint file carries, without serialization. Cheap relative to a
+    checkpoint write; the runner takes one after every successful move
+    so a transient device failure can roll back WITHOUT losing the
+    moves since the last on-disk generation."""
+    if hasattr(tally, "flux_slabs"):
+        meta, arrays = _partitioned_payload(tally)
+        return ("partitioned", meta, arrays)
+    meta, arrays = _plain_payload(tally)
+    return ("plain", meta, arrays)
+
+
+def restore_state(tally, snap: tuple) -> None:
+    """Apply a ``snapshot_state`` payload back onto the tally it came
+    from (no validation — same-process, same-object roll-back)."""
+    kind, meta, arrays = snap
+    if kind == "partitioned":
+        _apply_partitioned(tally, meta, arrays)
+    else:
+        _apply_plain(tally, meta, arrays)

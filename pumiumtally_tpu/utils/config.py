@@ -112,6 +112,28 @@ class TallyConfig:
         ledger's cost class). False restores the pre-telemetry walk
         carry and the host-side truncation scan.
 
+    quarantine: bad-particle quarantine (resilience/quarantine.py).
+        When True, per-move inputs that would poison the additive flux
+        accumulator — non-finite destinations or weights, destinations
+        absurdly far outside the mesh bounding box — are MASKED out of
+        the walk (the lane is parked and reports its held position,
+        like flying=0) instead of raising (checkify_invariants) or
+        scoring garbage (default). Quarantined lanes are counted
+        per-lane and per-reason into ``telemetry()["quarantined"]`` and
+        the ``pumi_quarantined_lanes_total`` counter. Off by default:
+        parity runs should fail loudly on bad inputs.
+    truncation_retries: escalation policy for truncated walks
+        (resilience semantics; ops/walk.py rewalk_truncated). 0 (the
+        default) keeps the warn-and-drop behavior. N > 0 re-walks ONLY
+        the truncated lanes with doubled max_crossings, up to N
+        attempts, before declaring them lost; recovered lanes score
+        their remaining segments normally, lost lanes are counted in
+        ``telemetry()`` (``pumi_lost_walks_total``) and still warn.
+        The partitioned facade re-arms the SAME compiled step per
+        attempt (additive crossing budget) instead of doubling the
+        static bound — same bounded-retry contract without recompiling
+        the partitioned program.
+
     sd_mode: standard-deviation accumulation strategy.
         "segment" (default, reference parity): the walk scatters (c, c²)
         per scored segment — slot 1 is Σc².
@@ -159,6 +181,8 @@ class TallyConfig:
     ledger: bool = True
     walk_stats: bool = True
     sd_mode: str = "segment"
+    quarantine: bool = False
+    truncation_retries: int = 0
 
     def resolve_max_crossings(self, ntet: int) -> int:
         if self.max_crossings is not None:

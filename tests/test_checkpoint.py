@@ -49,6 +49,39 @@ def test_round_trip_matches_uninterrupted(tmp_path):
     assert a.total_segments == b.total_segments
 
 
+def test_adaptive_replan_state_rides_checkpoints(tmp_path):
+    """compact_stages='adaptive' replans the compaction ladder from the
+    FIRST move's measured stats; a resumed run must reuse that ladder
+    (not replan from a post-resume move) or the scatter grouping — and
+    thus the flux, to ~1e-15 — drifts from the uninterrupted run."""
+    ckpt = str(tmp_path / "tally.npz")
+    mesh = build_box(1.0, 1.0, 1.0, 3, 3, 3)
+    n = 1024
+    cfg = TallyConfig(tolerance=1e-6, compact_stages="adaptive")
+
+    def fresh():
+        t = PumiTally(mesh, n, cfg)
+        rng = np.random.default_rng(7)
+        t.initialize_particle_location(
+            rng.uniform(0.1, 0.9, (n, 3)).ravel()
+        )
+        return t
+
+    a = fresh()
+    _drive(a, 1, seed=11)
+    assert a._replanned
+    a.save_checkpoint(ckpt)
+
+    b = fresh()
+    b.restore_checkpoint(ckpt)
+    assert b._replanned
+    assert b._compact_stages == a._compact_stages
+
+    _drive(a, 1, seed=12)
+    _drive(b, 1, seed=12)
+    np.testing.assert_array_equal(a.raw_flux, b.raw_flux)
+
+
 def test_mesh_mismatch_rejected(tmp_path):
     ckpt = str(tmp_path / "tally.npz")
     a = _fresh()

@@ -97,9 +97,10 @@ def test_partitioned_tally_writes_vtk(mesh, tmp_path):
 
 def test_partitioned_checkpoint_roundtrip_across_layouts(mesh, tmp_path):
     """A checkpoint written by an 8-part halo-1 run must resume under a
-    DIFFERENT layout (halo-2) with identical assembled flux and identical
-    continued accumulation — the stored flux is global, the slab layout
-    is derived state."""
+    DIFFERENT layout — another halo depth AND another part count — with
+    identical assembled flux and identical continued accumulation: the
+    stored flux is global, the slab layout is derived state (the
+    save_partitioned_checkpoint docstring's promise, pinned here)."""
     cfg = TallyConfig(n_groups=2, dtype=jnp.float64, tolerance=1e-8)
     rng = np.random.default_rng(23)
     pos = rng.uniform(0.05, 0.95, (N, 3))
@@ -128,11 +129,20 @@ def test_partitioned_checkpoint_roundtrip_across_layouts(mesh, tmp_path):
     )
     np.testing.assert_array_equal(b.elem_global, a.elem_global)
 
+    # A different PART COUNT (4 chips, halo-2) resumes identically too.
+    d = PartitionedTally(mesh, N, cfg, n_parts=4, halo_layers=2)
+    d.restore_checkpoint(str(tmp_path / "ck"))
+    np.testing.assert_allclose(d.raw_flux, a.raw_flux, rtol=0, atol=0)
+    np.testing.assert_array_equal(d.elem_global, a.elem_global)
+
     # Continued accumulation agrees exactly across the layouts.
     out_a = move(a, dest2)
     out_b = move(b, dest2)
+    out_d = move(d, dest2)
     np.testing.assert_allclose(out_b, out_a, atol=1e-12)
+    np.testing.assert_allclose(out_d, out_a, atol=1e-12)
     np.testing.assert_allclose(b.raw_flux, a.raw_flux, rtol=0, atol=1e-12)
+    np.testing.assert_allclose(d.raw_flux, a.raw_flux, rtol=0, atol=1e-12)
 
     # Mismatched mesh is rejected.
     other = TetMesh.from_numpy(

@@ -1,0 +1,615 @@
+"""Resilience subsystem: durable checkpoints (atomic + sha256 +
+rotation + corrupt-generation fallback), the ResilientRunner supervisor
+(auto-checkpoint, auto-resume, preemption flush, transient retry), the
+bad-particle quarantine, and the PUMI_TPU_FAULTS injection harness that
+proves each failure mode recovers.
+
+Acceptance contract (ISSUE 2): a run killed mid-move via die_at_move
+resumes from the auto-checkpoint and produces BITWISE-identical flux to
+an uninterrupted run; a NaN-injected source produces finite flux with
+the bad lanes counted in telemetry()["quarantined"], not a crash.
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+
+import numpy as np
+import pytest
+
+from pumiumtally_tpu import (
+    CheckpointStore,
+    PumiTally,
+    ResilientRunner,
+    TallyConfig,
+    build_box,
+)
+from pumiumtally_tpu.resilience import (
+    FaultInjector,
+    InjectedKill,
+    InjectedTransientFault,
+    parse_faults,
+)
+from pumiumtally_tpu.utils.checkpoint import (
+    CheckpointIntegrityError,
+    verify_checkpoint,
+)
+
+N = 16
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return build_box(1.0, 1.0, 1.0, 4, 4, 4)
+
+
+def _fresh(mesh, **cfg_kw):
+    t = PumiTally(
+        mesh, N, TallyConfig(tolerance=1e-6, **cfg_kw)
+    )
+    rng = np.random.default_rng(42)
+    t.initialize_particle_location(
+        rng.uniform(0.1, 0.9, (N, 3)).ravel()
+    )
+    return t
+
+
+def _inputs(i):
+    """Deterministic per-move inputs, so an interrupted run can REPLAY
+    the exact moves an uninterrupted run made."""
+    rng = np.random.default_rng(100 + i)
+    return (
+        rng.uniform(0.05, 0.95, (N, 3)).ravel().copy(),
+        np.ones(N, np.int8),
+        rng.uniform(0.5, 2.0, N),
+        rng.integers(0, 2, N).astype(np.int32),
+        np.full(N, -1, np.int32),
+    )
+
+
+def _drive(t, first, last):
+    for i in range(first, last + 1):
+        t.move_to_next_location(*_inputs(i))
+
+
+# ===================================================================== #
+# Durable checkpoints
+# ===================================================================== #
+def test_atomic_save_never_leaves_truncated_file(
+    mesh, tmp_path, monkeypatch
+):
+    """A crash/ENOSPC mid-write must leave the previous generation
+    intact under the real name — and no temp litter."""
+    ckpt = str(tmp_path / "t.npz")
+    t = _fresh(mesh)
+    _drive(t, 1, 1)
+    t.save_checkpoint(ckpt)
+    before = open(ckpt, "rb").read()
+
+    def boom(f, **arrays):
+        f.write(b"PK\x03\x04 partial garbage")
+        raise OSError(28, "No space left on device")
+
+    monkeypatch.setattr(np, "savez_compressed", boom)
+    _drive(t, 2, 2)
+    with pytest.raises(OSError):
+        t.save_checkpoint(ckpt)
+    monkeypatch.undo()
+    assert open(ckpt, "rb").read() == before  # old generation intact
+    assert verify_checkpoint(ckpt)["iter_count"] == 1
+    assert not [p for p in os.listdir(tmp_path) if ".tmp-" in p]
+
+
+def test_digest_detects_corruption(mesh, tmp_path):
+    ckpt = str(tmp_path / "t.npz")
+    t = _fresh(mesh)
+    _drive(t, 1, 1)
+    t.save_checkpoint(ckpt)
+    meta = verify_checkpoint(ckpt)
+    assert set(meta["array_sha256"]) >= {"flux", "origin", "elem"}
+
+    FaultInjector(parse_faults("corrupt_ckpt")).corrupt_file(ckpt)
+    with pytest.raises(Exception):
+        verify_checkpoint(ckpt)
+    b = _fresh(mesh)
+    with pytest.raises(Exception):
+        b.restore_checkpoint(ckpt)
+    # Failed restore must not have half-applied anything.
+    assert b.iter_count == 0
+
+
+def _tamper_meta(path, **fields):
+    with np.load(path) as z:
+        arrays = {k: z[k] for k in z.files}
+    meta = json.loads(bytes(arrays.pop("meta").tobytes()).decode())
+    meta.update(fields)
+    np.savez_compressed(
+        path,
+        meta=np.frombuffer(json.dumps(meta).encode(), np.uint8),
+        **arrays,
+    )
+
+
+def test_dtype_mismatch_rejected(mesh, tmp_path):
+    """An f64 checkpoint restored into an f32 tally would silently cast
+    the accumulator; the validator must raise instead (like sd_mode)."""
+    ckpt = str(tmp_path / "t.npz")
+    t = _fresh(mesh)
+    t.save_checkpoint(ckpt)
+    _tamper_meta(ckpt, dtype="float64")
+    b = _fresh(mesh)
+    with pytest.raises(ValueError, match="dtype"):
+        b.restore_checkpoint(ckpt)
+
+
+def test_store_rotation_and_corrupt_fallback(mesh, tmp_path):
+    store = CheckpointStore(str(tmp_path / "cks"), keep=2)
+    t = _fresh(mesh)
+    for i in range(1, 4):
+        _drive(t, i, i)
+        store.save(t)
+    its = [it for it, _ in store.entries()]
+    assert its == [2, 3]  # keep-2 rotation dropped generation 1
+    latest = store.find_latest()
+    assert latest is not None and latest[0] == 3
+
+    # Corrupt the newest generation: find_latest and restore_latest
+    # must fall back to the previous one.
+    FaultInjector(parse_faults("corrupt_ckpt")).corrupt_file(
+        store.path_for(3)
+    )
+    assert store.find_latest()[0] == 2
+    b = _fresh(mesh)
+    assert store.restore_latest(b) == 2
+    assert b.iter_count == 2
+
+    # Everything corrupt: nothing to resume.
+    FaultInjector(parse_faults("corrupt_ckpt")).corrupt_file(
+        store.path_for(2)
+    )
+    assert store.find_latest() is None
+    assert store.restore_latest(_fresh(mesh)) is None
+
+
+def test_mismatched_checkpoint_still_raises(mesh, tmp_path):
+    """Corruption falls back; a clean-but-incompatible generation is a
+    caller bug and must propagate, not be silently skipped."""
+    store = CheckpointStore(str(tmp_path / "cks"))
+    t = _fresh(mesh)
+    store.save(t)
+    wrong = PumiTally(
+        build_box(1.0, 1.0, 1.0, 2, 2, 2), N,
+        TallyConfig(tolerance=1e-6),
+    )
+    with pytest.raises(ValueError, match="different mesh"):
+        store.restore_latest(wrong)
+
+
+# ===================================================================== #
+# Fault grammar
+# ===================================================================== #
+def test_parse_faults_grammar():
+    p = parse_faults("nan_src:0.01,die_at_move:3,corrupt_ckpt,seed:5")
+    assert (p.nan_src, p.die_at_move, p.corrupt_ckpt, p.seed) == (
+        0.01, 3, True, 5,
+    )
+    assert not parse_faults("").any()
+    assert parse_faults("transient_at_move:2").transient_at_move == 2
+    with pytest.raises(ValueError, match="unknown fault"):
+        parse_faults("explode:1")
+    with pytest.raises(ValueError, match="probability"):
+        parse_faults("nan_src:2.0")
+
+
+def test_plan_from_env(monkeypatch):
+    monkeypatch.setenv("PUMI_TPU_FAULTS", "nan_src:0.5,seed:9")
+    inj = FaultInjector()
+    assert inj.plan.nan_src == 0.5 and inj.plan.seed == 9
+    d = np.zeros((N, 3))
+    hit = inj.corrupt_destinations(d, move=1)
+    assert hit > 0 and np.isnan(d).any()
+    # Deterministic per (seed, move): a replay injects the same lanes.
+    d2 = np.zeros((N, 3))
+    assert FaultInjector().corrupt_destinations(d2, move=1) == hit
+    np.testing.assert_array_equal(np.isnan(d), np.isnan(d2))
+
+
+# ===================================================================== #
+# The supervisor
+# ===================================================================== #
+def test_die_at_move_resume_bitwise_identical(mesh, tmp_path):
+    """ISSUE 2 acceptance: kill at move 4, auto-resume, replay —
+    bitwise-identical flux to an uninterrupted run with the same
+    inputs."""
+    ref = _fresh(mesh)
+    _drive(ref, 1, 5)
+
+    d = str(tmp_path / "cks")
+    a = PumiTally(mesh, N, TallyConfig(tolerance=1e-6))
+    run_a = ResilientRunner(
+        a, d, every_moves=1, handle_signals=False,
+        faults=FaultInjector(parse_faults("die_at_move:4")),
+    )
+    rng = np.random.default_rng(42)
+    run_a.initialize_particle_location(
+        rng.uniform(0.1, 0.9, (N, 3)).ravel()
+    )
+    with pytest.raises(InjectedKill):
+        for i in range(1, 6):
+            run_a.move_to_next_location(*_inputs(i))
+    assert a.iter_count == 3  # died before move 4 ran
+
+    b = PumiTally(mesh, N, TallyConfig(tolerance=1e-6))
+    run_b = ResilientRunner(b, d, every_moves=1, handle_signals=False)
+    assert run_b.resumed_from == 3
+    # The resume-aware driver loop: initialize is a no-op, replayed
+    # moves are skipped by iter_count.
+    run_b.initialize_particle_location(
+        rng.uniform(0.1, 0.9, (N, 3)).ravel()
+    )
+    for i in range(1, 6):
+        if b.iter_count >= i:
+            continue
+        run_b.move_to_next_location(*_inputs(i))
+    run_b.close()
+
+    np.testing.assert_array_equal(
+        np.asarray(b.raw_flux), np.asarray(ref.raw_flux)
+    )
+    np.testing.assert_array_equal(b.element_ids, ref.element_ids)
+    assert b.total_segments == ref.total_segments
+    assert b.metrics.counter("pumi_resumes_total").value() == 1
+
+
+def test_transient_retry_with_backoff(mesh, tmp_path):
+    """A transient failure mid-run rolls back to the last good state
+    and retries; the completed run matches an undisturbed one."""
+    ref = _fresh(mesh)
+    _drive(ref, 1, 3)
+
+    delays = []
+    t = _fresh(mesh)
+    run = ResilientRunner(
+        t, str(tmp_path / "cks"), every_moves=10,
+        handle_signals=False, max_retries=3, backoff_base=0.25,
+        faults=FaultInjector(parse_faults("transient_at_move:2")),
+        sleep=delays.append,
+    )
+    _drive(run, 1, 3)
+    np.testing.assert_array_equal(
+        np.asarray(t.raw_flux), np.asarray(ref.raw_flux)
+    )
+    assert delays == [0.25]  # one retry, exponential base
+    assert t.metrics.counter("pumi_move_retries_total").value() == 1
+
+
+def test_retry_snapshots_off_propagates_transients(mesh, tmp_path):
+    """retry_snapshots=False trades the per-move flux readback for no
+    in-process retry: transients propagate, auto-resume is the
+    recovery path."""
+    t = _fresh(mesh)
+    run = ResilientRunner(
+        t, str(tmp_path / "cks"), handle_signals=False,
+        retry_snapshots=False, sleep=lambda s: None,
+        faults=FaultInjector(parse_faults("transient_at_move:1")),
+    )
+    assert run._good is None
+    with pytest.raises(InjectedTransientFault):
+        run.move_to_next_location(*_inputs(1))
+
+
+def test_store_sweeps_orphaned_tmp_files(mesh, tmp_path):
+    """A SIGKILL mid-write leaves atomic_savez's temp file behind; the
+    store sweeps it on construction instead of hoarding it forever."""
+    d = tmp_path / "cks"
+    d.mkdir()
+    orphan = d / "ckpt-00000001.npz.tmp-abc123"
+    orphan.write_bytes(b"half-written garbage")
+    store = CheckpointStore(str(d))
+    assert not orphan.exists()
+    t = _fresh(mesh)
+    store.save(t)
+    assert store.find_latest() is not None
+
+
+def test_transient_exhausts_retries(mesh, tmp_path):
+    class AlwaysTransient(FaultInjector):
+        def maybe_transient(self, move):
+            raise InjectedTransientFault("flaky forever")
+
+    t = _fresh(mesh)
+    run = ResilientRunner(
+        t, str(tmp_path / "cks"), handle_signals=False,
+        max_retries=2, faults=AlwaysTransient(), sleep=lambda s: None,
+    )
+    with pytest.raises(InjectedTransientFault):
+        run.move_to_next_location(*_inputs(1))
+
+
+def test_sigterm_flushes_final_checkpoint(mesh, tmp_path):
+    """Preemption contract: SIGTERM writes one final generation before
+    the process dies (SystemExit under the default prior handler)."""
+    t = _fresh(mesh)
+    store = CheckpointStore(str(tmp_path / "cks"))
+    run = ResilientRunner(t, store, every_moves=1000)
+    try:
+        _drive(run, 1, 2)
+        assert store.find_latest() is None  # nothing written yet
+        with pytest.raises(SystemExit) as exc:
+            os.kill(os.getpid(), signal.SIGTERM)
+            # Signal delivery happens at the next bytecode boundary.
+            for _ in range(100):
+                pass
+        assert exc.value.code == 128 + signal.SIGTERM
+        assert store.find_latest()[0] == 2  # flushed at current iter
+        # Handler restored: a second SIGTERM would be the default
+        # action; make sure ours is gone before leaving the test.
+        assert signal.getsignal(signal.SIGTERM) == signal.SIG_DFL
+    finally:
+        run._uninstall_signal_handlers()
+
+
+def test_pending_signal_delivered_when_move_raises(mesh, tmp_path):
+    """A preemption signal deferred mid-move must still flush and kill
+    the process when the move RAISES — swallowing it would leave a
+    process that ignores SIGTERM forever."""
+    t = _fresh(mesh)
+    store = CheckpointStore(str(tmp_path / "cks"))
+    run = ResilientRunner(t, store, every_moves=1000)
+    try:
+        def bad_move(*args, **kwargs):
+            os.kill(os.getpid(), signal.SIGTERM)
+            for _ in range(100):  # let the handler run (deferred)
+                pass
+            raise RuntimeError("driver bug mid-move")
+
+        t.move_to_next_location = bad_move
+        with pytest.raises(SystemExit) as exc:
+            run.move_to_next_location(*_inputs(1))
+        assert exc.value.code == 128 + signal.SIGTERM
+        # The flush wrote the last consistent state (post-init).
+        assert store.find_latest()[0] == 0
+    finally:
+        run._uninstall_signal_handlers()
+
+
+def test_corrupt_ckpt_fault_through_runner(mesh, tmp_path):
+    """The corrupt_ckpt fault corrupts every generation the supervisor
+    writes; resume must then find nothing valid."""
+    t = _fresh(mesh)
+    run = ResilientRunner(
+        t, str(tmp_path / "cks"), every_moves=1,
+        handle_signals=False,
+        faults=FaultInjector(parse_faults("corrupt_ckpt")),
+    )
+    _drive(run, 1, 2)
+    assert len(run.store.entries()) >= 1
+    assert run.store.find_latest() is None
+
+
+def test_nan_source_quarantined_not_crash(mesh, tmp_path):
+    """ISSUE 2 acceptance: a NaN-injected source produces finite flux
+    with the bad lanes counted in telemetry()["quarantined"]."""
+    t = PumiTally(
+        mesh, N, TallyConfig(tolerance=1e-6, quarantine=True)
+    )
+    rng = np.random.default_rng(42)
+    run = ResilientRunner(
+        t, str(tmp_path / "cks"), every_moves=1000,
+        handle_signals=False,
+        faults=FaultInjector(parse_faults("nan_src:0.3,seed:7")),
+    )
+    run.initialize_particle_location(
+        rng.uniform(0.1, 0.9, (N, 3)).ravel()
+    )
+    _drive(run, 1, 3)
+    tm = t.telemetry()
+    assert tm["quarantined"] > 0
+    assert tm["quarantined"] == tm["totals"]["quarantined"]
+    assert np.isfinite(np.asarray(t.raw_flux)).all()
+    assert t.quarantined_lanes().sum() == tm["quarantined"]
+    inj = t.metrics.counter("pumi_injected_faults_total")
+    assert inj.value(kind="nan_src") == tm["quarantined"]
+
+
+# ===================================================================== #
+# Quarantine semantics (facade-level, no injector)
+# ===================================================================== #
+def test_quarantine_masks_and_reports_per_lane(mesh):
+    t = PumiTally(
+        mesh, N, TallyConfig(tolerance=1e-6, quarantine=True)
+    )
+    rng = np.random.default_rng(42)
+    pos = rng.uniform(0.1, 0.9, (N, 3))
+    t.initialize_particle_location(pos.ravel())
+
+    dest, fly, w, g, mats = _inputs(1)
+    d3 = dest.reshape(N, 3)
+    d3[3] = np.nan          # nonfinite_dest
+    d3[5] = 1e9             # out_of_mesh
+    w = w.copy()
+    w[7] = np.inf           # nonfinite_weight
+    t.move_to_next_location(dest, fly, w, g, mats)
+
+    lanes = t.quarantined_lanes()
+    assert set(np.nonzero(lanes)[0]) == {3, 5, 7}
+    # Parked contract: quarantined lanes report their HELD position.
+    held = dest.reshape(N, 3)
+    clean = PumiTally(mesh, N, TallyConfig(tolerance=1e-6))
+    clean.initialize_particle_location(pos.ravel())
+    np.testing.assert_allclose(
+        held[[3, 5, 7]],
+        np.asarray(clean.state.origin)[[3, 5, 7]],
+        atol=1e-12,
+    )
+    assert np.isfinite(np.asarray(t.raw_flux)).all()
+    # Per-reason counters, and the deduplicated headline.
+    c = t.metrics.counter("pumi_quarantine_reasons_total")
+    assert c.value(reason="nonfinite_dest") == 1
+    assert c.value(reason="out_of_mesh") == 1
+    assert c.value(reason="nonfinite_weight") == 1
+    assert t.telemetry()["quarantined"] == 3
+    # The caller's weights array is never written through.
+    assert np.isinf(w[7])
+
+
+def test_multi_reason_lane_counts_once(mesh):
+    """A lane tripping several reasons in one move is ONE quarantined
+    lane: the headline agrees with quarantined_lanes()."""
+    t = PumiTally(
+        mesh, N, TallyConfig(tolerance=1e-6, quarantine=True)
+    )
+    rng = np.random.default_rng(42)
+    t.initialize_particle_location(
+        rng.uniform(0.1, 0.9, (N, 3)).ravel()
+    )
+    dest, fly, w, g, mats = _inputs(1)
+    dest.reshape(N, 3)[3] = 1e9      # out_of_mesh ...
+    w = w.copy()
+    w[3] = np.nan                    # ... AND nonfinite_weight
+    t.move_to_next_location(dest, fly, w, g, mats)
+    assert t.telemetry()["quarantined"] == 1
+    assert t.quarantined_lanes().sum() == 1
+    c = t.metrics.counter("pumi_quarantine_reasons_total")
+    assert c.value(reason="out_of_mesh") == 1
+    assert c.value(reason="nonfinite_weight") == 1
+
+
+def test_quarantine_initial_positions(mesh):
+    t = PumiTally(
+        mesh, N, TallyConfig(tolerance=1e-6, quarantine=True)
+    )
+    rng = np.random.default_rng(42)
+    pos = rng.uniform(0.1, 0.9, (N, 3))
+    pos[2] = np.nan
+    t.initialize_particle_location(pos.ravel())
+    assert t.quarantined_lanes()[2] == 1
+    # The masked lane stayed at the element-0 seed (finite state).
+    assert np.isfinite(np.asarray(t.state.origin)).all()
+    # The caller's array is untouched.
+    assert np.isnan(pos[2]).all()
+
+
+def test_retry_after_walk_failure_keeps_quarantine_semantics(
+    mesh, tmp_path
+):
+    """A transient failure AFTER the quarantine scan (inside the walk)
+    must retry against the ORIGINAL inputs: the lane is re-quarantined
+    (not walked to the sanitized zeros) and the rolled-back per-lane
+    count ends at exactly 1."""
+    from jax.errors import JaxRuntimeError
+
+    t = PumiTally(
+        mesh, N, TallyConfig(tolerance=1e-6, quarantine=True)
+    )
+    rng = np.random.default_rng(42)
+    t.initialize_particle_location(
+        rng.uniform(0.1, 0.9, (N, 3)).ravel()
+    )
+    orig_trace, fired = t._trace, []
+
+    def flaky(*args, **kwargs):
+        if not fired:
+            fired.append(True)
+            raise JaxRuntimeError("preempted device")
+        return orig_trace(*args, **kwargs)
+
+    t._trace = flaky
+    run = ResilientRunner(
+        t, str(tmp_path / "cks"), every_moves=1000,
+        handle_signals=False, sleep=lambda s: None,
+    )
+    dest, fly, w, g, mats = _inputs(1)
+    held = np.asarray(t.state.origin)[4].copy()
+    dest.reshape(N, 3)[4] = np.nan
+    run.move_to_next_location(dest, fly, w, g, mats)
+    assert t.metrics.counter("pumi_move_retries_total").value() == 1
+    # Rolled back + re-counted once, not twice.
+    assert t.quarantined_lanes()[4] == 1
+    # The retried lane was parked at its HELD position, not walked to
+    # the sanitized (0,0,0).
+    np.testing.assert_allclose(
+        dest.reshape(N, 3)[4], held, atol=1e-12
+    )
+    assert np.isfinite(np.asarray(t.raw_flux)).all()
+
+
+def test_retry_after_copyback_failure_rearms_out_params(
+    mesh, tmp_path
+):
+    """A retryable error surfacing AFTER the facade's copy-back (e.g.
+    the late xpoints fetch) has already zeroed the caller's flying
+    flags and overwritten dest — the retry must re-arm the original
+    inputs, not walk zero particles and silently drop the move."""
+    from jax.errors import JaxRuntimeError
+
+    cfg = TallyConfig(tolerance=1e-6, record_xpoints=4)
+    ref = PumiTally(mesh, N, cfg)
+    t = PumiTally(mesh, N, cfg)
+    pos = np.random.default_rng(42).uniform(0.1, 0.9, (N, 3))
+    for x in (ref, t):
+        x.initialize_particle_location(pos.ravel().copy())
+    ref.move_to_next_location(*_inputs(1))
+
+    orig, fired = t._store_xpoints, []
+
+    def flaky(result):
+        if not fired:
+            fired.append(True)
+            raise JaxRuntimeError("device lost at xpoints fetch")
+        return orig(result)
+
+    t._store_xpoints = flaky
+    run = ResilientRunner(
+        t, str(tmp_path / "cks"), every_moves=1000,
+        handle_signals=False, sleep=lambda s: None,
+    )
+    run.move_to_next_location(*_inputs(1))
+    assert t.iter_count == 1
+    np.testing.assert_array_equal(
+        np.asarray(t.raw_flux), np.asarray(ref.raw_flux)
+    )
+    xp_t, c_t = t.intersection_points()
+    xp_r, c_r = ref.intersection_points()
+    np.testing.assert_array_equal(c_t, c_r)
+
+
+def test_quarantined_lanes_ride_checkpoints(mesh, tmp_path):
+    """Per-lane quarantine counts are resumable state: a resumed run
+    keeps its degraded-mode report."""
+    ckpt = str(tmp_path / "t.npz")
+    t = PumiTally(
+        mesh, N, TallyConfig(tolerance=1e-6, quarantine=True)
+    )
+    rng = np.random.default_rng(42)
+    t.initialize_particle_location(
+        rng.uniform(0.1, 0.9, (N, 3)).ravel()
+    )
+    dest, fly, w, g, mats = _inputs(1)
+    dest.reshape(N, 3)[6] = np.nan
+    t.move_to_next_location(dest, fly, w, g, mats)
+    t.save_checkpoint(ckpt)
+
+    b = PumiTally(
+        mesh, N, TallyConfig(tolerance=1e-6, quarantine=True)
+    )
+    b.restore_checkpoint(ckpt)
+    np.testing.assert_array_equal(
+        b.quarantined_lanes(), t.quarantined_lanes()
+    )
+
+
+def test_quarantine_off_keeps_loud_failure(mesh):
+    t = PumiTally(
+        mesh, N,
+        TallyConfig(tolerance=1e-6, checkify_invariants=True),
+    )
+    rng = np.random.default_rng(42)
+    t.initialize_particle_location(
+        rng.uniform(0.1, 0.9, (N, 3)).ravel()
+    )
+    dest, fly, w, g, mats = _inputs(1)
+    dest.reshape(N, 3)[0] = np.nan
+    with pytest.raises(ValueError, match="non-finite"):
+        t.move_to_next_location(dest, fly, w, g, mats)
