@@ -47,7 +47,8 @@ from .core.tally import (
 from .io.vtk import write_flux_vtk
 from .mesh.core import TetMesh
 from .obs import TallyTelemetry, stats_to_dict
-from .ops.walk import trace
+from .ops import staging
+from .ops.walk import trace, trace_packed
 from .utils.config import TallyConfig
 from .utils.profiling import annotate
 from .utils.timing import TallyTimes, phase_timer
@@ -149,8 +150,23 @@ class PumiTally:
             self._replanned = cfg.compact_stages != "adaptive"
             self._initialized = False
             # Host-order permutation: device slot i holds particle
-            # _perm[i]; None while the layout is still identity.
+            # _perm[i]; None while the layout is still identity. The
+            # DEVICE-resident copy (_perm_dev) drives the packed
+            # pipeline's fused gather/scatter; both are derived only
+            # when the periodic sort actually fires (_resort_by_element)
+            # — never per move.
             self._perm: np.ndarray | None = None
+            self._perm_dev = None
+            self._traces_since_sort = 0
+            # Move-loop I/O pipelining (ops/staging.py): "packed" stages
+            # ONE host record per move each way; "overlap" adds
+            # double-buffered staging + deferred telemetry folds;
+            # "legacy" is the pre-pipeline multi-transfer path.
+            self._io = cfg.resolve_io_pipeline()
+            self._stager = staging.HostStager(
+                depth=2 if self._io == "overlap" else 1
+            )
+            self._pending_folds: list = []
             self._last_xpoints: tuple | None = None
             # Bad-particle quarantine (resilience/quarantine.py):
             # cumulative per-lane counts + the out-of-mesh threshold.
@@ -166,10 +182,18 @@ class PumiTally:
 
     # ------------------------------------------------------------------ #
     def _trace(self, *args, **kwargs):
-        """Dispatch to the fused walk; with checkify_invariants on, route
-        through the checkify-wrapped variant so the reference's device
-        asserts (OMEGA_H_CHECK_PRINTF, cpp:605-608, 618-629) fire as
-        Python exceptions."""
+        """Dispatch to the fused walk — the facade's SINGLE walk entry
+        point for every pipeline mode, so wrappers around it (the
+        resilience test harness's transient-fault injection, future
+        instrumentation) intercept packed and legacy moves alike.
+        ``_packed=True`` routes to the packed-record program
+        (ops/walk.py trace_packed); with checkify_invariants on (legacy
+        mode only — resolve_io_pipeline forces it), route through the
+        checkify-wrapped variant so the reference's device asserts
+        (OMEGA_H_CHECK_PRINTF, cpp:605-608, 618-629) fire as Python
+        exceptions."""
+        if kwargs.pop("_packed", False):
+            return trace_packed(*args, **kwargs)
         if self.config.checkify_invariants:
             from .ops.walk import checked_trace
 
@@ -182,6 +206,44 @@ class PumiTally:
     def _gather_in(self, host: np.ndarray) -> np.ndarray:
         """Reorder per-particle host input into device slot order."""
         return host if self._perm is None else host[self._perm]
+
+    def _refresh_perm_device(self) -> None:
+        """Re-derive the device-resident slot permutation from state.
+        ``state.particle_id`` after a sort IS the slot→pid map, already
+        on device — no transfer.  Called by the periodic sort and by
+        checkpoint restore (utils/checkpoint._apply_plain)."""
+        self._perm_dev = (
+            self.state.particle_id if self._perm is not None else None
+        )
+
+    def _resort_by_element(self) -> None:
+        """Periodic locality sort (the migrate-every-100 analog,
+        cpp:256-258).  The ``jnp.argsort(state.elem)`` and every derived
+        permutation artifact (device perm for the packed pipeline's
+        fused gather/scatter, host perm for cold-path un-permutes) are
+        computed HERE only: moves between sorts reuse the cached
+        ``_perm_dev`` unchanged, and a sort request with no trace since
+        the last sort is skipped outright (the element keys cannot have
+        changed)."""
+        if self._traces_since_sort == 0:
+            return
+        order = jnp.argsort(self.state.elem)
+        self.state = jax.tree_util.tree_map(
+            lambda x: x[order], self.state
+        )
+        self._traces_since_sort = 0
+        self._perm = np.asarray(jax.device_get(self.state.particle_id))
+        self._refresh_perm_device()
+
+    def _drain_pending(self) -> None:
+        """Flush deferred telemetry folds (io_pipeline="overlap"): each
+        entry is a zero-arg closure recorded in move order.  Called
+        after the NEXT move's dispatch (so the fold work overlaps the
+        device walk) and at every flush point (telemetry(), VTK write,
+        checkpointing)."""
+        pending, self._pending_folds = self._pending_folds, []
+        for fold in pending:
+            fold()
 
     def _check_groups(self, group: np.ndarray) -> None:
         _check_group_range(group, self.config.n_groups)
@@ -240,16 +302,28 @@ class PumiTally:
         return lanes(self)
 
     def _escalate_truncated(
-        self, result, dest, weight, group, stats_d, tkw, move
+        self, result, dest, weight, group, stats_d, tkw, move,
+        done_h=None, io=None,
     ):
         """Truncation escalation (TallyConfig.truncation_retries): re-walk
         only the truncated lanes with doubled max_crossings before
-        declaring them lost (ops/walk.py rewalk_truncated). Returns the
-        (possibly merged) result, refreshed stats, and the lost count."""
-        n_tr = self._n_truncated(result, stats_d)
+        declaring them lost (ops/walk.py rewalk_truncated) — ONE policy
+        for both pipelines.  The packed pipeline passes the host ``done``
+        column from the readback record (``done_h``, the stats-off
+        truncation count without a device scan) and its ``io`` accounting
+        dict; a re-walk then refreshes the caller's host views through
+        ONE cold-path coalesced readback.  Returns ``(result, stats_d,
+        n_lost, parts)`` where ``parts`` is the refreshed
+        split_trace_readback tuple (packed, after a re-walk) or None."""
+        if stats_d is not None:
+            n_tr = stats_d["truncated"]
+        elif done_h is not None:
+            n_tr = int(np.sum(~done_h))
+        else:
+            n_tr = self._n_truncated(result, None)
         if not n_tr:
-            return result, stats_d, 0
-        n_lost, n_retried = n_tr, 0
+            return result, stats_d, 0, None
+        n_lost, n_retried, parts = n_tr, 0, None
         if self.config.truncation_retries > 0:
             from .ops.walk import rewalk_truncated
 
@@ -258,10 +332,26 @@ class PumiTally:
                 retries=self.config.truncation_retries,
                 trace_fn=self._trace, **tkw,
             )
-            stats_d = self._read_stats(result)
+            if io is not None:
+                host_rb = jax.device_get(
+                    staging.pack_trace_readback_cold(
+                        result, self._perm_dev
+                    )
+                )
+                io["d2h_bytes"] += int(host_rb.nbytes)
+                io["d2h_transfers"] += 1
+                parts = staging.split_trace_readback(
+                    host_rb, self.num_particles, self.config.dtype
+                )
+                stats_d = (
+                    stats_to_dict(parts[3])
+                    if self.config.walk_stats else None
+                )
+            else:
+                stats_d = self._read_stats(result)
         if n_retried or n_lost:
             self._telemetry.record_rewalk(move, n_retried, n_lost)
-        return result, stats_d, n_lost
+        return result, stats_d, n_lost, parts
 
     # ------------------------------------------------------------------ #
     def initialize_particle_location(
@@ -291,8 +381,6 @@ class PumiTally:
         with annotate("PumiTally.initialize_particle_location"), phase_timer(
             self.tally_times, "initialization_time", True
         ) as timer:
-            dest_h = self._gather_in(pos3)
-            dest = jnp.asarray(dest_h, dtype=self.config.dtype)
             s = self.state
             tkw = dict(
                 initial=True,
@@ -311,22 +399,63 @@ class PumiTally:
                 record_xpoints=self.config.record_xpoints,
                 n_groups=self.config.n_groups,
             )
-            result = self._trace(
-                self.mesh,
-                s.origin,
-                dest,
-                s.elem,
-                jnp.asarray(self._gather_in(fly_h)),
-                s.weight,
-                s.group,
-                s.material_id,
-                self.flux,
-                **tkw,
-            )
-            stats_d = self._read_stats(result)
-            result, stats_d, n_lost = self._escalate_truncated(
-                result, dest, s.weight, s.group, stats_d, tkw, 0
-            )
+            if self._io != "legacy":
+                # Packed pipeline: ONE staging record up, ONE coalesced
+                # readback down (positions are unused here — only the
+                # stats/done tail drives the truncation accounting).
+                rec_h = staging.pack_init_record(
+                    self._stager, pos3, fly_h, self.config.dtype
+                )
+                io = dict(
+                    h2d_bytes=int(rec_h.nbytes), h2d_transfers=1,
+                    d2h_bytes=0, d2h_transfers=0,
+                )
+                result, readback, dest, _fly, _w, _g = self._trace(
+                    self.mesh, s.origin, s.elem, s.material_id,
+                    jax.device_put(rec_h), self.flux, self._perm_dev,
+                    weight=s.weight, group=s.group, _packed=True, **tkw,
+                )
+                host_rb = jax.device_get(readback)
+                io["d2h_bytes"] += int(host_rb.nbytes)
+                io["d2h_transfers"] += 1
+                _pos, _mats, done_h, tail = staging.split_trace_readback(
+                    host_rb, n, self.config.dtype
+                )
+                stats_d = (
+                    stats_to_dict(tail) if self.config.walk_stats else None
+                )
+                result, stats_d, n_lost, _parts = self._escalate_truncated(
+                    result, dest, s.weight, s.group, stats_d, tkw, 0,
+                    done_h=done_h, io=io,
+                )
+            else:
+                dest_h = self._gather_in(pos3)
+                dest = jnp.asarray(dest_h, dtype=self.config.dtype)
+                fly_dev = jnp.asarray(self._gather_in(fly_h))
+                io = dict(
+                    h2d_bytes=int(dest.nbytes) + int(fly_dev.nbytes),
+                    h2d_transfers=2, d2h_bytes=0, d2h_transfers=0,
+                )
+                result = self._trace(
+                    self.mesh,
+                    s.origin,
+                    dest,
+                    s.elem,
+                    fly_dev,
+                    s.weight,
+                    s.group,
+                    s.material_id,
+                    self.flux,
+                    **tkw,
+                )
+                stats_d = self._read_stats(result)
+                if result.stats is not None:
+                    io["d2h_bytes"] += int(result.stats.nbytes)
+                    io["d2h_transfers"] += 1
+                result, stats_d, n_lost, _ = self._escalate_truncated(
+                    result, dest, s.weight, s.group, stats_d, tkw, 0
+                )
+            self._traces_since_sort += 1
             self.flux = result.flux
             self.state = s._replace(
                 origin=result.position, dest=dest, elem=result.elem
@@ -342,6 +471,7 @@ class PumiTally:
             stats_d,
             seconds=self.tally_times.initialization_time - t_before,
             synced=self.config.measure_time,
+            **io,
         )
 
     def _maybe_replan(self, n_segments: int, n_moving: int) -> None:
@@ -417,19 +547,12 @@ class PumiTally:
             self.tally_times, "total_time_to_tally", True
         ) as timer:
             s = self.state
-            dest = jnp.asarray(
-                self._gather_in(dest3_h), dtype=cfg.dtype
-            )
-            in_flight = jnp.asarray(self._gather_in(fly_h))
             # Host-side mover count for the one-shot adaptive replan —
             # counted here (before the flags are zeroed) and only while
             # a replan is still pending, so the hot path pays nothing.
             n_moving_h = (
                 int(fly_h.sum()) if not self._replanned else 0
             )
-            weight = jnp.asarray(self._gather_in(weights_h), dtype=cfg.dtype)
-            group = jnp.asarray(self._gather_in(groups_h), dtype=jnp.int32)
-
             tkw = dict(
                 initial=False,
                 max_crossings=self._max_crossings,
@@ -452,23 +575,84 @@ class PumiTally:
                 record_xpoints=cfg.record_xpoints,
                 n_groups=cfg.n_groups,
             )
-            result = self._trace(
-                self.mesh,
-                s.origin,
-                dest,
-                s.elem,
-                in_flight,
-                weight,
-                group,
-                s.material_id,
-                self.flux,
-                **tkw,
-            )
-            stats_d = self._read_stats(result)
-            result, stats_d, n_lost = self._escalate_truncated(
-                result, dest, weight, group, stats_d, tkw,
-                self.iter_count + 1,
-            )
+            if self._io != "legacy":
+                # Packed pipeline (ops/staging.py): ONE contiguous host
+                # record up (dest/weight/group/flying), slot permutation
+                # and unpack fused into the compiled step, ONE coalesced
+                # readback down (positions/materials/done/stats already
+                # scattered back into host pid order on device).
+                rec_h = staging.pack_move_record(
+                    self._stager, dest3_h, weights_h, groups_h, fly_h,
+                    cfg.dtype,
+                )
+                io = dict(
+                    h2d_bytes=int(rec_h.nbytes), h2d_transfers=1,
+                    d2h_bytes=0, d2h_transfers=0,
+                )
+                result, readback, dest, in_flight, weight, group = (
+                    self._trace(
+                        self.mesh, s.origin, s.elem, s.material_id,
+                        jax.device_put(rec_h), self.flux,
+                        self._perm_dev, _packed=True, **tkw,
+                    )
+                )
+                if self._io == "overlap":
+                    # Deferred bookkeeping of the PREVIOUS move runs
+                    # here, overlapping the device walk of THIS move.
+                    self._drain_pending()
+                host_rb = jax.device_get(readback)
+                io["d2h_bytes"] += int(host_rb.nbytes)
+                io["d2h_transfers"] += 1
+                final_pos, final_mats, done_h, tail = (
+                    staging.split_trace_readback(host_rb, n, cfg.dtype)
+                )
+                stats_d = (
+                    stats_to_dict(tail) if cfg.walk_stats else None
+                )
+                result, stats_d, n_lost, parts = self._escalate_truncated(
+                    result, dest, weight, group, stats_d, tkw,
+                    self.iter_count + 1, done_h=done_h, io=io,
+                )
+                if parts is not None:
+                    final_pos, final_mats, done_h, tail = parts
+            else:
+                dest = jnp.asarray(
+                    self._gather_in(dest3_h), dtype=cfg.dtype
+                )
+                in_flight = jnp.asarray(self._gather_in(fly_h))
+                weight = jnp.asarray(
+                    self._gather_in(weights_h), dtype=cfg.dtype
+                )
+                group = jnp.asarray(
+                    self._gather_in(groups_h), dtype=jnp.int32
+                )
+                io = dict(
+                    h2d_bytes=int(
+                        dest.nbytes + in_flight.nbytes + weight.nbytes
+                        + group.nbytes
+                    ),
+                    h2d_transfers=4, d2h_bytes=0, d2h_transfers=0,
+                )
+                result = self._trace(
+                    self.mesh,
+                    s.origin,
+                    dest,
+                    s.elem,
+                    in_flight,
+                    weight,
+                    group,
+                    s.material_id,
+                    self.flux,
+                    **tkw,
+                )
+                stats_d = self._read_stats(result)
+                if result.stats is not None:
+                    io["d2h_bytes"] += int(result.stats.nbytes)
+                    io["d2h_transfers"] += 1
+                result, stats_d, n_lost, _ = self._escalate_truncated(
+                    result, dest, weight, group, stats_d, tkw,
+                    self.iter_count + 1,
+                )
             self.flux = result.flux
             if self._prev_even is not None:
                 self.flux, self._prev_even = accumulate_batch_squares(
@@ -484,54 +668,84 @@ class PumiTally:
                 material_id=result.material_id,
             )
             self.iter_count += 1
+            self._traces_since_sort += 1
 
             # Copy-back contract: clipped final positions and material ids
             # into the caller's arrays (copy_last_location cpp:266-280,
             # copy_material_ids cpp:282-294); host flying flags reset to 0
             # (copy_and_reset_flying_flag cpp:316-319).
-            final_pos = np.asarray(result.position, dtype=np.float64)
-            final_mats = np.asarray(result.material_id, dtype=np.int32)
-            if self._perm is None:
-                dest_flat[: n * 3] = final_pos.reshape(-1)
+            if self._io != "legacy":
+                # The readback record was scattered into host pid order
+                # on device; both out-params are straight copies (the
+                # position assign widens walk dtype → f64).
+                dest_flat[: n * 3].reshape(n, 3)[:] = final_pos
                 mats_flat[:n] = final_mats
+                segs = (
+                    stats_d["segments"] if stats_d is not None
+                    else int(tail[0])
+                )
             else:
-                dest_flat[: n * 3].reshape(n, 3)[self._perm] = final_pos
-                mats_flat[:n][self._perm] = final_mats
+                final_pos = np.asarray(result.position, dtype=np.float64)
+                final_mats = np.asarray(result.material_id, dtype=np.int32)
+                io["d2h_bytes"] += int(
+                    result.position.nbytes + result.material_id.nbytes
+                )
+                io["d2h_transfers"] += 2
+                if self._perm is None:
+                    dest_flat[: n * 3] = final_pos.reshape(-1)
+                    mats_flat[:n] = final_mats
+                else:
+                    dest_flat[: n * 3].reshape(n, 3)[self._perm] = final_pos
+                    mats_flat[:n][self._perm] = final_mats
+                # ONE stats-vector fetch (taken above, refreshed by any
+                # escalation re-walk) carries segments + truncations +
+                # crossings — the pre-telemetry path read n_segments AND
+                # host-scanned the whole done array here.
+                segs = (
+                    stats_d["segments"] if stats_d is not None
+                    else int(result.n_segments)
+                )
             flying_flat[:n] = 0
-            # ONE stats-vector fetch (taken above, refreshed by any
-            # escalation re-walk) carries segments + truncations +
-            # crossings — the pre-telemetry path read n_segments AND
-            # host-scanned the whole done array here.
-            segs = (
-                stats_d["segments"] if stats_d is not None
-                else int(result.n_segments)
-            )
             self.total_segments += segs
             self._maybe_replan(segs, n_moving_h)
             self._store_xpoints(result)
+            # The truncation warning is a user-facing contract and stays
+            # in-call in every pipeline mode; only the telemetry fold is
+            # deferred under "overlap".
             self._warn_if_truncated(n_lost)
 
             # Periodic locality sort (the migrate-every-100 analog,
-            # cpp:256-258).
+            # cpp:256-258) — argsort and perm artifacts cached inside
+            # _resort_by_element, never recomputed per move.
             if (
                 cfg.sort_by_element
                 and self.iter_count % cfg.migration_period == 0
             ):
-                order = jnp.argsort(self.state.elem)
-                self.state = jax.tree_util.tree_map(
-                    lambda x: x[order], self.state
-                )
-                self._perm = np.asarray(self.state.particle_id)
+                self._resort_by_element()
             if cfg.measure_time:
                 timer.sync(self.state)
         self.tally_times.n_moves += 1
-        self._telemetry.record_walk(
-            "move",
-            self.iter_count,
-            stats_d,
-            seconds=self.tally_times.total_time_to_tally - t_before,
-            synced=cfg.measure_time,
-        )
+        seconds = self.tally_times.total_time_to_tally - t_before
+        if self._io == "overlap":
+            # Defer the telemetry fold so this move's bookkeeping
+            # overlaps the NEXT move's device walk; flushed by
+            # _drain_pending at every read surface.
+            move_no, synced = self.iter_count, cfg.measure_time
+            self._pending_folds.append(
+                lambda stats_d=stats_d, io=io: self._telemetry.record_walk(
+                    "move", move_no, stats_d, seconds=seconds,
+                    synced=synced, **io,
+                )
+            )
+        else:
+            self._telemetry.record_walk(
+                "move",
+                self.iter_count,
+                stats_d,
+                seconds=seconds,
+                synced=cfg.measure_time,
+                **io,
+            )
 
     # ------------------------------------------------------------------ #
     def _store_xpoints(self, result) -> None:
@@ -611,6 +825,7 @@ class PumiTally:
     def write_pumi_tally_mesh(self, filename: str | None = None) -> str:
         """Normalize flux, attach per-group cell fields + volume, write VTK
         (finalizeAndWritePumiFlux, cpp:685-705), print phase times."""
+        self._drain_pending()
         with annotate("PumiTally.write_pumi_tally_mesh"), phase_timer(
             self.tally_times, "vtk_file_write_time", True
         ):
@@ -627,6 +842,7 @@ class PumiTally:
         records, phase times (TallyTimes), a fresh per-device memory
         sample, and the full metrics-registry snapshot. Per-record JSONL
         streaming: set ``PUMI_TPU_METRICS=jsonl:/path``."""
+        self._drain_pending()
         return self._telemetry.snapshot(times=self.tally_times)
 
     @property
@@ -643,12 +859,14 @@ class PumiTally:
         this a natural extension."""
         from .utils.checkpoint import save_checkpoint
 
+        self._drain_pending()
         save_checkpoint(filename, self)
 
     def restore_checkpoint(self, filename: str) -> None:
         """Resume from a checkpoint written against the same mesh/config."""
         from .utils.checkpoint import restore_checkpoint
 
+        self._drain_pending()
         restore_checkpoint(filename, self)
 
     # ------------------------------------------------------------------ #

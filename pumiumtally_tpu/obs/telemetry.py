@@ -94,6 +94,27 @@ class TallyTelemetry:
             "walks declared lost after bounded re-walk retries (or "
             "immediately, with the escalation policy off)",
         )
+        # Move-loop I/O accounting (ops/staging.py): bytes and transfer
+        # counts the facade actually staged per trace.  Under
+        # io_pipeline="packed" the steady-state invariant is ONE H2D
+        # and ONE D2H per move — tests/test_io_pipeline.py asserts it
+        # through these counters under a jax.transfer_guard.
+        self._h2d_bytes = r.counter(
+            "pumi_h2d_bytes_total",
+            "host-to-device bytes staged by the move loop",
+        )
+        self._d2h_bytes = r.counter(
+            "pumi_d2h_bytes_total",
+            "device-to-host bytes read back by the move loop",
+        )
+        self._h2d_transfers = r.counter(
+            "pumi_h2d_transfers_total",
+            "host-to-device transfers issued by the move loop",
+        )
+        self._d2h_transfers = r.counter(
+            "pumi_d2h_transfers_total",
+            "device-to-host transfers issued by the move loop",
+        )
 
     # ------------------------------------------------------------------ #
     def record_walk(
@@ -126,6 +147,17 @@ class TallyTelemetry:
                 self._occ.set(stats["occupancy"])
         if "rounds" in extra:
             self._rounds.inc(int(extra["rounds"]))
+        # I/O accounting riding the same per-trace record (the facade
+        # passes what it actually staged — packed: one record each way;
+        # legacy: one entry per staged array).
+        for key, counter in (
+            ("h2d_bytes", self._h2d_bytes),
+            ("d2h_bytes", self._d2h_bytes),
+            ("h2d_transfers", self._h2d_transfers),
+            ("d2h_transfers", self._d2h_transfers),
+        ):
+            if key in extra:
+                counter.inc(int(extra[key]))
         return self.recorder.record(kind, **fields)
 
     def record_quarantine(
@@ -182,6 +214,10 @@ class TallyTelemetry:
                 "quarantined": quarantined,
                 "rewalked": self._rewalked.value(),
                 "lost": self._lost.value(),
+                "h2d_bytes": self._h2d_bytes.value(),
+                "d2h_bytes": self._d2h_bytes.value(),
+                "h2d_transfers": self._h2d_transfers.value(),
+                "d2h_transfers": self._d2h_transfers.value(),
             },
             # Headline resilience count, also at the top level: the
             # acceptance surface is telemetry()["quarantined"].

@@ -1,0 +1,482 @@
+"""Move-loop I/O staging: packed host↔device records for both facades.
+
+The walk kernel is already device-tuned; what the PARTITIONED_PROFILE
+round-5 decomposition showed is that the FACADE move loop is not — each
+``move_to_next_location`` issued 4-5 separate ``jnp.asarray`` H2D
+transfers (destinations, flying flags, weights, groups), a host-side
+numpy permutation gather, and then blocked on per-array D2H readbacks
+(positions, material ids, stats).  PUMI-Tally (PAPERS.md) identifies
+exactly this host↔device staging as the residual cost once the walk is
+on-device.  This module makes the transfer count structural:
+
+  * **packed staging (H2D)** — destinations / flying / weight / group
+    are packed into ONE contiguous host record buffer
+    (``[n, MOVE_COLS]`` carrier words) so each move issues exactly one
+    ``jax.device_put``; a device-side unpack fused into the compiled
+    step (ops/walk.py ``trace_packed``) bitcasts the columns back.
+  * **device-resident permutation** — when the periodic element sort is
+    active, the slot permutation lives on device
+    (``state.particle_id``) and the gather into slot order is fused
+    into the unpack; the inverse scatter back into host pid order is
+    fused into the readback pack.  No host-side numpy permutation on
+    the hot path.
+  * **coalesced readback (D2H)** — clipped positions, material ids,
+    done flags and the walk-stats vector are packed into ONE flat
+    device record inside the compiled step, so each move issues exactly
+    one ``jax.device_get``.
+
+Encoding: every record uses a CARRIER unsigned integer dtype of the
+walk dtype's width (uint32 for f32, uint64 for f64) so floats travel
+bit-exactly (``lax.bitcast_convert_type``; verified against numpy's
+little-endian ``.view`` pairing) and ints travel sign-extended.  The
+packed pipeline is therefore bit-identical to the legacy multi-transfer
+path — pinned by tests/test_io_pipeline.py on both facades.
+
+Tail integers (stats vectors, round stats, scalar counters) are widened
+to int64 before bitcasting into carrier words, so counter ranges never
+depend on the carrier width.
+
+Host staging buffers are allocated through :class:`HostStager`.  On CPU
+``jax.device_put`` ZERO-COPIES numpy buffers (verified empirically), so
+buffers there are freshly allocated per move; on real accelerators the
+H2D copy is genuine and ``io_pipeline="overlap"`` double-buffers two
+pinned host records so packing move k+1 never waits on (or races) the
+in-flight copy of move k.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# Record column layouts (single-chip facade).
+MOVE_COLS = 6   # dest x,y,z | weight | group | flying
+INIT_COLS = 4   # dest x,y,z | flying
+READBACK_COLS = 5  # pos x,y,z | material_id | done
+
+# Partitioned facade: slot-major records over [n_parts * cap] lanes.
+PART_IN_COLS = 12   # origin(3) | dest(3) | weight | group | material |
+#                     elem | particle_id | valid
+PART_RB_SLOT_COLS = 9  # pos(3) | material | elem | done | track | pid | valid
+
+
+# --------------------------------------------------------------------- #
+# Carrier dtype helpers
+# --------------------------------------------------------------------- #
+def np_carrier(dtype) -> np.dtype:
+    """Host carrier dtype for a walk dtype: unsigned int of equal width."""
+    itemsize = np.dtype(dtype).itemsize
+    if itemsize == 4:
+        return np.dtype(np.uint32)
+    if itemsize == 8:
+        return np.dtype(np.uint64)
+    raise NotImplementedError(
+        f"packed staging needs a 4- or 8-byte walk dtype, got {dtype!r}"
+    )
+
+
+def _jnp_carrier(dtype):
+    return jnp.uint32 if jnp.dtype(dtype).itemsize == 4 else jnp.uint64
+
+
+# Host-side int32 encode/decode through the carrier (sign-preserving:
+# int32 -1 round-trips through either carrier width).
+def _enc_i32_host(values, carrier: np.dtype) -> np.ndarray:
+    v = np.ascontiguousarray(values, np.int32)
+    if carrier == np.uint32:
+        return v.view(np.uint32)
+    return v.astype(np.int64).view(np.uint64)
+
+
+def _dec_i32_host(col, carrier: np.dtype) -> np.ndarray:
+    c = np.ascontiguousarray(col)
+    if carrier == np.uint32:
+        return c.view(np.int32)
+    return c.view(np.int64).astype(np.int32)
+
+
+def _dec_f_host(cols, dtype: np.dtype) -> np.ndarray:
+    return np.ascontiguousarray(cols).view(np.dtype(dtype))
+
+
+def _dec_i64_host(cols) -> np.ndarray:
+    """Tail decode: carrier words back to the int64 values they encode
+    (the byte stream is the int64 array's little-endian bytes)."""
+    return np.ascontiguousarray(cols).view(np.int64)
+
+
+# Device-side (traced) encode/decode — used INSIDE the compiled step.
+def _enc_f_dev(x, carrier):
+    return lax.bitcast_convert_type(x, carrier)
+
+
+def _dec_f_dev(x, dtype):
+    return lax.bitcast_convert_type(x, dtype)
+
+
+def _enc_i32_dev(x, carrier):
+    if carrier == jnp.uint32:
+        return lax.bitcast_convert_type(x.astype(jnp.int32), jnp.uint32)
+    return lax.bitcast_convert_type(x.astype(jnp.int64), jnp.uint64)
+
+
+def _dec_i32_dev(x):
+    if x.dtype == jnp.uint32:
+        return lax.bitcast_convert_type(x, jnp.int32)
+    return lax.bitcast_convert_type(x, jnp.int64).astype(jnp.int32)
+
+
+def _widen_counts(x):
+    """Counters at the widest integer the runtime HAS: int64 under x64,
+    int32 otherwise (jnp.int64 silently truncates to int32 without x64,
+    which would corrupt the tail encoding below)."""
+    return x.astype(
+        jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+    )
+
+
+def _enc_i64_tail_dev(vals, carrier):
+    """Encode integer counters as the byte stream of a little-endian
+    int64 array (the host decodes with ``.view(np.int64)``), WITHOUT
+    requiring x64: 64-bit inputs bitcast directly into carrier words;
+    32-bit inputs (x64 off, or int32 counters under x64) emit an
+    explicit (lo, sign-extension) uint32 word pair."""
+    if jnp.dtype(vals.dtype).itemsize == 8:
+        return lax.bitcast_convert_type(vals, carrier).reshape(
+            vals.shape[:-1] + (-1,)
+        )
+    v32 = vals.astype(jnp.int32)
+    lo = lax.bitcast_convert_type(v32, jnp.uint32)
+    hi = jnp.where(v32 < 0, jnp.uint32(0xFFFFFFFF), jnp.uint32(0))
+    return jnp.stack([lo, hi], axis=-1).reshape(
+        vals.shape[:-1] + (-1,)
+    )
+
+
+def tail_words_per_i64(carrier_itemsize: int) -> int:
+    return 8 // carrier_itemsize
+
+
+# --------------------------------------------------------------------- #
+# Host staging buffers
+# --------------------------------------------------------------------- #
+class HostStager:
+    """Reusable host record buffers for the packed pipeline.
+
+    ``jax.device_put`` zero-copies host numpy buffers on the CPU
+    backend (the device array ALIASES the numpy memory — verified), so
+    reuse there would scribble over a buffer the runtime may still
+    reference; CPU always allocates fresh.  On accelerators the H2D
+    copy is real: ``depth=1`` (packed) reuses one buffer — the facade
+    blocks on every move's readback, which fences the previous copy —
+    and ``depth=2`` (overlap) alternates two so packing move k+1 never
+    waits on the in-flight copy of move k.
+    """
+
+    def __init__(self, depth: int = 1):
+        self.depth = max(1, int(depth))
+        # Per-(shape, dtype) ring + its own rotation counter: reuse must
+        # hand back the OLDEST buffer (the one whose H2D copy is the
+        # furthest in the past), and interleaved record shapes (init vs
+        # move) must not steal each other's rotation.
+        self._bufs: dict = {}
+
+    def buf(self, shape: tuple, dtype) -> np.ndarray:
+        key = (tuple(shape), np.dtype(dtype))
+        if jax.default_backend() == "cpu":
+            return np.zeros(shape, dtype)
+        ring, turn = self._bufs.setdefault(key, ([], 0))
+        if len(ring) < self.depth:
+            ring.append(np.zeros(shape, dtype))
+            self._bufs[key] = (ring, turn)
+            return ring[-1]
+        b = ring[turn % self.depth]
+        self._bufs[key] = (ring, turn + 1)
+        b.fill(0)
+        return b
+
+
+# --------------------------------------------------------------------- #
+# Single-chip facade records
+# --------------------------------------------------------------------- #
+def pack_move_record(
+    stager: HostStager, dest3, weights, groups, fly, dtype
+) -> np.ndarray:
+    """ONE host record per move: [n, MOVE_COLS] carrier words in host
+    pid order (the device unpack applies the slot permutation)."""
+    npdt = np.dtype(dtype)
+    carrier = np_carrier(npdt)
+    n = dest3.shape[0]
+    rec = stager.buf((n, MOVE_COLS), carrier)
+    rec[:, 0:3] = np.ascontiguousarray(dest3, np.float64).astype(
+        npdt
+    ).view(carrier)
+    rec[:, 3] = np.ascontiguousarray(weights, np.float64).astype(
+        npdt
+    ).view(carrier)
+    # Groups are host-validated non-negative (< n_groups), so a plain
+    # value store round-trips exactly through either carrier.
+    rec[:, 4] = np.ascontiguousarray(groups, np.int64).astype(carrier)
+    rec[:, 5] = np.ascontiguousarray(fly).astype(carrier)
+    return rec
+
+
+def pack_init_record(stager: HostStager, dest3, fly, dtype) -> np.ndarray:
+    """Initial-search record: destinations + flying flags only (weight
+    and group come from device-resident state)."""
+    npdt = np.dtype(dtype)
+    carrier = np_carrier(npdt)
+    n = dest3.shape[0]
+    rec = stager.buf((n, INIT_COLS), carrier)
+    rec[:, 0:3] = np.ascontiguousarray(dest3, np.float64).astype(
+        npdt
+    ).view(carrier)
+    rec[:, 3] = np.ascontiguousarray(fly).astype(carrier)
+    return rec
+
+
+def unpack_move_record(rec, dtype, perm, initial: bool):
+    """Device-side (traced) inverse of pack_move_record/pack_init_record,
+    with the slot-permutation gather fused in: host rows are pid order,
+    device slot i holds particle ``perm[i]``."""
+    if perm is not None:
+        rec = rec[perm]
+    dest = _dec_f_dev(rec[:, 0:3], dtype)
+    if initial:
+        return dest, rec[:, 3] != 0, None, None
+    weight = _dec_f_dev(rec[:, 3], dtype)
+    group = rec[:, 4].astype(jnp.int32)
+    return dest, rec[:, 5] != 0, weight, group
+
+
+def pack_trace_readback(position, material_id, done, stats, n_segments,
+                        perm):
+    """Device-side (traced) readback pack: [n, READBACK_COLS] slot
+    record scattered back into host pid order (the inverse of the
+    unpack's perm gather), flattened, with the walk-stats vector — or,
+    when walk stats are off, the scalar segment count — appended as an
+    int64-encoded tail.  ONE ``device_get`` then carries everything the
+    facade needs per move."""
+    carrier = _jnp_carrier(position.dtype)
+    slot = jnp.concatenate(
+        [
+            _enc_f_dev(position, carrier),
+            _enc_i32_dev(material_id, carrier)[:, None],
+            done.astype(carrier)[:, None],
+        ],
+        axis=1,
+    )
+    if perm is not None:
+        slot = jnp.zeros_like(slot).at[perm].set(slot)
+    tail_src = stats if stats is not None else n_segments[None]
+    tail = _enc_i64_tail_dev(tail_src, carrier)
+    return jnp.concatenate([slot.reshape(-1), tail])
+
+
+_pack_trace_readback_jit = jax.jit(pack_trace_readback)
+
+
+def pack_trace_readback_cold(result, perm):
+    """Standalone jitted readback pack for cold paths (truncation
+    escalation re-walks produce a merged TraceResult outside the packed
+    step)."""
+    return _pack_trace_readback_jit(
+        result.position, result.material_id, result.done, result.stats,
+        result.n_segments, perm,
+    )
+
+
+def split_trace_readback(host_rec, n: int, dtype):
+    """Host-side inverse of pack_trace_readback.  Returns
+    ``(position [n,3] walk-dtype, material_id [n] int32, done [n] bool,
+    tail int64 array)`` where ``tail`` is the stats vector (walk stats
+    on) or ``[n_segments]`` (off)."""
+    npdt = np.dtype(dtype)
+    slot = host_rec[: n * READBACK_COLS].reshape(n, READBACK_COLS)
+    position = _dec_f_host(slot[:, 0:3], npdt)
+    material_id = _dec_i32_host(slot[:, 3], np_carrier(npdt))
+    done = slot[:, 4] != 0
+    tail = _dec_i64_host(host_rec[n * READBACK_COLS:])
+    return position, material_id, done, tail
+
+
+# --------------------------------------------------------------------- #
+# Partitioned facade records
+# --------------------------------------------------------------------- #
+def pack_partitioned_record(
+    partition, global_elem, fields: dict, cap: int, dtype,
+    stager: HostStager,
+) -> np.ndarray:
+    """Slot-major host record [n_parts*cap, PART_IN_COLS]: the packed
+    equivalent of walk_partitioned.distribute_particles (same owner /
+    slot computation), staged as ONE array instead of eight."""
+    npdt = np.dtype(dtype)
+    carrier = np_carrier(npdt)
+    n = int(np.asarray(global_elem).shape[0])
+    n_parts = partition.n_parts
+    owner = partition.owner[np.asarray(global_elem)].astype(np.int64)
+    counts = np.bincount(owner, minlength=n_parts)
+    if counts.max(initial=0) > cap:
+        raise ValueError(
+            f"chip {int(counts.argmax())} needs {int(counts.max())} slots "
+            f"at seed time but cap={cap}"
+        )
+    order = np.argsort(owner, kind="stable")
+    start = np.searchsorted(owner[order], np.arange(n_parts))
+    rank_in_part = np.arange(n, dtype=np.int64) - start[owner[order]]
+    slot_of = np.empty(n, np.int64)
+    slot_of[order] = owner[order] * cap + rank_in_part
+
+    rec = stager.buf((n_parts * cap, PART_IN_COLS), carrier)
+    # Empty slots carry pid = -1 (the legacy distribute fill) and
+    # valid = 0; every other empty-slot column is inert zero bits.
+    rec[:, 10] = _enc_i32_host(np.full(1, -1, np.int32), carrier)[0]
+    rec[slot_of, 0:3] = np.ascontiguousarray(
+        fields["origin"], np.float64
+    ).astype(npdt).view(carrier)
+    rec[slot_of, 3:6] = np.ascontiguousarray(
+        fields["dest"], np.float64
+    ).astype(npdt).view(carrier)
+    rec[slot_of, 6] = np.ascontiguousarray(
+        fields["weight"], np.float64
+    ).astype(npdt).view(carrier)
+    rec[slot_of, 7] = np.ascontiguousarray(
+        fields["group"], np.int64
+    ).astype(carrier)
+    rec[slot_of, 8] = _enc_i32_host(fields["material_id"], carrier)
+    rec[slot_of, 9] = partition.global2local[
+        np.asarray(global_elem)
+    ].astype(carrier)
+    rec[slot_of, 10] = _enc_i32_host(
+        np.arange(n, dtype=np.int32), carrier
+    )
+    rec[slot_of, 11] = 1
+    return rec
+
+
+def unpack_partitioned_record(rec):
+    """Device-side (traced) inverse of pack_partitioned_record.  The walk
+    dtype is implied by the carrier width.  Returns the step's ten
+    per-particle inputs (done starts all-False)."""
+    dtype = jnp.float32 if rec.dtype == jnp.uint32 else jnp.float64
+    origin = _dec_f_dev(rec[:, 0:3], dtype)
+    dest = _dec_f_dev(rec[:, 3:6], dtype)
+    weight = _dec_f_dev(rec[:, 6], dtype)
+    group = rec[:, 7].astype(jnp.int32)
+    material_id = _dec_i32_dev(rec[:, 8])
+    elem = rec[:, 9].astype(jnp.int32)
+    pid = _dec_i32_dev(rec[:, 10])
+    valid = rec[:, 11] != 0
+    done = jnp.zeros_like(valid)
+    return origin, dest, elem, done, material_id, weight, group, pid, valid
+
+
+def pack_partitioned_readback(res, n_parts: int):
+    """Device-side (traced) coalesced readback for the partitioned step:
+    per-slot outputs ([pos, material, elem, done, track, pid, valid] →
+    PART_RB_SLOT_COLS carrier words) plus a per-chip int64 tail carrying
+    the stats vector, the round-stats matrix and the scalar counters
+    (n_rounds, n_dropped, n_segments) — ONE [n_parts, cap*COLS + tail]
+    array sharded on its leading axis, ONE ``device_get``."""
+    carrier = _jnp_carrier(res.position.dtype)
+    cap = res.position.shape[0] // n_parts
+    slot = jnp.concatenate(
+        [
+            _enc_f_dev(res.position, carrier),
+            _enc_i32_dev(res.material_id, carrier)[:, None],
+            _enc_i32_dev(res.elem, carrier)[:, None],
+            res.done.astype(carrier)[:, None],
+            _enc_f_dev(res.track_length, carrier)[:, None],
+            _enc_i32_dev(res.particle_id, carrier)[:, None],
+            res.valid.astype(carrier)[:, None],
+        ],
+        axis=1,
+    ).reshape(n_parts, cap * PART_RB_SLOT_COLS)
+    tail_i64 = jnp.concatenate(
+        [
+            _widen_counts(res.stats),
+            _widen_counts(res.round_stats.reshape(n_parts, -1)),
+            _widen_counts(res.n_rounds)[:, None],
+            _widen_counts(res.n_dropped)[:, None],
+            _widen_counts(res.n_segments)[:, None],
+        ],
+        axis=1,
+    )
+    tail = _enc_i64_tail_dev(tail_i64, carrier)
+    return jnp.concatenate([slot, tail], axis=1)
+
+
+def split_partitioned_readback(host_rec, n_parts: int, cap: int,
+                               dtype) -> dict:
+    """Host-side inverse of pack_partitioned_readback.  ``cap`` is the
+    facade's per-chip slot count; the round-stats bound R is recovered
+    from the remaining tail width."""
+    npdt = np.dtype(dtype)
+    carrier = np_carrier(npdt)
+    from ..obs import WALK_STATS_LEN
+
+    w = tail_words_per_i64(carrier.itemsize)
+    width = host_rec.shape[1]
+    rem = width - cap * PART_RB_SLOT_COLS
+    if rem < 0 or rem % w:
+        raise ValueError(
+            f"cannot split a [{n_parts}, {width}] partitioned readback "
+            f"at cap={cap}"
+        )
+    ints = rem // w - WALK_STATS_LEN - 3
+    if ints < 0 or ints % 6:
+        raise ValueError(
+            f"partitioned readback tail of {rem // w} int64s does not "
+            f"decode at cap={cap}"
+        )
+    R = ints // 6
+    slot = host_rec[:, : cap * PART_RB_SLOT_COLS].reshape(
+        n_parts * cap, PART_RB_SLOT_COLS
+    )
+    tail_i64 = _dec_i64_host(
+        host_rec[:, cap * PART_RB_SLOT_COLS:]
+    ).reshape(n_parts, -1)
+    out = {
+        "position": _dec_f_host(slot[:, 0:3], npdt),
+        "material_id": _dec_i32_host(slot[:, 3], carrier),
+        "elem": _dec_i32_host(slot[:, 4], carrier),
+        "done": slot[:, 5] != 0,
+        "track_length": _dec_f_host(slot[:, 6], npdt),
+        "particle_id": _dec_i32_host(slot[:, 7], carrier),
+        "valid": slot[:, 8] != 0,
+        "stats": tail_i64[:, :WALK_STATS_LEN],
+        "round_stats": tail_i64[
+            :, WALK_STATS_LEN: WALK_STATS_LEN + 6 * R
+        ].reshape(n_parts, 6, R),
+        "n_rounds": tail_i64[:, WALK_STATS_LEN + 6 * R],
+        "n_dropped": tail_i64[:, WALK_STATS_LEN + 6 * R + 1],
+        "n_segments": tail_i64[:, WALK_STATS_LEN + 6 * R + 2],
+    }
+    return out
+
+
+def collect_packed(parsed: dict, n: int, partition) -> dict:
+    """Gather the packed per-slot outputs back into host pid order —
+    the packed-record equivalent of
+    walk_partitioned.collect_by_particle_id (same zero-fill defaults,
+    same elem_global resolution via the holding chip's local2global)."""
+    pid = parsed["particle_id"]
+    valid = parsed["valid"]
+    sel = valid & (pid >= 0)
+    idx = pid[sel]
+    out = {}
+    for name in ("position", "material_id", "done", "elem",
+                 "track_length"):
+        arr = parsed[name]
+        buf = np.zeros((n,) + arr.shape[1:], arr.dtype)
+        buf[idx] = arr[sel]
+        out[name] = buf
+    cap = pid.shape[0] // partition.n_parts
+    chip = (np.arange(pid.shape[0]) // cap)[sel]
+    eg = partition.local2global[chip, parsed["elem"][sel]]
+    buf = np.full(n, -1, np.int64)
+    buf[idx] = eg
+    out["elem_global"] = buf
+    return out

@@ -1197,6 +1197,106 @@ trace.__doc__ = trace_impl.__doc__
 
 
 # --------------------------------------------------------------------- #
+# Packed-I/O trace (move-loop pipelining; ops/staging.py)
+# --------------------------------------------------------------------- #
+def trace_packed_impl(
+    mesh,
+    origin,
+    elem,
+    material_id,
+    record,
+    flux,
+    perm=None,
+    weight=None,
+    group=None,
+    **kwargs,
+):
+    """The fused packed-I/O step: device-side record unpack (with the
+    slot-permutation gather), the full walk, and the coalesced readback
+    pack — ONE compiled program, so a steady-state facade move issues
+    exactly one H2D transfer (the input record) and one D2H transfer
+    (the readback record).
+
+    ``record`` is a [n, MOVE_COLS] (or [n, INIT_COLS] when
+    ``initial=True``) carrier-word host record (staging.pack_move_record
+    / pack_init_record), donated.  ``perm`` is the device-resident slot
+    permutation (``state.particle_id`` after a periodic element sort) or
+    None while the layout is identity.  For the initial search,
+    ``weight``/``group`` come from device state instead of the record.
+
+    Returns ``(TraceResult, readback, dest, in_flight, weight, group)``
+    — the staged device arrays ride along so the facade can update its
+    state and re-arm escalation re-walks without re-staging.
+    """
+    from .staging import pack_trace_readback, unpack_move_record
+
+    initial = kwargs["initial"]
+    dest, in_flight, w, g = unpack_move_record(
+        record, origin.dtype, perm, initial
+    )
+    if w is None:
+        w, g = weight, group
+    r = trace_impl(
+        mesh, origin, dest, elem, in_flight, w, g, material_id, flux,
+        **kwargs,
+    )
+    readback = pack_trace_readback(
+        r.position, r.material_id, r.done, r.stats, r.n_segments, perm
+    )
+    return r, readback, dest, in_flight, w, g
+
+
+_trace_packed_jit = jax.jit(
+    trace_packed_impl,
+    static_argnames=(
+        "initial",
+        "max_crossings",
+        "score_squares",
+        "tolerance",
+        "compact_after",
+        "compact_size",
+        "compact_stages",
+        "unroll",
+        "robust",
+        "tally_scatter",
+        "gathers",
+        "ledger",
+        "stats",
+        "debug_checks",
+        "record_xpoints",
+        "n_groups",
+    ),
+    # The flux carry is donated exactly like the unpacked trace — a
+    # supervisor retry re-sees its original inputs because the facade
+    # re-packs the staging record from the caller's untouched host
+    # arrays (PR 2's re-arm contract).  The record itself is NOT
+    # donated: no output shares its carrier shape, so XLA would only
+    # warn.
+    donate_argnames=("flux",),
+)
+
+_PACKED_FLUX_ARG_INDEX = list(
+    inspect.signature(trace_packed_impl).parameters
+).index("flux")
+
+
+def trace_packed(*args, **kwargs):
+    if kwargs.get("tally_scatter", "auto") == "auto":
+        flux = (
+            args[_PACKED_FLUX_ARG_INDEX]
+            if len(args) > _PACKED_FLUX_ARG_INDEX
+            else kwargs.get("flux")
+        )
+        kwargs = dict(
+            kwargs, tally_scatter=resolve_tally_scatter("auto", flux)
+        )
+    return _trace_packed_jit(*args, **kwargs)
+
+
+trace_packed.__doc__ = trace_packed_impl.__doc__
+
+
+# --------------------------------------------------------------------- #
 # Truncated-lane escalation (resilience)
 # --------------------------------------------------------------------- #
 def merge_recorded_xpoints(xa, ka, xb, kb, rows_a, rows_b) -> None:

@@ -156,6 +156,11 @@ class PartitionedTraceResult(NamedTuple):
     # iterations plus every follow-up round's iterations (round_stats
     # row 5). obs.walk_stats.reduce_chip_stats aggregates the matrix.
     stats: jax.Array | None = None
+    # [n_parts, cap*PART_RB_SLOT_COLS + tail] coalesced readback record
+    # (ops/staging.py pack_partitioned_readback), present only when the
+    # step was built with packed_io=True: ONE device_get carries the
+    # per-slot outputs AND the per-chip stats/round-stats/counters.
+    readback: jax.Array | None = None
 
 
 def _walk_phase(
@@ -550,6 +555,7 @@ def make_partitioned_step(
     robust: bool = True,
     tally_scatter: str = "auto",
     record_xpoints: int | None = None,
+    packed_io: bool = False,
 ):
     """Build the jitted distributed trace step for one mesh partition.
 
@@ -585,6 +591,16 @@ def make_partitioned_step(
         compaction rounds, AND the migration exchange (payload grows by
         3K floats + 1 int per emigrant row), so a particle's recorded
         sequence is its full path order across chips.
+      packed_io: move-loop I/O pipelining (ops/staging.py). When True
+        the returned callable is ``step(record, flux)`` where
+        ``record`` is the [n_parts*cap, PART_IN_COLS] carrier-word
+        record from staging.pack_partitioned_record (donated; ONE H2D
+        per move), the record unpack runs inside the compiled program,
+        and the result carries a coalesced ``readback`` array packing
+        every per-slot output plus the per-chip stats/round-stats/
+        counters (ONE D2H per move).  Bit-identical to the unpacked
+        step.  Incompatible with record_xpoints (the facade falls back
+        to the legacy pipeline there).
 
     Returns step(cur, dest, elem, done, material, weight, group, pid, valid,
     flux) -> PartitionedTraceResult, where per-particle arrays are
@@ -1035,6 +1051,35 @@ def make_partitioned_step(
             stats=P(AXIS),
         ),
     )
+    if packed_io:
+        if record_xpoints is not None:
+            raise NotImplementedError(
+                "packed_io does not carry the intersection-point "
+                "buffers; use the unpacked step for record_xpoints"
+            )
+        from .staging import (
+            pack_partitioned_readback,
+            unpack_partitioned_record,
+        )
+
+        def packed_impl(record, flux):
+            (cur, dest, elem, done, material_id, weight, group, pid,
+             valid) = unpack_partitioned_record(record)
+            res = mapped(
+                *tables, *halo_tables, cur, dest, elem, done,
+                material_id, weight, group, pid, valid, flux,
+            )
+            return res._replace(
+                readback=pack_partitioned_readback(res, n_parts)
+            )
+
+        # Donate the flux slab exactly like the unpacked step; a
+        # supervisor retry re-sees its original inputs because the
+        # facade re-packs the staging record from the caller's
+        # untouched host arrays (PR 2's re-arm contract).  The record
+        # is not donated — no output shares its carrier shape.
+        return jax.jit(packed_impl, donate_argnums=(1,))
+
     jitted = jax.jit(
         mapped, donate_argnums=(6 + len(halo_tables) + 9,)  # the flux slab
     )

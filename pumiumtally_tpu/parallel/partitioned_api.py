@@ -45,6 +45,7 @@ from ..obs import (
     WALK_STATS_FIELDS,
     reduce_chip_stats,
 )
+from ..ops import staging
 from ..ops.walk_partitioned import (
     collect_by_particle_id,
     distribute_particles,
@@ -184,6 +185,15 @@ class PartitionedTally:
             max_rounds=max_rounds,
         )
         self._steps: dict = {}
+        # Move-loop I/O pipelining (ops/staging.py; PumiTally mirror):
+        # "packed"/"overlap" stage ONE record per walk each way through
+        # the packed step; "overlap" double-buffers the host record and
+        # defers telemetry folds past the next dispatch.
+        self._io = self.config.resolve_io_pipeline()
+        self._stager = staging.HostStager(
+            depth=2 if self._io == "overlap" else 1
+        )
+        self._pending_folds: list = []
         # Flat per-chip slabs [n_parts, max_local*n_groups*2]: the TPU
         # production layout (3-D slabs pad their minor dim 2 → 128 under
         # the (8,128) tile; core.tally.make_flux). The 3-D view is
@@ -268,15 +278,23 @@ class PartitionedTally:
         return lanes(self)
 
     def _step(self, initial: bool):
-        key = bool(initial)
+        key = (bool(initial), self._io != "legacy")
         if key not in self._steps:
             self._steps[key] = make_partitioned_step(
                 self.device_mesh,
                 self.partition,
                 initial=initial,
+                packed_io=self._io != "legacy",
                 **self._step_kwargs,
             )
         return self._steps[key]
+
+    def _drain_pending(self) -> None:
+        """Flush deferred telemetry folds (io_pipeline="overlap") — see
+        PumiTally._drain_pending."""
+        pending, self._pending_folds = self._pending_folds, []
+        for fold in pending:
+            fold()
 
     def _run(self, dest, in_flight, weight, group, initial):
         field = (
@@ -292,14 +310,28 @@ class PartitionedTally:
             )
             if self.config.measure_time:
                 timer.sync(self.flux_slabs)
-        self._telemetry.record_walk(
-            "initial_search" if initial else "move",
-            self.iter_count + (0 if initial else 1),
-            stats.pop("agg"),
-            seconds=getattr(self.tally_times, field) - t_before,
-            synced=self.config.measure_time,
-            **stats,
-        )
+        kind = "initial_search" if initial else "move"
+        move_no = self.iter_count + (0 if initial else 1)
+        agg = stats.pop("agg")
+        seconds = getattr(self.tally_times, field) - t_before
+        if self._io == "overlap" and not initial:
+            # Defer the fold so this move's bookkeeping overlaps the
+            # next move's device walk (drained in _walk_once after the
+            # step dispatch, and at every read surface).
+            synced = self.config.measure_time
+            self._pending_folds.append(
+                lambda: self._telemetry.record_walk(
+                    kind, move_no, agg, seconds=seconds, synced=synced,
+                    **stats,
+                )
+            )
+        else:
+            self._telemetry.record_walk(
+                kind, move_no, agg,
+                seconds=seconds,
+                synced=self.config.measure_time,
+                **stats,
+            )
         return got, moving
 
     def _run_inner(self, dest, in_flight, weight, group, initial):
@@ -327,7 +359,9 @@ class PartitionedTally:
             )
             _merge_got(got, sub_trunc, got2)
             stats["agg"] = _merge_agg(stats["agg"], stats2["agg"])
-            for f in ("rounds", "dropped", "migrated", "adopted"):
+            for f in ("rounds", "dropped", "migrated", "adopted",
+                      "h2d_bytes", "h2d_transfers", "d2h_bytes",
+                      "d2h_transfers"):
                 stats[f] += stats2[f]
             for f in ("per_chip_segments", "per_chip_crossings"):
                 stats[f] = [
@@ -370,7 +404,12 @@ class PartitionedTally:
 
     def _walk_once(self, dest, moving, weight, group, initial):
         """One distribute → partitioned step → collect/fold pass over
-        the ``moving`` subset (the pre-escalation ``_run_inner`` body)."""
+        the ``moving`` subset (the pre-escalation ``_run_inner`` body).
+        Dispatches to the packed pipeline unless io_pipeline="legacy"."""
+        if self._io != "legacy":
+            return self._walk_once_packed(
+                dest, moving, weight, group, initial
+            )
         placed = distribute_particles(
             self.partition,
             self.device_mesh,
@@ -417,6 +456,14 @@ class PartitionedTally:
         agg = reduce_chip_stats(sv)
         rs = np.asarray(res.round_stats)  # [n_parts, 6, rounds_bound]
         n_rounds = int(np.asarray(res.n_rounds)[0])
+        # Legacy-path I/O accounting: one device_put per distributed
+        # field, one readback per collected/consumed result array.
+        d2h_reads = [
+            res.particle_id, res.valid, res.position, res.material_id,
+            res.done, res.elem, res.weight, res.group, res.track_length,
+            res.stats, res.round_stats, res.n_rounds, res.n_dropped,
+        ] + ([res.xpoints, res.n_xpoints] if res.xpoints is not None
+             else [])
         stats = {
             "agg": agg,
             "rounds": n_rounds,
@@ -427,6 +474,81 @@ class PartitionedTally:
             "adopted": int(rs[:, 4].sum()),
             "per_chip_segments": sv[:, IDX["segments"]].tolist(),
             "per_chip_crossings": sv[:, IDX["crossings"]].tolist(),
+            "h2d_bytes": sum(int(v.nbytes) for v in placed.values()),
+            "h2d_transfers": len(placed),
+            "d2h_bytes": sum(int(a.nbytes) for a in d2h_reads),
+            "d2h_transfers": len(d2h_reads),
+        }
+        self.total_segments += agg["segments"]
+        self.total_rounds += n_rounds
+        return got, stats
+
+    def _walk_once_packed(self, dest, moving, weight, group, initial):
+        """The _walk_once body over the packed pipeline (ops/staging.py):
+        the slot distribution is packed into ONE carrier record and
+        device_put once; the step unpacks it in-program and returns a
+        coalesced readback record, so the whole pass is ONE H2D + ONE
+        D2H.  Bit-identical to the legacy path (pinned by
+        tests/test_io_pipeline.py)."""
+        rec_h = staging.pack_partitioned_record(
+            self.partition,
+            self.elem_global[moving],
+            dict(
+                origin=self.positions[moving],
+                dest=dest[moving],
+                weight=weight[moving],
+                group=group[moving],
+                material_id=self.material_id[moving],
+            ),
+            self.cap,
+            self.config.dtype,
+            self._stager,
+        )
+        io = dict(
+            h2d_bytes=int(rec_h.nbytes), h2d_transfers=1,
+            d2h_bytes=0, d2h_transfers=0,
+        )
+        rec = jax.device_put(
+            rec_h, NamedSharding(self.device_mesh, P(AXIS))
+        )
+        res = self._step(initial)(rec, self.flux_slabs)
+        self.flux_slabs = res.flux
+        if self._io == "overlap":
+            # The previous move's deferred bookkeeping overlaps this
+            # step's device execution.
+            self._drain_pending()
+        host_rb = jax.device_get(res.readback)
+        io["d2h_bytes"] += int(host_rb.nbytes)
+        io["d2h_transfers"] += 1
+        parsed = staging.split_partitioned_readback(
+            host_rb, self.n_parts, self.cap, self.config.dtype
+        )
+        got = staging.collect_packed(
+            parsed, int(moving.sum()), self.partition
+        )
+        n_dropped = int(parsed["n_dropped"].sum())
+        if n_dropped != 0:
+            raise RuntimeError(
+                "partitioned walk dropped immigrants: raise cap"
+            )
+        # Fold the moved particles back into full host order.
+        self.positions[moving] = got["position"]
+        self.elem_global[moving] = got["elem_global"]
+        if not initial:
+            self.material_id[moving] = got["material_id"]
+        sv = parsed["stats"]
+        agg = reduce_chip_stats(sv)
+        rs = parsed["round_stats"]
+        n_rounds = int(parsed["n_rounds"][0])
+        stats = {
+            "agg": agg,
+            "rounds": n_rounds,
+            "dropped": n_dropped,
+            "migrated": int(rs[:, 1].sum()),
+            "adopted": int(rs[:, 4].sum()),
+            "per_chip_segments": sv[:, IDX["segments"]].tolist(),
+            "per_chip_crossings": sv[:, IDX["crossings"]].tolist(),
+            **io,
         }
         self.total_segments += agg["segments"]
         self.total_rounds += n_rounds
@@ -577,6 +699,7 @@ class PartitionedTally:
         count or halo depth (utils/checkpoint.py)."""
         from ..utils.checkpoint import save_partitioned_checkpoint
 
+        self._drain_pending()
         save_partitioned_checkpoint(filename, self)
 
     def restore_checkpoint(self, filename: str) -> None:
@@ -584,6 +707,7 @@ class PartitionedTally:
         run shape before overwriting any state."""
         from ..utils.checkpoint import restore_partitioned_checkpoint
 
+        self._drain_pending()
         restore_partitioned_checkpoint(filename, self)
         # Recorded crossing points describe the pre-restore trace, not
         # the restored state — the "LAST call" contract must not serve
@@ -596,6 +720,7 @@ class PartitionedTally:
         live in parallel/multihost.py."""
         from ..io.vtk import write_flux_vtk
 
+        self._drain_pending()
         with annotate("PartitionedTally.write_pumi_tally_mesh"), \
                 phase_timer(self.tally_times, "vtk_file_write_time", True):
             name = filename or self.config.output_filename
@@ -610,6 +735,7 @@ class PartitionedTally:
         contract over the partitioned walk, with per-move migration
         extras in the flight records (rounds, emigrants sent, immigrants
         adopted, per-chip segment/crossing splits)."""
+        self._drain_pending()
         return self._telemetry.snapshot(times=self.tally_times)
 
     @property

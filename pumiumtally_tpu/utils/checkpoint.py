@@ -312,6 +312,13 @@ def _apply_plain(tally, meta: dict, arrays: dict) -> None:
     tally._initialized = bool(meta["initialized"])
     perm = arrays["perm"]
     tally._perm = None if perm.size == 0 else perm.astype(np.int64)
+    # Packed-pipeline derived state: re-derive the device-resident slot
+    # permutation from the restored particle_id, and force the next
+    # periodic sort to recompute its cached artifacts.
+    if hasattr(tally, "_refresh_perm_device"):
+        tally._refresh_perm_device()
+    if hasattr(tally, "_traces_since_sort"):
+        tally._traces_since_sort = 1
     if "replanned" in meta:
         tally._replanned = bool(meta["replanned"])
         planned = meta.get("compact_stages_planned")

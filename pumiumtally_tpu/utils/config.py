@@ -22,6 +22,7 @@ def dense_ladder(n_particles: int) -> tuple:
     )
 
 import dataclasses
+import os
 from typing import Any
 
 import jax.numpy as jnp
@@ -150,6 +151,26 @@ class TallyConfig:
         slabs — halo scores are already on owner rows at step end);
         StreamingTallyPipeline rejects it (in-flight batches overlap).
 
+    io_pipeline: move-loop I/O staging strategy (ops/staging.py).
+        "packed" (default): destinations/flying/weights/groups are
+        packed into ONE contiguous host record per move (one H2D
+        transfer), the slot permutation is applied on device, and
+        positions/material ids/done/stats come back as ONE coalesced
+        device record (one D2H transfer) — bit-identical outputs to
+        "legacy", structurally fewer transfers (asserted in CI via a
+        jax.transfer_guard test).
+        "overlap": "packed" plus double-buffered host staging records
+        and deferred telemetry folding, so host-side bookkeeping of
+        move k overlaps the device walk of move k+1 (flushed at every
+        read surface; truncation warnings stay in-call).
+        "legacy": the pre-pipeline multi-transfer path (one jnp.asarray
+        per input array, per-array readbacks).
+        The env var ``PUMI_TPU_IO_PIPELINE`` overrides the field (the
+        CI faults step uses it to prove resilience holds under
+        pipelining).  Both facades fall back to "legacy" automatically
+        when record_xpoints or checkify_invariants is set (those paths
+        need the un-packed result surface).
+
     Scope: ``ledger`` and ``gathers`` are honored by the single-chip and
     streaming-pipeline walks only. The partitioned walk
     (ops/walk_partitioned.py) always accumulates and migrates the ledger
@@ -183,6 +204,22 @@ class TallyConfig:
     sd_mode: str = "segment"
     quarantine: bool = False
     truncation_retries: int = 0
+    io_pipeline: str = "packed"
+
+    def resolve_io_pipeline(self) -> str:
+        """The effective move-loop I/O mode: the env override
+        ``PUMI_TPU_IO_PIPELINE`` beats the field; debug surfaces that
+        need the un-packed result (recorded intersection points,
+        checkify invariants) force "legacy"."""
+        mode = os.environ.get("PUMI_TPU_IO_PIPELINE") or self.io_pipeline
+        if mode not in ("packed", "overlap", "legacy"):
+            raise ValueError(
+                "io_pipeline must be 'packed', 'overlap' or 'legacy': "
+                f"{mode!r}"
+            )
+        if self.record_xpoints is not None or self.checkify_invariants:
+            return "legacy"
+        return mode
 
     def resolve_max_crossings(self, ntet: int) -> int:
         if self.max_crossings is not None:
