@@ -19,6 +19,12 @@ from .core.state import ParticleState, make_particle_state
 from .core.tally import make_flux, normalize_flux, reaction_rate
 from .mesh.box import build_box, build_box_arrays
 from .mesh.core import TetMesh
+from .integrity import (
+    DispatchTimeoutError,
+    FatalIntegrityViolation,
+    IntegrityViolation,
+    TransientIntegrityViolation,
+)
 from .mesh.io import load_mesh, save_npz
 from .models.pipeline import StreamingTallyPipeline
 from .models.transport import Material, SyntheticTransport
@@ -54,6 +60,10 @@ __all__ = [
     "ResilientRunner",
     "CheckpointStore",
     "FaultInjector",
+    "IntegrityViolation",
+    "TransientIntegrityViolation",
+    "FatalIntegrityViolation",
+    "DispatchTimeoutError",
     "trace",
     "TraceResult",
     "TallyConfig",

@@ -175,6 +175,35 @@ class PumiTally:
                 from .resilience.quarantine import setup
 
                 setup(self, mesh.coords, self.num_particles)
+            # Self-verification layer (integrity/): escalation mode,
+            # invariant tolerances, the shadow-audit reference walker,
+            # and the facade-side fault hooks (bitflip_flux / sdc_walk /
+            # hang_at_move target the NEW detectors, so they live here,
+            # not on the supervisor's injector). All None/off by
+            # default — the hot path pays nothing.
+            self._integrity = cfg.resolve_integrity()
+            self._finj = None
+            self._auditor = None
+            if (
+                self._integrity != "off"
+                or cfg.audit_lanes
+                or cfg.move_deadline_s is not None
+            ):
+                from .integrity import invariants
+                from .resilience.faultinject import FaultInjector
+
+                self._finj = FaultInjector()
+                scale = invariants.mesh_scale(mesh.coords)
+                self._integrity_tol = invariants.conservation_tolerance(
+                    cfg.integrity_tol, cfg.dtype, scale, cfg.tolerance
+                )
+                self._audit_tol = invariants.audit_tolerance(
+                    cfg.audit_tol, cfg.dtype, scale, cfg.tolerance
+                )
+            if cfg.audit_lanes:
+                from .integrity.audit import HostReference
+
+                self._auditor = HostReference(mesh)
             timer.sync((self.state, self.flux))
         # Phase-boundary memory sample (HBM peaks where the backend
         # reports them — construction allocated the mesh tables + flux).
@@ -201,6 +230,174 @@ class PumiTally:
             err.throw()
             return result
         return trace(*args, **kwargs)
+
+    # ------------------------------------------------------------------ #
+    def _dispatch(self, fn, move: int):
+        """One compiled-step dispatch + blocking readback, under the
+        integrity watchdog deadline when configured
+        (integrity/watchdog.py). ``fn`` must be MUTATION-FREE (pure
+        dispatch + fetch): on a timeout its abandoned thread may still
+        complete the device work later, and nothing must apply it —
+        recovery is the supervisor's last-good rollback, which rebuilds
+        every donated buffer from host copies.
+
+        The FIRST dispatch of each kind (initial search / move) runs
+        un-deadlined: it legitimately includes XLA compilation, which
+        can exceed any deadline sized for steady-state moves (minutes
+        on real hardware). The watchdog arms from the second dispatch
+        on — the regime where a stall means a wedged device."""
+        if self.config.move_deadline_s is None:
+            return fn()
+        key = "init" if move == 0 else "move"
+        warm = getattr(self, "_watchdog_warm", None)
+        if warm is None:
+            warm = self._watchdog_warm = set()
+
+        def body():
+            if self._finj is not None and self._finj.maybe_hang(move):
+                self.metrics.counter(
+                    "pumi_injected_faults_total",
+                    "faults injected through PUMI_TPU_FAULTS "
+                    "(labeled by kind)",
+                ).inc(kind="hang")
+            return fn()
+
+        if key not in warm:
+            # Warm-up dispatch: un-deadlined (it includes compilation),
+            # but still through body() so a hang_at_move targeting it
+            # fires (inline) instead of silently never injecting.
+            warm.add(key)
+            return body()
+        from .integrity.watchdog import (
+            DispatchTimeoutError,
+            run_with_deadline,
+        )
+
+        try:
+            return run_with_deadline(
+                body, self.config.move_deadline_s
+            )
+        except DispatchTimeoutError:
+            self._telemetry.record_integrity(move, {}, ["watchdog"])
+            raise
+
+    def _self_verify(
+        self, move, integ, stats_d, fly_h, n_lost, s_before, result,
+        dest_dev, done_h, pos_out,
+    ) -> None:
+        """Evaluate the move's integrity surface and escalate per
+        ``TallyConfig.integrity``: the fused invariant vector (device
+        ↔ host lane agreement, conservation residual, flux health),
+        then the shadow-audit sample. Violations are counted + recorded
+        BEFORE escalation so 'warn' and 'halt' leave the same
+        telemetry."""
+        cfg = self.config
+        if self._integrity == "off" and not cfg.audit_lanes:
+            return
+        from .integrity import invariants, policy
+
+        fields: dict = {}
+        violations: list = []
+        if integ is not None:
+            fields = invariants.integrity_to_dict(integ)
+            violations += invariants.check_move(
+                fields, int(fly_h.sum()), int(n_lost),
+                self._integrity_tol,
+            )
+        if (
+            cfg.audit_lanes
+            and self._auditor is not None
+            and move >= 1
+            and move % cfg.audit_every == 0
+        ):
+            out = self._run_audit(
+                move, s_before, result, dest_dev, fly_h, done_h, pos_out
+            )
+            if out is not None:
+                self._telemetry.record_audit(
+                    move, out.audited, out.mismatches, out.skipped,
+                    out.max_dev,
+                )
+                if out.mismatches:
+                    violations.append("sdc_audit")
+        if fields or violations:
+            self._telemetry.record_integrity(move, fields, violations)
+        policy.escalate(self._integrity, violations, move)
+
+    def _inv_perm(self) -> np.ndarray:
+        """pid → device-slot map (inverse of ``_perm``)."""
+        inv = np.empty(self.num_particles, np.int64)
+        inv[self._perm] = np.arange(self.num_particles)
+        return inv
+
+    def _run_audit(
+        self, move, s_before, result, dest_dev, fly_h, done_h, pos_out
+    ):
+        """Shadow-audit one move (integrity/audit.py): sample K
+        completed in-flight lanes deterministically per (seed, move),
+        fetch their pre-move state + production outputs (a few tiny
+        out-of-band D2H gathers — audits are opt-in and priced in
+        BENCHMARKS.md), re-walk them in float64 on the host reference,
+        and compare."""
+        cfg = self.config
+        if done_h is None:
+            done_h = np.asarray(result.done)
+            if self._perm is not None:  # slot order → pid order
+                out = np.empty_like(done_h)
+                out[self._perm] = done_h
+                done_h = out
+        cand = np.nonzero(fly_h & done_h)[0]
+        if cand.size == 0:
+            return None
+        rng = np.random.default_rng([cfg.audit_seed, int(move)])
+        pids = rng.choice(
+            cand, size=min(cfg.audit_lanes, cand.size), replace=False
+        )
+        slots = pids if self._perm is None else self._inv_perm()[pids]
+        sl = jnp.asarray(slots)
+        origins = np.asarray(
+            jax.device_get(s_before.origin[sl]), np.float64
+        )
+        elems = np.asarray(jax.device_get(s_before.elem[sl]))
+        dests = np.asarray(jax.device_get(dest_dev[sl]), np.float64)
+        track = np.asarray(
+            jax.device_get(result.track_length[sl]), np.float64
+        ).copy()
+        prod_pos = np.asarray(pos_out[pids], np.float64)
+        if self._finj is not None and self._finj.sdc_at(move):
+            # Injected SDC: one mis-scored segment on the first sampled
+            # lane — the float64 re-walk must flag it.
+            track[0] += 1e3 * self._audit_tol
+            self.metrics.counter(
+                "pumi_injected_faults_total",
+                "faults injected through PUMI_TPU_FAULTS "
+                "(labeled by kind)",
+            ).inc(kind="sdc_walk")
+        from .integrity.audit import audit_sample
+
+        return audit_sample(
+            self._auditor, origins, dests, elems, prod_pos, track,
+            tolerance=cfg.tolerance,
+            max_crossings=self._max_crossings,
+            tol=self._audit_tol,
+        )
+
+    def _maybe_inject_bitflip(self, move: int) -> None:
+        """``PUMI_TPU_FAULTS=bitflip_flux:K``: flip the sign of the
+        largest accumulator entry (or NaN slot 0 of an empty
+        accumulator) after move K — the NEXT move's on-device flux
+        invariant must catch it."""
+        if self._finj is None or not self._finj.bitflip_at(move):
+            return
+        j = int(jnp.argmax(jnp.abs(self.flux)))
+        v = self.flux[j]
+        self.flux = self.flux.at[j].set(
+            jnp.where(v == 0, jnp.asarray(jnp.nan, self.flux.dtype), -v)
+        )
+        self.metrics.counter(
+            "pumi_injected_faults_total",
+            "faults injected through PUMI_TPU_FAULTS (labeled by kind)",
+        ).inc(kind="bitflip_flux")
 
     # ------------------------------------------------------------------ #
     def _gather_in(self, host: np.ndarray) -> np.ndarray:
@@ -341,7 +538,8 @@ class PumiTally:
                 io["d2h_bytes"] += int(host_rb.nbytes)
                 io["d2h_transfers"] += 1
                 parts = staging.split_trace_readback(
-                    host_rb, self.num_particles, self.config.dtype
+                    host_rb, self.num_particles, self.config.dtype,
+                    integrity=self._integrity != "off",
                 )
                 stats_d = (
                     stats_to_dict(parts[3])
@@ -396,6 +594,7 @@ class PumiTally:
                 gathers=self.config.gathers,
                 ledger=self.config.ledger,
                 stats=self.config.walk_stats,
+                integrity=self._integrity != "off",
                 record_xpoints=self.config.record_xpoints,
                 n_groups=self.config.n_groups,
             )
@@ -410,16 +609,31 @@ class PumiTally:
                     h2d_bytes=int(rec_h.nbytes), h2d_transfers=1,
                     d2h_bytes=0, d2h_transfers=0,
                 )
-                result, readback, dest, _fly, _w, _g = self._trace(
-                    self.mesh, s.origin, s.elem, s.material_id,
-                    jax.device_put(rec_h), self.flux, self._perm_dev,
-                    weight=s.weight, group=s.group, _packed=True, **tkw,
-                )
-                host_rb = jax.device_get(readback)
+                rec_dev = jax.device_put(rec_h)
+                # Bind the donated flux at closure-CREATION time: an
+                # abandoned watchdog worker waking after a rollback
+                # must consume the stale pre-restore buffer, never the
+                # restored live accumulator.
+                flux_in, perm_in = self.flux, self._perm_dev
+
+                def _step():
+                    out = self._trace(
+                        self.mesh, s.origin, s.elem, s.material_id,
+                        rec_dev, flux_in, perm_in,
+                        weight=s.weight, group=s.group, _packed=True,
+                        **tkw,
+                    )
+                    return out, jax.device_get(out[1])
+
+                out, host_rb = self._dispatch(_step, 0)
+                result, readback, dest, _fly, _w, _g = out
                 io["d2h_bytes"] += int(host_rb.nbytes)
                 io["d2h_transfers"] += 1
-                _pos, _mats, done_h, tail = staging.split_trace_readback(
-                    host_rb, n, self.config.dtype
+                _pos, _mats, done_h, tail, integ = (
+                    staging.split_trace_readback(
+                        host_rb, n, self.config.dtype,
+                        integrity=self._integrity != "off",
+                    )
                 )
                 stats_d = (
                     stats_to_dict(tail) if self.config.walk_stats else None
@@ -428,6 +642,8 @@ class PumiTally:
                     result, dest, s.weight, s.group, stats_d, tkw, 0,
                     done_h=done_h, io=io,
                 )
+                if _parts is not None:
+                    integ = _parts[4]
             else:
                 dest_h = self._gather_in(pos3)
                 dest = jnp.asarray(dest_h, dtype=self.config.dtype)
@@ -436,25 +652,38 @@ class PumiTally:
                     h2d_bytes=int(dest.nbytes) + int(fly_dev.nbytes),
                     h2d_transfers=2, d2h_bytes=0, d2h_transfers=0,
                 )
-                result = self._trace(
-                    self.mesh,
-                    s.origin,
-                    dest,
-                    s.elem,
-                    fly_dev,
-                    s.weight,
-                    s.group,
-                    s.material_id,
-                    self.flux,
-                    **tkw,
-                )
-                stats_d = self._read_stats(result)
+
+                flux_in = self.flux  # bound pre-closure (see above)
+
+                def _step():
+                    r = self._trace(
+                        self.mesh,
+                        s.origin,
+                        dest,
+                        s.elem,
+                        fly_dev,
+                        s.weight,
+                        s.group,
+                        s.material_id,
+                        flux_in,
+                        **tkw,
+                    )
+                    return r, self._read_stats(r)
+
+                result, stats_d = self._dispatch(_step, 0)
                 if result.stats is not None:
                     io["d2h_bytes"] += int(result.stats.nbytes)
                     io["d2h_transfers"] += 1
                 result, stats_d, n_lost, _ = self._escalate_truncated(
                     result, dest, s.weight, s.group, stats_d, tkw, 0
                 )
+                integ = (
+                    np.asarray(result.integrity, np.float64)
+                    if result.integrity is not None else None
+                )
+                if result.integrity is not None:
+                    io["d2h_bytes"] += int(result.integrity.nbytes)
+                    io["d2h_transfers"] += 1
             self._traces_since_sort += 1
             self.flux = result.flux
             self.state = s._replace(
@@ -463,6 +692,14 @@ class PumiTally:
             self._store_xpoints(result)
             self._initialized = True
             self._warn_if_truncated(n_lost)
+            # Integrity surface for the location search: flux must stay
+            # untouched/finite and the lane accounting must close (the
+            # conservation triple is identically zero here — nothing is
+            # scored; the shadow audit starts with move 1).
+            self._self_verify(
+                0, integ, stats_d, fly_h, n_lost, s, result, dest,
+                None, None,
+            )
             if self.config.measure_time:
                 timer.sync(self.state)
         self._telemetry.record_walk(
@@ -572,6 +809,7 @@ class PumiTally:
                 gathers=cfg.gathers,
                 ledger=cfg.ledger,
                 stats=cfg.walk_stats,
+                integrity=self._integrity != "off",
                 record_xpoints=cfg.record_xpoints,
                 n_groups=cfg.n_groups,
             )
@@ -589,22 +827,41 @@ class PumiTally:
                     h2d_bytes=int(rec_h.nbytes), h2d_transfers=1,
                     d2h_bytes=0, d2h_transfers=0,
                 )
-                result, readback, dest, in_flight, weight, group = (
-                    self._trace(
+                rec_dev = jax.device_put(rec_h)
+                # Donated-buffer binding at closure-creation time — an
+                # abandoned watchdog worker must never donate the
+                # restored live accumulator (see the init-path note).
+                flux_in, perm_in = self.flux, self._perm_dev
+
+                deadline = self.config.move_deadline_s is not None
+
+                def _step():
+                    out = self._trace(
                         self.mesh, s.origin, s.elem, s.material_id,
-                        jax.device_put(rec_h), self.flux,
-                        self._perm_dev, _packed=True, **tkw,
+                        rec_dev, flux_in,
+                        perm_in, _packed=True, **tkw,
                     )
-                )
-                if self._io == "overlap":
-                    # Deferred bookkeeping of the PREVIOUS move runs
-                    # here, overlapping the device walk of THIS move.
+                    if self._io == "overlap" and not deadline:
+                        # Deferred bookkeeping of the PREVIOUS move
+                        # runs here, overlapping the device walk of
+                        # THIS move. Under the watchdog the closure
+                        # must stay mutation-free (an abandoned worker
+                        # must never touch _pending_folds/telemetry),
+                        # so the drain moves after the dispatch.
+                        self._drain_pending()
+                    return out, jax.device_get(out[1])
+
+                out, host_rb = self._dispatch(_step, self.iter_count + 1)
+                if self._io == "overlap" and deadline:
                     self._drain_pending()
-                host_rb = jax.device_get(readback)
+                result, readback, dest, in_flight, weight, group = out
                 io["d2h_bytes"] += int(host_rb.nbytes)
                 io["d2h_transfers"] += 1
-                final_pos, final_mats, done_h, tail = (
-                    staging.split_trace_readback(host_rb, n, cfg.dtype)
+                final_pos, final_mats, done_h, tail, integ = (
+                    staging.split_trace_readback(
+                        host_rb, n, cfg.dtype,
+                        integrity=self._integrity != "off",
+                    )
                 )
                 stats_d = (
                     stats_to_dict(tail) if cfg.walk_stats else None
@@ -614,7 +871,7 @@ class PumiTally:
                     self.iter_count + 1, done_h=done_h, io=io,
                 )
                 if parts is not None:
-                    final_pos, final_mats, done_h, tail = parts
+                    final_pos, final_mats, done_h, tail, integ = parts
             else:
                 dest = jnp.asarray(
                     self._gather_in(dest3_h), dtype=cfg.dtype
@@ -633,19 +890,27 @@ class PumiTally:
                     ),
                     h2d_transfers=4, d2h_bytes=0, d2h_transfers=0,
                 )
-                result = self._trace(
-                    self.mesh,
-                    s.origin,
-                    dest,
-                    s.elem,
-                    in_flight,
-                    weight,
-                    group,
-                    s.material_id,
-                    self.flux,
-                    **tkw,
+
+                flux_in = self.flux  # bound pre-closure (see above)
+
+                def _step():
+                    r = self._trace(
+                        self.mesh,
+                        s.origin,
+                        dest,
+                        s.elem,
+                        in_flight,
+                        weight,
+                        group,
+                        s.material_id,
+                        flux_in,
+                        **tkw,
+                    )
+                    return r, self._read_stats(r)
+
+                result, stats_d = self._dispatch(
+                    _step, self.iter_count + 1
                 )
-                stats_d = self._read_stats(result)
                 if result.stats is not None:
                     io["d2h_bytes"] += int(result.stats.nbytes)
                     io["d2h_transfers"] += 1
@@ -653,6 +918,14 @@ class PumiTally:
                     result, dest, weight, group, stats_d, tkw,
                     self.iter_count + 1,
                 )
+                integ = (
+                    np.asarray(result.integrity, np.float64)
+                    if result.integrity is not None else None
+                )
+                if result.integrity is not None:
+                    io["d2h_bytes"] += int(result.integrity.nbytes)
+                    io["d2h_transfers"] += 1
+                done_h = None
             self.flux = result.flux
             if self._prev_even is not None:
                 self.flux, self._prev_even = accumulate_batch_squares(
@@ -713,6 +986,17 @@ class PumiTally:
             # in-call in every pipeline mode; only the telemetry fold is
             # deferred under "overlap".
             self._warn_if_truncated(n_lost)
+
+            # Self-verification (integrity/): evaluate the fused
+            # invariant vector + shadow-audit sample and escalate per
+            # TallyConfig.integrity; then the bitflip fault hook (its
+            # corruption is caught by the NEXT move's flux invariant).
+            self._self_verify(
+                self.iter_count, integ, stats_d, fly_h, n_lost, s,
+                result, dest, done_h,
+                dest_flat[: n * 3].reshape(n, 3),
+            )
+            self._maybe_inject_bitflip(self.iter_count)
 
             # Periodic locality sort (the migrate-every-100 analog,
             # cpp:256-258) — argsort and perm artifacts cached inside

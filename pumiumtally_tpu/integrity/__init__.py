@@ -1,0 +1,81 @@
+"""Self-verifying tallies: detect a WRONG answer, not just a dead run.
+
+PR 2 made runs survivable (checkpoints, retry, quarantine) and PR 3 made
+the move loop one packed H2D/D2H pair — but the flux accumulator is a
+pure additive sum with no consistency check: a bit-flip, a kernel
+regression, or a hung device dispatch silently corrupts a multi-hour
+accumulation (PAPER.md; exascale multi-GPU PIC/MC practice in PAPERS.md
+treats silent-data-corruption detection as a first-class subsystem).
+This package is the detection + escalation layer, threaded through both
+facades:
+
+  * ``invariants`` — schema and host-side evaluation of the on-device
+    conservation invariants the walk kernels fold into their compiled
+    programs (ops/walk.py ``integrity=True``, ops/walk_partitioned.py
+    ``make_partitioned_step(integrity=True)``): weighted scored-length
+    vs straight-line path over completed lanes, flux non-negativity /
+    finiteness, lane-count conservation. The scalars ride the packed
+    readback tail of PR 3, so steady-state moves still issue exactly
+    one H2D and one D2H transfer.
+  * ``audit`` — shadow-audit sampling: re-walk a K-lane random sample
+    through an independent float64 host-reference walker each move and
+    compare scored track lengths / final positions within tolerance — a
+    continuous SDC and kernel-regression detector for production runs.
+  * ``policy`` — the escalation ladder behind
+    ``TallyConfig(integrity="off"|"warn"|"retry"|"halt")``: violations
+    increment ``pumi_integrity_violations_total{check=...}``; "retry"
+    raises a RETRYABLE error the ``ResilientRunner`` absorbs with its
+    last-good-snapshot rollback; "halt" raises fatally after the runner
+    flushes a last-good checkpoint.
+  * ``watchdog`` — a deadline around the compiled step
+    (``TallyConfig(move_deadline_s=...)``): a hung / never-returning
+    dispatch surfaces as a retryable ``DispatchTimeoutError`` instead
+    of wedging the supervisor.
+
+Each detector is proven by a fault-injection mode that corrupts and
+catches (``PUMI_TPU_FAULTS``: ``bitflip_flux`` → flux invariant,
+``sdc_walk`` → shadow audit, ``hang_at_move`` → watchdog, the PR 2
+``nan_src`` → quarantine); see tests/test_integrity.py.
+"""
+from .audit import AuditOutcome, HostReference, audit_sample
+from .invariants import (
+    INTEGRITY_FIELDS,
+    INTEGRITY_LEN,
+    IIDX,
+    PART_INTEGRITY_FIELDS,
+    PART_INTEGRITY_LEN,
+    audit_tolerance,
+    check_move,
+    conservation_tolerance,
+    integrity_to_dict,
+    mesh_scale,
+)
+from .policy import (
+    FatalIntegrityViolation,
+    IntegrityViolation,
+    TransientIntegrityViolation,
+    escalate,
+)
+from .watchdog import DispatchTimeoutError, run_with_deadline
+
+__all__ = [
+    "INTEGRITY_FIELDS",
+    "INTEGRITY_LEN",
+    "IIDX",
+    "PART_INTEGRITY_FIELDS",
+    "PART_INTEGRITY_LEN",
+    "integrity_to_dict",
+    "check_move",
+    "conservation_tolerance",
+    "audit_tolerance",
+    "mesh_scale",
+    "HostReference",
+    "AuditOutcome",
+    "audit_sample",
+    "IntegrityViolation",
+    "TransientIntegrityViolation",
+    "FatalIntegrityViolation",
+    "escalate",
+    "DispatchTimeoutError",
+    "run_with_deadline",
+]

@@ -1,0 +1,137 @@
+"""Schema + host-side evaluation of the on-device integrity invariants.
+
+The walk kernels fold a small vector of conservation scalars into their
+compiled programs when the facade runs with
+``TallyConfig(integrity != "off")`` (ops/walk.py ``integrity=True``,
+ops/walk_partitioned.py ``make_partitioned_step(integrity=True)``).
+Like the walk-stats vector (obs/walk_stats.py) the layout here is the
+single source of truth for the kernels AND the packed-readback codec
+(ops/staging.py) — a drift breaks tests/test_integrity.py loudly.
+
+Single-chip vector (walk dtype, ``INTEGRITY_FIELDS``):
+
+  * ``scored_wlen`` / ``path_wlen`` — Σ weight·(scored track length) vs
+    Σ weight·|final − origin| over lanes that were in flight AND
+    finished. All movement is along the origin→dest ray, so the two
+    sums agree to fp accumulation + the robust bump's unscored
+    ulp-scale hops; a mis-scored, missed or double-scored segment (SDC
+    in the scatter path, a kernel regression) splits them. Zero on
+    initial-search traces (nothing is scored there).
+  * ``max_residual`` — max over completed lanes of
+    |track_length − |final − origin|| — the per-lane sharpening of the
+    sum check (a +x/−x cancellation across lanes cannot hide).
+  * ``bad_flux`` — count of non-finite OR negative flux entries after
+    this trace's accumulation (the reference's non-negative-tally
+    device assert, cpp:618-629, as a per-move scalar). A single flipped
+    sign or exponent bit in the accumulator shows up here next move.
+  * ``lanes_flying`` / ``lanes_done`` — lane-count conservation inputs:
+    the device's view of how many lanes walked and how many finished,
+    cross-checked against the host-side flying count and the truncation
+    counter so done + truncated + parked(+quarantined) == n.
+
+Partitioned per-chip vector (int64 tail, ``PART_INTEGRITY_FIELDS``):
+``bad_flux`` / ``lanes_valid`` / ``lanes_done`` — the on-device half
+(flux and slot accounting); the conservation half is evaluated host-side
+from the track-length ledger that already migrates with each particle
+(PartitionedTraceResult.track_length) against the facade's host-resident
+pre-move positions, which is strictly stronger (per-lane, cross-cut).
+
+All scalars ride the packed readback tail of PR 3
+(staging.pack_trace_readback / pack_partitioned_readback), so enabling
+the invariants adds ZERO extra host↔device transfers.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+INTEGRITY_FIELDS = (
+    "scored_wlen",
+    "path_wlen",
+    "max_residual",
+    "bad_flux",
+    "lanes_flying",
+    "lanes_done",
+)
+INTEGRITY_LEN = len(INTEGRITY_FIELDS)
+IIDX = {name: i for i, name in enumerate(INTEGRITY_FIELDS)}
+
+PART_INTEGRITY_FIELDS = ("bad_flux", "lanes_valid", "lanes_done")
+PART_INTEGRITY_LEN = len(PART_INTEGRITY_FIELDS)
+
+
+def integrity_to_dict(vec) -> dict:
+    """Host view of one single-chip integrity vector: float conservation
+    scalars + integer counts (the counts travel as walk-dtype floats —
+    exact up to 2^24 lanes in f32, far past any single-chip batch)."""
+    v = np.asarray(vec, np.float64)
+    if v.shape != (INTEGRITY_LEN,):
+        raise ValueError(
+            f"expected a [{INTEGRITY_LEN}] integrity vector, got {v.shape}"
+        )
+    d = {f: float(v[i]) for i, f in enumerate(INTEGRITY_FIELDS)}
+    for f in ("bad_flux", "lanes_flying", "lanes_done"):
+        d[f] = int(d[f])
+    return d
+
+
+def mesh_scale(coords) -> float:
+    """1 + bounding-box diagonal — the coordinate scale every default
+    tolerance here is proportional to."""
+    c = np.asarray(coords, np.float64)
+    return 1.0 + float(np.linalg.norm(c.max(axis=0) - c.min(axis=0)))
+
+
+def conservation_tolerance(
+    configured: float | None, dtype, scale: float, walk_tolerance: float
+) -> float:
+    """Per-lane residual threshold for the conservation invariant.
+
+    The honest error envelope is crossings·(walk tolerance + ulp bumps)
+    (see the debug_checks bound in ops/walk.py); a bit-flip or dropped
+    segment is orders of magnitude above it. The default is deliberately
+    generous — a false positive halts production runs, a small true SDC
+    merely needs to beat the envelope to be seen:
+    ``max(64·walk_tolerance, 1e4·eps(dtype)) · scale``.
+    """
+    if configured is not None:
+        return float(configured)
+    eps = float(np.finfo(np.dtype(dtype)).eps)
+    return max(64.0 * walk_tolerance, 1e4 * eps) * scale
+
+
+def audit_tolerance(
+    configured: float | None, dtype, scale: float, walk_tolerance: float
+) -> float:
+    """Shadow-audit comparison threshold (production walk-dtype result
+    vs the float64 host reference): covers the walk dtype's rounding,
+    the tolerance-band clip choices and the robust bump's unscored hops.
+    """
+    if configured is not None:
+        return float(configured)
+    eps = float(np.finfo(np.dtype(dtype)).eps)
+    return max(128.0 * walk_tolerance, 2e4 * eps) * scale
+
+
+def check_move(
+    fields: dict,
+    n_flying: int,
+    n_truncated: int,
+    tol: float,
+) -> list[str]:
+    """Evaluate one move's single-chip invariant vector → violated check
+    names. ``n_flying`` is the host-side in-flight count staged for this
+    move (after quarantine masking); ``n_truncated`` the move's final
+    truncation count (post-escalation)."""
+    violations = []
+    if fields["max_residual"] > tol:
+        violations.append("conservation")
+    if fields["bad_flux"] > 0:
+        violations.append("flux")
+    # Device/host lane agreement AND done + truncated == flying (parked
+    # and quarantined lanes are the n − flying remainder by definition).
+    if (
+        fields["lanes_flying"] != int(n_flying)
+        or fields["lanes_done"] + int(n_truncated) != int(n_flying)
+    ):
+        violations.append("lanes")
+    return violations

@@ -1,0 +1,69 @@
+"""Dispatch watchdog: a deadline around the compiled step.
+
+A hung device dispatch (driver wedge, collective deadlock, preempted
+chip that never faults) blocks the facade inside ``jax.device_get``
+forever — the one failure mode PR 2's retry machinery cannot see,
+because no exception ever surfaces. With
+``TallyConfig(move_deadline_s=...)`` the facades run each move's
+dispatch + blocking readback on a watchdog-supervised worker thread:
+if it misses the deadline, a ``DispatchTimeoutError`` is raised —
+listed in ``resilience.runner.RETRYABLE`` — so the supervisor rolls
+back to the last good snapshot, re-arms, and replays the move instead
+of wedging.
+
+Contract for the supervised closure: it must be MUTATION-FREE (pure
+dispatch + fetch, no facade state updates). On a timeout the abandoned
+worker thread may still complete its device work later; nobody applies
+its results, and the supervisor's rollback re-creates every donated
+buffer from host copies, so the stale completion is inert. The worker
+is a daemon thread — a truly hung dispatch never blocks process exit
+(the OS-level supervisor reaps the process; auto-resume is the
+recovery).
+"""
+from __future__ import annotations
+
+import threading
+
+
+class DispatchTimeoutError(RuntimeError):
+    """A compiled-step dispatch/readback missed its deadline. Retryable:
+    the ResilientRunner treats it like any transient device fault
+    (last-good rollback + bounded backoff replay)."""
+
+
+def run_with_deadline(fn, seconds: float | None, what: str = "move"):
+    """Run ``fn()`` with a wall-clock deadline.
+
+    ``seconds`` None/0 → run inline (no thread, no overhead). On
+    timeout raises ``DispatchTimeoutError`` and abandons the worker
+    (daemon) thread; exceptions raised by ``fn`` re-raise here
+    unchanged, so injected faults and JAX runtime errors keep their
+    types through the watchdog.
+    """
+    if not seconds:
+        return fn()
+    outcome: dict = {}
+    finished = threading.Event()
+
+    def target():
+        try:
+            outcome["value"] = fn()
+        except BaseException as e:  # re-raised on the caller thread
+            outcome["error"] = e
+        finally:
+            finished.set()
+
+    worker = threading.Thread(
+        target=target, name="pumi-dispatch-watchdog", daemon=True
+    )
+    worker.start()
+    if not finished.wait(float(seconds)):
+        raise DispatchTimeoutError(
+            f"{what} dispatch exceeded move_deadline_s={seconds}: the "
+            "device step (or its readback) never returned — surfacing "
+            "as a transient error so the supervisor can re-arm and "
+            "replay from the last good snapshot"
+        )
+    if "error" in outcome:
+        raise outcome["error"]
+    return outcome["value"]

@@ -115,6 +115,24 @@ class TallyTelemetry:
             "pumi_d2h_transfers_total",
             "device-to-host transfers issued by the move loop",
         )
+        # Self-verification families (integrity/): invariant + audit +
+        # watchdog violations by check, shadow-audit volume, and the
+        # worst conservation residual seen this run.
+        self._integ_violations = r.counter(
+            "pumi_integrity_violations_total",
+            "integrity-check violations (labeled by check: "
+            "conservation, flux, lanes, sdc_audit, watchdog)",
+        )
+        self._audited = r.counter(
+            "pumi_audited_lanes_total",
+            "lanes re-walked by the float64 shadow audit",
+        )
+        self._audit_mismatch = r.counter(
+            "pumi_audit_mismatches_total",
+            "shadow-audit lanes disagreeing with the host reference "
+            "beyond tolerance",
+        )
+        self._max_residual = 0.0
 
     # ------------------------------------------------------------------ #
     def record_walk(
@@ -187,6 +205,47 @@ class TallyTelemetry:
             lost=int(lost),
         )
 
+    def record_integrity(
+        self, move: int, fields: dict, violations: list
+    ) -> dict:
+        """Fold one move's integrity evaluation: the invariant scalars
+        (integrity/invariants.py field names, possibly empty for
+        watchdog-only events) plus the violated check names. Counting
+        happens here BEFORE policy escalation so the counters are
+        consistent whichever rung fires."""
+        for check in violations:
+            self._integ_violations.inc(check=check)
+        if fields.get("max_residual") is not None:
+            self._max_residual = max(
+                self._max_residual, float(fields["max_residual"])
+            )
+        return self.recorder.record(
+            "integrity",
+            move=int(move),
+            violations=list(violations),
+            **fields,
+        )
+
+    def record_audit(
+        self, move: int, audited: int, mismatches: int, skipped: int,
+        max_dev: float,
+    ) -> dict:
+        """Fold one move's shadow-audit outcome (integrity/audit.py) —
+        per-move results in the flight recorder (and any
+        PUMI_TPU_METRICS=jsonl: stream)."""
+        if audited:
+            self._audited.inc(audited)
+        if mismatches:
+            self._audit_mismatch.inc(mismatches)
+        return self.recorder.record(
+            "audit",
+            move=int(move),
+            audited=int(audited),
+            mismatches=int(mismatches),
+            skipped=int(skipped),
+            max_dev=float(max_dev),
+        )
+
     def record_memory(self, phase: str) -> dict:
         """Sample per-device memory at a phase boundary (peak bytes where
         the backend reports them — TPU does, CPU usually returns {})."""
@@ -222,6 +281,17 @@ class TallyTelemetry:
             # Headline resilience count, also at the top level: the
             # acceptance surface is telemetry()["quarantined"].
             "quarantined": quarantined,
+            # Self-verification block (integrity/): violations by
+            # check, shadow-audit volume, worst conservation residual.
+            "integrity": {
+                "violations": {
+                    s["labels"].get("check", ""): s["value"]
+                    for s in self._integ_violations.snapshot()["series"]
+                },
+                "audited_lanes": self._audited.value(),
+                "audit_mismatches": self._audit_mismatch.value(),
+                "max_residual": self._max_residual,
+            },
             "per_move": self.recorder.tail(tail),
             "memory": device_memory_stats(),
             "metrics": self.registry.snapshot(),

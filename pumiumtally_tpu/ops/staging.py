@@ -252,13 +252,16 @@ def unpack_move_record(rec, dtype, perm, initial: bool):
 
 
 def pack_trace_readback(position, material_id, done, stats, n_segments,
-                        perm):
+                        perm, integrity=None):
     """Device-side (traced) readback pack: [n, READBACK_COLS] slot
     record scattered back into host pid order (the inverse of the
     unpack's perm gather), flattened, with the walk-stats vector — or,
     when walk stats are off, the scalar segment count — appended as an
-    int64-encoded tail.  ONE ``device_get`` then carries everything the
-    facade needs per move."""
+    int64-encoded tail, and the integrity-invariant vector
+    (integrity/invariants.py; walk-dtype floats bitcast into carrier
+    words) appended after that when self-verification is on.  ONE
+    ``device_get`` then carries everything the facade needs per move —
+    the invariants cost zero extra transfers."""
     carrier = _jnp_carrier(position.dtype)
     slot = jnp.concatenate(
         [
@@ -272,7 +275,10 @@ def pack_trace_readback(position, material_id, done, stats, n_segments,
         slot = jnp.zeros_like(slot).at[perm].set(slot)
     tail_src = stats if stats is not None else n_segments[None]
     tail = _enc_i64_tail_dev(tail_src, carrier)
-    return jnp.concatenate([slot.reshape(-1), tail])
+    parts = [slot.reshape(-1), tail]
+    if integrity is not None:
+        parts.append(_enc_f_dev(integrity.astype(position.dtype), carrier))
+    return jnp.concatenate(parts)
 
 
 _pack_trace_readback_jit = jax.jit(pack_trace_readback)
@@ -284,22 +290,31 @@ def pack_trace_readback_cold(result, perm):
     step)."""
     return _pack_trace_readback_jit(
         result.position, result.material_id, result.done, result.stats,
-        result.n_segments, perm,
+        result.n_segments, perm, result.integrity,
     )
 
 
-def split_trace_readback(host_rec, n: int, dtype):
+def split_trace_readback(host_rec, n: int, dtype, integrity: bool = False):
     """Host-side inverse of pack_trace_readback.  Returns
     ``(position [n,3] walk-dtype, material_id [n] int32, done [n] bool,
-    tail int64 array)`` where ``tail`` is the stats vector (walk stats
-    on) or ``[n_segments]`` (off)."""
+    tail int64 array, integrity float64 vector or None)`` where ``tail``
+    is the stats vector (walk stats on) or ``[n_segments]`` (off)."""
     npdt = np.dtype(dtype)
     slot = host_rec[: n * READBACK_COLS].reshape(n, READBACK_COLS)
     position = _dec_f_host(slot[:, 0:3], npdt)
     material_id = _dec_i32_host(slot[:, 3], np_carrier(npdt))
     done = slot[:, 4] != 0
-    tail = _dec_i64_host(host_rec[n * READBACK_COLS:])
-    return position, material_id, done, tail
+    integ = None
+    tail_words = host_rec[n * READBACK_COLS:]
+    if integrity:
+        from ..integrity.invariants import INTEGRITY_LEN
+
+        integ = _dec_f_host(
+            tail_words[-INTEGRITY_LEN:], npdt
+        ).astype(np.float64)
+        tail_words = tail_words[:-INTEGRITY_LEN]
+    tail = _dec_i64_host(tail_words)
+    return position, material_id, done, tail, integ
 
 
 # --------------------------------------------------------------------- #
@@ -394,29 +409,34 @@ def pack_partitioned_readback(res, n_parts: int):
         ],
         axis=1,
     ).reshape(n_parts, cap * PART_RB_SLOT_COLS)
-    tail_i64 = jnp.concatenate(
-        [
-            _widen_counts(res.stats),
-            _widen_counts(res.round_stats.reshape(n_parts, -1)),
-            _widen_counts(res.n_rounds)[:, None],
-            _widen_counts(res.n_dropped)[:, None],
-            _widen_counts(res.n_segments)[:, None],
-        ],
-        axis=1,
-    )
+    cols = [
+        _widen_counts(res.stats),
+        _widen_counts(res.round_stats.reshape(n_parts, -1)),
+        _widen_counts(res.n_rounds)[:, None],
+        _widen_counts(res.n_dropped)[:, None],
+        _widen_counts(res.n_segments)[:, None],
+    ]
+    if res.integrity is not None:
+        # Per-chip integrity counters (integrity/invariants.py
+        # PART_INTEGRITY_FIELDS) ride the same int64 tail — the
+        # invariants add zero transfers on the partitioned facade too.
+        cols.append(_widen_counts(res.integrity))
+    tail_i64 = jnp.concatenate(cols, axis=1)
     tail = _enc_i64_tail_dev(tail_i64, carrier)
     return jnp.concatenate([slot, tail], axis=1)
 
 
 def split_partitioned_readback(host_rec, n_parts: int, cap: int,
-                               dtype) -> dict:
+                               dtype, integrity: bool = False) -> dict:
     """Host-side inverse of pack_partitioned_readback.  ``cap`` is the
     facade's per-chip slot count; the round-stats bound R is recovered
     from the remaining tail width."""
     npdt = np.dtype(dtype)
     carrier = np_carrier(npdt)
+    from ..integrity.invariants import PART_INTEGRITY_LEN
     from ..obs import WALK_STATS_LEN
 
+    ilen = PART_INTEGRITY_LEN if integrity else 0
     w = tail_words_per_i64(carrier.itemsize)
     width = host_rec.shape[1]
     rem = width - cap * PART_RB_SLOT_COLS
@@ -425,7 +445,7 @@ def split_partitioned_readback(host_rec, n_parts: int, cap: int,
             f"cannot split a [{n_parts}, {width}] partitioned readback "
             f"at cap={cap}"
         )
-    ints = rem // w - WALK_STATS_LEN - 3
+    ints = rem // w - WALK_STATS_LEN - 3 - ilen
     if ints < 0 or ints % 6:
         raise ValueError(
             f"partitioned readback tail of {rem // w} int64s does not "
@@ -454,6 +474,9 @@ def split_partitioned_readback(host_rec, n_parts: int, cap: int,
         "n_dropped": tail_i64[:, WALK_STATS_LEN + 6 * R + 1],
         "n_segments": tail_i64[:, WALK_STATS_LEN + 6 * R + 2],
     }
+    if integrity:
+        base = WALK_STATS_LEN + 6 * R + 3
+        out["integrity"] = tail_i64[:, base: base + ilen]
     return out
 
 

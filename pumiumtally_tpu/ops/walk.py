@@ -342,6 +342,14 @@ class TraceResult(NamedTuple):
     n_xpoints: jax.Array | None = None
     track_length: jax.Array | None = None
     stats: jax.Array | None = None
+    # [INTEGRITY_LEN] on-device conservation-invariant vector
+    # (integrity/invariants.py schema: weighted scored-vs-path sums,
+    # max per-lane residual, bad-flux count, lane counts), computed
+    # inside the jitted program with integrity=True — a couple of
+    # reductions over arrays the walk already holds, zero extra
+    # dispatches or transfers (the packed pipeline appends it to the
+    # readback tail). None with integrity=False.
+    integrity: jax.Array | None = None
 
 
 def resolve_tally_scatter(
@@ -398,6 +406,7 @@ def trace_impl(
     gathers: str = "merged",
     ledger: bool = True,
     stats: bool = True,
+    integrity: bool = False,
     debug_checks: bool = False,
     record_xpoints: int | None = None,
     n_groups: int | None = None,
@@ -492,6 +501,17 @@ def trace_impl(
         dispatches, no extra readbacks (the caller fetches the vector
         INSTEAD of scanning ``done`` host-side). False restores the
         exact pre-telemetry carry for A/B cost attribution.
+      integrity: fold the on-device conservation-invariant vector into
+        the jitted program (TraceResult.integrity;
+        integrity/invariants.py schema): Σ weight·scored-track vs
+        Σ weight·|final − origin| over completed lanes plus the max
+        per-lane residual (requires ``ledger``), a non-finite/negative
+        flux-entry count, and lane-count conservation inputs. All
+        end-of-walk reductions — nothing rides the crossing loop — and
+        the packed pipeline carries the vector in the existing readback
+        tail, so the transfer count is unchanged. The flux math is
+        untouched: outputs are bit-identical with the flag on or off
+        (pinned by tests/test_integrity.py).
       record_xpoints: when set to K, record each particle's first K
         boundary-crossing points into an [n, K, 3] buffer (the tracer's
         getIntersectionPoints() surface, reference test:403-479,
@@ -589,6 +609,11 @@ def trace_impl(
         )
     if gathers not in ("merged", "split"):
         raise ValueError(f"gathers must be 'merged' or 'split': {gathers!r}")
+    if integrity and not ledger:
+        raise ValueError(
+            "integrity=True needs the per-particle track-length ledger "
+            "(ledger=True) for the conservation invariant"
+        )
 
     # Carry layout — ONE definition shared by the walk body, the phase
     # runner and the compaction rounds: a fixed head (done stays at
@@ -1088,6 +1113,34 @@ def trace_impl(
         (lanes[-2], lanes[-1]) if record_xpoints is not None
         else (None, None)
     )
+    integ_vec = None
+    if integrity:
+        # Conservation invariants (integrity/invariants.py field order).
+        # Completed, walked lanes only: a truncated lane legitimately
+        # holds a partial ledger (the escalation re-walk's merge keeps
+        # the sums consistent across attempts — see _merge_rewalk).
+        comp = in_flight & done
+        zero = jnp.sum(weight) * 0  # device-varying scalar zero
+        if initial:
+            # The location search scores nothing; the conservation
+            # triple is identically zero by construction.
+            scored = path = resid = zero
+        else:
+            dist = jnp.linalg.norm(cur - origin, axis=-1)
+            scored = jnp.sum(jnp.where(comp, weight * pseg, 0.0))
+            path = jnp.sum(jnp.where(comp, weight * dist, 0.0))
+            resid = jnp.max(jnp.where(comp, jnp.abs(pseg - dist), 0.0))
+        bad_flux = jnp.sum(
+            jnp.logical_not(jnp.isfinite(flux)) | (flux < 0.0)
+        )
+        integ_vec = jnp.stack([
+            scored.astype(dtype),
+            path.astype(dtype),
+            resid.astype(dtype),
+            bad_flux.astype(dtype),
+            jnp.sum(in_flight).astype(dtype),
+            jnp.sum(comp).astype(dtype),
+        ])
     stats_vec = None
     if stats:
         ncross_l, nchase_l = lanes[0], lanes[1]
@@ -1116,6 +1169,7 @@ def trace_impl(
         n_xpoints=kx,
         track_length=pseg if ledger else None,
         stats=stats_vec,
+        integrity=integ_vec,
     )
 
 
@@ -1181,6 +1235,7 @@ _trace_jit = jax.jit(
         "gathers",
         "ledger",
         "stats",
+        "integrity",
         "debug_checks",
         "record_xpoints",
         "n_groups",
@@ -1241,7 +1296,8 @@ def trace_packed_impl(
         **kwargs,
     )
     readback = pack_trace_readback(
-        r.position, r.material_id, r.done, r.stats, r.n_segments, perm
+        r.position, r.material_id, r.done, r.stats, r.n_segments, perm,
+        r.integrity,
     )
     return r, readback, dest, in_flight, w, g
 
@@ -1262,6 +1318,7 @@ _trace_packed_jit = jax.jit(
         "gathers",
         "ledger",
         "stats",
+        "integrity",
         "debug_checks",
         "record_xpoints",
         "n_groups",
@@ -1352,6 +1409,28 @@ def _merge_rewalk(a: TraceResult, b: TraceResult, todo) -> TraceResult:
     track = None
     if a.track_length is not None and b.track_length is not None:
         track = a.track_length + b.track_length
+    integ = b.integrity
+    if a.integrity is not None and b.integrity is not None:
+        from ..integrity.invariants import IIDX as II
+
+        # Per-attempt conservation is internally consistent (attempt b
+        # walks the truncated lanes from their mid-walk positions, so
+        # its scored and path sums cover exactly the continuation), so
+        # the sums ADD; the residual maxes; bad_flux reflects the final
+        # accumulator; lanes_flying stays the move's true in-flight
+        # count (b saw only the retried subset) while lanes_done adds
+        # (b's completions are lanes a left unfinished).
+        integ = a.integrity + b.integrity
+        integ = integ.at[II["max_residual"]].set(
+            jnp.maximum(
+                a.integrity[II["max_residual"]],
+                b.integrity[II["max_residual"]],
+            )
+        )
+        integ = integ.at[II["bad_flux"]].set(b.integrity[II["bad_flux"]])
+        integ = integ.at[II["lanes_flying"]].set(
+            a.integrity[II["lanes_flying"]]
+        )
     return TraceResult(
         position=b.position,
         elem=b.elem,
@@ -1364,6 +1443,7 @@ def _merge_rewalk(a: TraceResult, b: TraceResult, todo) -> TraceResult:
         n_xpoints=kx,
         track_length=track,
         stats=stats,
+        integrity=integ,
     )
 
 

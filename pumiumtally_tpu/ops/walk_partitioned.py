@@ -161,6 +161,13 @@ class PartitionedTraceResult(NamedTuple):
     # step was built with packed_io=True: ONE device_get carries the
     # per-slot outputs AND the per-chip stats/round-stats/counters.
     readback: jax.Array | None = None
+    # [n_parts, PART_INTEGRITY_LEN] per-chip on-device integrity
+    # counters (integrity/invariants.py: bad_flux / lanes_valid /
+    # lanes_done), present with make_partitioned_step(integrity=True).
+    # The conservation half of the partitioned invariants is evaluated
+    # HOST-side by the facade from the migrating track-length ledger —
+    # per-lane and cut-aware, strictly stronger than a chip-local sum.
+    integrity: jax.Array | None = None
 
 
 def _walk_phase(
@@ -556,6 +563,7 @@ def make_partitioned_step(
     tally_scatter: str = "auto",
     record_xpoints: int | None = None,
     packed_io: bool = False,
+    integrity: bool = False,
 ):
     """Build the jitted distributed trace step for one mesh partition.
 
@@ -601,6 +609,14 @@ def make_partitioned_step(
         counters (ONE D2H per move).  Bit-identical to the unpacked
         step.  Incompatible with record_xpoints (the facade falls back
         to the legacy pipeline there).
+      integrity: fold the per-chip on-device integrity counters into
+        the program (PartitionedTraceResult.integrity;
+        integrity/invariants.py PART_INTEGRITY_FIELDS): non-finite /
+        negative flux-entry count over the owned slab plus slot
+        accounting (valid and finished lanes) for the facade's
+        lane-conservation check. End-of-step reductions only — the
+        packed readback carries them in its existing int64 tail, so
+        the one-H2D/one-D2H invariant of PR 3 is untouched.
 
     Returns step(cur, dest, elem, done, material, weight, group, pid, valid,
     flux) -> PartitionedTraceResult, where per-particle arrays are
@@ -1001,6 +1017,21 @@ def make_partitioned_step(
             (w0_iters + jnp.sum(round_stats[5])).astype(sd_t),
         ])
 
+        ivec = None
+        if integrity:
+            # On-device integrity counters (integrity/invariants.py
+            # PART_INTEGRITY_FIELDS): corruption in the owned flux slab
+            # (the additive accumulator a bit-flip poisons) plus slot
+            # accounting for the facade's lane-conservation check.
+            bad_flux = jnp.sum(
+                jnp.logical_not(jnp.isfinite(flux_l)) | (flux_l < 0.0)
+            )
+            ivec = jnp.stack([
+                bad_flux.astype(sd_t),
+                jnp.sum(valid).astype(sd_t),
+                jnp.sum(valid & done).astype(sd_t),
+            ])
+
         return PartitionedTraceResult(
             position=cur,
             dest=dest,
@@ -1020,6 +1051,7 @@ def make_partitioned_step(
             xpoints=xpk[0] if xpk else None,
             n_xpoints=xpk[1] if xpk else None,
             stats=svec[None],
+            integrity=None if ivec is None else ivec[None],
         )
 
     table_specs = tuple(P(AXIS) for _ in (*tables, *halo_tables))
@@ -1049,6 +1081,7 @@ def make_partitioned_step(
                 particle_spec if record_xpoints is not None else None
             ),
             stats=P(AXIS),
+            integrity=P(AXIS) if integrity else None,
         ),
     )
     if packed_io:

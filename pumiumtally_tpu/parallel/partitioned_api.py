@@ -183,6 +183,7 @@ class PartitionedTally:
             ),
             exchange_size=exchange_size,
             max_rounds=max_rounds,
+            integrity=self.config.resolve_integrity() != "off",
         )
         self._steps: dict = {}
         # Move-loop I/O pipelining (ops/staging.py; PumiTally mirror):
@@ -229,6 +230,35 @@ class PartitionedTally:
             from ..resilience.quarantine import setup
 
             setup(self, mesh.coords, self.num_particles)
+        # Self-verification layer (integrity/; the PumiTally contract):
+        # on-device flux/lane invariants per chip, host-side
+        # conservation over the migrating track ledger, shadow audits,
+        # watchdog, and the facade-side fault hooks.
+        self._integrity = self.config.resolve_integrity()
+        self._finj = None
+        self._auditor = None
+        if (
+            self._integrity != "off"
+            or self.config.audit_lanes
+            or self.config.move_deadline_s is not None
+        ):
+            from ..integrity import invariants
+            from ..resilience.faultinject import FaultInjector
+
+            self._finj = FaultInjector()
+            scale = invariants.mesh_scale(mesh.coords)
+            self._integrity_tol = invariants.conservation_tolerance(
+                self.config.integrity_tol, self.config.dtype, scale,
+                self.config.tolerance,
+            )
+            self._audit_tol = invariants.audit_tolerance(
+                self.config.audit_tol, self.config.dtype, scale,
+                self.config.tolerance,
+            )
+        if self.config.audit_lanes:
+            from ..integrity.audit import HostReference
+
+            self._auditor = HostReference(mesh)
         # sd_mode="batch": per-chip snapshot of the even (Σc) slab
         # entries as of the previous move. The halo fold has already
         # moved guest scores onto owner rows (and zeroed halo rows) by
@@ -296,6 +326,177 @@ class PartitionedTally:
         for fold in pending:
             fold()
 
+    def _dispatch(self, fn, move: int):
+        """Partitioned-step dispatch + blocking readback under the
+        watchdog deadline — the PumiTally._dispatch contract (the
+        closure is mutation-free; a timed-out dispatch is abandoned and
+        the supervisor's rollback rebuilds every donated buffer; the
+        first dispatch of each kind runs un-deadlined because it
+        includes XLA compilation)."""
+        if self.config.move_deadline_s is None:
+            return fn()
+        key = "init" if move == 0 else "move"
+        warm = getattr(self, "_watchdog_warm", None)
+        if warm is None:
+            warm = self._watchdog_warm = set()
+
+        def body():
+            if self._finj is not None and self._finj.maybe_hang(move):
+                self.metrics.counter(
+                    "pumi_injected_faults_total",
+                    "faults injected through PUMI_TPU_FAULTS "
+                    "(labeled by kind)",
+                ).inc(kind="hang")
+            return fn()
+
+        if key not in warm:
+            # Warm-up dispatch: un-deadlined (compilation), but still
+            # through body() so a hang_at_move targeting it fires.
+            warm.add(key)
+            return body()
+        from ..integrity.watchdog import (
+            DispatchTimeoutError,
+            run_with_deadline,
+        )
+
+        try:
+            return run_with_deadline(
+                body, self.config.move_deadline_s
+            )
+        except DispatchTimeoutError:
+            self._telemetry.record_integrity(move, {}, ["watchdog"])
+            raise
+
+    def _self_verify(
+        self, move, initial, got, moving, stats, pos_before, weights,
+        n_lost,
+    ) -> None:
+        """Integrity evaluation over one partitioned move: the
+        per-chip on-device counters (flux health, slot accounting),
+        host-side per-lane conservation over the MIGRATING track
+        ledger vs the facade's pre-move positions (cut-aware — a
+        double-scored cut segment shows here), particle-id coverage
+        (every moving pid accounted exactly once by the collect), and
+        the shadow audit. Escalates per TallyConfig.integrity."""
+        cfg = self.config
+        if self._integrity == "off" and not cfg.audit_lanes:
+            return
+        from ..integrity import invariants, policy
+
+        fields: dict = {}
+        violations: list = []
+        ivec = stats.pop("integrity_dev", None)
+        if self._integrity != "off" and ivec is not None:
+            ivec = np.asarray(ivec, np.int64)
+            done = got["done"].astype(bool)
+            n_moving = int(moving.sum())
+            fields["bad_flux"] = int(ivec[:, 0].sum())
+            fields["lanes_flying"] = n_moving
+            fields["lanes_done"] = int(done.sum())
+            if fields["bad_flux"] > 0:
+                violations.append("flux")
+            # Lane conservation: the device's occupied-slot count, the
+            # collect's pid coverage (each moving pid exactly once) and
+            # done + truncated == moving must all close.
+            if (
+                stats.get("pid_seen") != n_moving
+                or stats.get("pid_unique") != n_moving
+                or fields["lanes_done"] + int(n_lost) != n_moving
+            ):
+                violations.append("lanes")
+            if not initial:
+                # Host-side conservation over the migrating ledger.
+                track = np.asarray(got["track_length"], np.float64)
+                disp = np.linalg.norm(
+                    np.asarray(got["position"], np.float64)
+                    - pos_before,
+                    axis=1,
+                )
+                resid = np.where(done, np.abs(track - disp), 0.0)
+                w = np.asarray(weights, np.float64)[moving]
+                fields["scored_wlen"] = float(
+                    (w * np.where(done, track, 0.0)).sum()
+                )
+                fields["path_wlen"] = float(
+                    (w * np.where(done, disp, 0.0)).sum()
+                )
+                fields["max_residual"] = (
+                    float(resid.max()) if resid.size else 0.0
+                )
+                if fields["max_residual"] > self._integrity_tol:
+                    violations.append("conservation")
+        if (
+            cfg.audit_lanes
+            and self._auditor is not None
+            and not initial
+            and move >= 1
+            and move % cfg.audit_every == 0
+        ):
+            out = self._run_audit(move, got, moving, pos_before)
+            if out is not None:
+                self._telemetry.record_audit(
+                    move, out.audited, out.mismatches, out.skipped,
+                    out.max_dev,
+                )
+                if out.mismatches:
+                    violations.append("sdc_audit")
+        if fields or violations:
+            self._telemetry.record_integrity(move, fields, violations)
+        policy.escalate(self._integrity, violations, move)
+
+    def _run_audit(self, move, got, moving, pos_before):
+        """Shadow-audit a K-lane sample of this move — entirely from
+        arrays the facade already holds host-side (origins, global
+        elements, collected positions and the migrated track ledger):
+        zero extra transfers on the partitioned facade."""
+        cfg = self.config
+        done = got["done"].astype(bool)
+        rows = np.nonzero(done)[0]  # rows within the moving subset
+        if rows.size == 0:
+            return None
+        rng = np.random.default_rng([cfg.audit_seed, int(move)])
+        sel = rng.choice(
+            rows, size=min(cfg.audit_lanes, rows.size), replace=False
+        )
+        dests = self._audit_dest[sel]
+        origins = pos_before[sel]
+        elems = self._audit_elem_before[sel]
+        prod_pos = np.asarray(got["position"], np.float64)[sel]
+        track = np.asarray(got["track_length"], np.float64)[sel].copy()
+        if self._finj is not None and self._finj.sdc_at(move):
+            track[0] += 1e3 * self._audit_tol
+            self.metrics.counter(
+                "pumi_injected_faults_total",
+                "faults injected through PUMI_TPU_FAULTS "
+                "(labeled by kind)",
+            ).inc(kind="sdc_walk")
+        from ..integrity.audit import audit_sample
+
+        return audit_sample(
+            self._auditor, origins, dests, elems, prod_pos, track,
+            tolerance=cfg.tolerance,
+            max_crossings=self._step_kwargs["max_crossings"],
+            tol=self._audit_tol,
+        )
+
+    def _maybe_inject_bitflip(self, move: int) -> None:
+        """``bitflip_flux`` hook over the sharded slabs — the
+        PumiTally._maybe_inject_bitflip contract."""
+        if self._finj is None or not self._finj.bitflip_at(move):
+            return
+        flat = self.flux_slabs.reshape(-1)
+        j = int(jnp.argmax(jnp.abs(flat)))
+        v = flat[j]
+        self.flux_slabs = (
+            flat.at[j]
+            .set(jnp.where(v == 0, jnp.asarray(jnp.nan, flat.dtype), -v))
+            .reshape(self.flux_slabs.shape)
+        )
+        self.metrics.counter(
+            "pumi_injected_faults_total",
+            "faults injected through PUMI_TPU_FAULTS (labeled by kind)",
+        ).inc(kind="bitflip_flux")
+
     def _run(self, dest, in_flight, weight, group, initial):
         field = (
             "initialization_time" if initial else "total_time_to_tally"
@@ -336,6 +537,20 @@ class PartitionedTally:
 
     def _run_inner(self, dest, in_flight, weight, group, initial):
         moving = in_flight != 0
+        pos_before = None
+        if self._integrity != "off" or self.config.audit_lanes:
+            # Pre-move positions for the host-side conservation check
+            # (the walk folds positions back into self.positions in
+            # place). The destination/element copies are audit-only —
+            # skipped on the audit-off hot path.
+            pos_before = np.asarray(
+                self.positions[moving], np.float64
+            ).copy()
+            if self.config.audit_lanes:
+                self._audit_dest = np.asarray(
+                    dest[moving], np.float64
+                ).copy()
+                self._audit_elem_before = self.elem_global[moving].copy()
         got, stats = self._walk_once(dest, moving, weight, group, initial)
         n_lost = stats["agg"]["truncated"]
         n_re = 0
@@ -359,6 +574,11 @@ class PartitionedTally:
             )
             _merge_got(got, sub_trunc, got2)
             stats["agg"] = _merge_agg(stats["agg"], stats2["agg"])
+            if "integrity_dev" in stats2:
+                # Latest attempt's on-device counters carry the FINAL
+                # flux health; pid coverage keeps attempt 1's
+                # full-moving-set view.
+                stats["integrity_dev"] = stats2["integrity_dev"]
             for f in ("rounds", "dropped", "migrated", "adopted",
                       "h2d_bytes", "h2d_transfers", "d2h_bytes",
                       "d2h_transfers"):
@@ -400,6 +620,15 @@ class PartitionedTally:
                 RuntimeWarning,
                 stacklevel=4,
             )
+        # Self-verification (integrity/) + the bitflip fault hook
+        # (caught by the NEXT move's on-device flux invariant).
+        move = self.iter_count + (0 if initial else 1)
+        self._self_verify(
+            move, initial, got, moving, stats, pos_before, weight,
+            n_lost,
+        )
+        if not initial:
+            self._maybe_inject_bitflip(move)
         return got, moving, stats
 
     def _walk_once(self, dest, moving, weight, group, initial):
@@ -423,22 +652,34 @@ class PartitionedTally:
             ),
             cap=self.cap,
         )
-        res = self._step(initial)(
-            placed["origin"].astype(self.config.dtype),
-            placed["dest"].astype(self.config.dtype),
-            placed["elem"],
-            jnp.zeros_like(placed["valid"]),
-            placed["material_id"],
-            placed["weight"].astype(self.config.dtype),
-            placed["group"],
-            placed["particle_id"],
-            placed["valid"],
-            self.flux_slabs,
+        flux_in = self.flux_slabs  # bound pre-closure: an abandoned
+        # watchdog worker must consume the stale buffer, never the
+        # restored live slabs (PumiTally._dispatch contract).
+
+        def _go():
+            res = self._step(initial)(
+                placed["origin"].astype(self.config.dtype),
+                placed["dest"].astype(self.config.dtype),
+                placed["elem"],
+                jnp.zeros_like(placed["valid"]),
+                placed["material_id"],
+                placed["weight"].astype(self.config.dtype),
+                placed["group"],
+                placed["particle_id"],
+                placed["valid"],
+                flux_in,
+            )
+            # The collect's np.asarray fetches are the blocking reads,
+            # so they belong inside the watchdog-supervised closure
+            # (mutation-free: state folds happen after dispatch).
+            return res, collect_by_particle_id(
+                res, int(moving.sum()), self.partition
+            )
+
+        res, got = self._dispatch(
+            _go, self.iter_count + (0 if initial else 1)
         )
         self.flux_slabs = res.flux
-        got = collect_by_particle_id(
-            res, int(moving.sum()), self.partition
-        )
         n_dropped = int(np.asarray(res.n_dropped).sum())
         if n_dropped != 0:
             raise RuntimeError(
@@ -463,7 +704,9 @@ class PartitionedTally:
             res.done, res.elem, res.weight, res.group, res.track_length,
             res.stats, res.round_stats, res.n_rounds, res.n_dropped,
         ] + ([res.xpoints, res.n_xpoints] if res.xpoints is not None
-             else [])
+             else []) + (
+            [res.integrity] if res.integrity is not None else []
+        )
         stats = {
             "agg": agg,
             "rounds": n_rounds,
@@ -479,6 +722,12 @@ class PartitionedTally:
             "d2h_bytes": sum(int(a.nbytes) for a in d2h_reads),
             "d2h_transfers": len(d2h_reads),
         }
+        if res.integrity is not None:
+            stats["integrity_dev"] = np.asarray(res.integrity)
+            pid_h = np.asarray(res.particle_id)
+            sel = np.asarray(res.valid) & (pid_h >= 0)
+            stats["pid_seen"] = int(sel.sum())
+            stats["pid_unique"] = int(np.unique(pid_h[sel]).size)
         self.total_segments += agg["segments"]
         self.total_rounds += n_rounds
         return got, stats
@@ -511,17 +760,33 @@ class PartitionedTally:
         rec = jax.device_put(
             rec_h, NamedSharding(self.device_mesh, P(AXIS))
         )
-        res = self._step(initial)(rec, self.flux_slabs)
-        self.flux_slabs = res.flux
-        if self._io == "overlap":
-            # The previous move's deferred bookkeeping overlaps this
-            # step's device execution.
+
+        flux_in = self.flux_slabs  # bound pre-closure (see _walk_once)
+
+        deadline = self.config.move_deadline_s is not None
+
+        def _go():
+            res = self._step(initial)(rec, flux_in)
+            if self._io == "overlap" and not deadline:
+                # The previous move's deferred bookkeeping overlaps
+                # this step's device execution. Under the watchdog the
+                # closure must stay mutation-free (an abandoned worker
+                # must never touch _pending_folds/telemetry), so the
+                # drain moves after the dispatch.
+                self._drain_pending()
+            return res, jax.device_get(res.readback)
+
+        res, host_rb = self._dispatch(
+            _go, self.iter_count + (0 if initial else 1)
+        )
+        if self._io == "overlap" and deadline:
             self._drain_pending()
-        host_rb = jax.device_get(res.readback)
+        self.flux_slabs = res.flux
         io["d2h_bytes"] += int(host_rb.nbytes)
         io["d2h_transfers"] += 1
         parsed = staging.split_partitioned_readback(
-            host_rb, self.n_parts, self.cap, self.config.dtype
+            host_rb, self.n_parts, self.cap, self.config.dtype,
+            integrity=self._integrity != "off",
         )
         got = staging.collect_packed(
             parsed, int(moving.sum()), self.partition
@@ -550,6 +815,12 @@ class PartitionedTally:
             "per_chip_crossings": sv[:, IDX["crossings"]].tolist(),
             **io,
         }
+        if "integrity" in parsed:
+            stats["integrity_dev"] = parsed["integrity"]
+            pid_h = parsed["particle_id"]
+            sel = parsed["valid"] & (pid_h >= 0)
+            stats["pid_seen"] = int(sel.sum())
+            stats["pid_unique"] = int(np.unique(pid_h[sel]).size)
         self.total_segments += agg["segments"]
         self.total_rounds += n_rounds
         return got, stats

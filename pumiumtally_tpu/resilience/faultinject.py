@@ -23,7 +23,31 @@ Grammar: comma-separated ``name[:value]`` clauses —
   ``corrupt_ckpt``        every checkpoint the supervisor writes is
                           bit-flipped right after the write — the
                           ``find_latest`` fallback must skip it;
+  ``bitflip_flux:K``      after the K-th facade move, one flux entry
+                          gets its sign flipped (or NaN'd when the
+                          accumulator is still empty) — a single-bit
+                          SDC the integrity layer's on-device flux
+                          invariant must catch on the NEXT move
+                          (integrity/invariants.py);
+  ``sdc_walk:K``          at the K-th move's shadow audit, one sampled
+                          lane's production track length is perturbed —
+                          a mis-scored segment the float64 audit
+                          re-walk must flag (integrity/audit.py);
+  ``hang_at_move:K``      the K-th move's device dispatch sleeps
+                          ``hang_seconds`` (a wedged dispatch) — the
+                          watchdog deadline must surface it as a
+                          retryable DispatchTimeoutError
+                          (integrity/watchdog.py);
+  ``hang_seconds:S``      how long the injected hang sleeps (default
+                          5.0; tests use fractions of a second so the
+                          abandoned watchdog thread dies quickly);
   ``seed:S``              rng seed for nan_src lane choice (default 0).
+
+The PR 2 modes (nan_src/die/transient/corrupt_ckpt) are driven by the
+``ResilientRunner``'s injector; the integrity modes (bitflip_flux/
+sdc_walk/hang_at_move) are driven by the FACADE's own injector so the
+detectors they target see the corruption regardless of whether a
+supervisor wraps the run.
 
 The injector is a no-op when the plan is empty, so production code can
 call its hooks unconditionally.
@@ -57,6 +81,10 @@ class FaultPlan:
     die_at_move: int | None = None
     transient_at_move: int | None = None
     corrupt_ckpt: bool = False
+    bitflip_flux: int | None = None
+    sdc_walk: int | None = None
+    hang_at_move: int | None = None
+    hang_seconds: float = 5.0
     seed: int = 0
 
     def any(self) -> bool:
@@ -65,6 +93,9 @@ class FaultPlan:
             or self.die_at_move is not None
             or self.transient_at_move is not None
             or self.corrupt_ckpt
+            or self.bitflip_flux is not None
+            or self.sdc_walk is not None
+            or self.hang_at_move is not None
         )
 
 
@@ -89,13 +120,26 @@ def parse_faults(spec: str) -> FaultPlan:
             if value:
                 raise ValueError("corrupt_ckpt takes no value")
             fields["corrupt_ckpt"] = True
+        elif name == "bitflip_flux":
+            fields["bitflip_flux"] = int(value)
+        elif name == "sdc_walk":
+            fields["sdc_walk"] = int(value)
+        elif name == "hang_at_move":
+            fields["hang_at_move"] = int(value)
+        elif name == "hang_seconds":
+            fields["hang_seconds"] = float(value)
+            if fields["hang_seconds"] <= 0:
+                raise ValueError(
+                    f"hang_seconds must be positive: {value!r}"
+                )
         elif name == "seed":
             fields["seed"] = int(value)
         else:
             raise ValueError(
                 f"unknown fault {name!r} in PUMI_TPU_FAULTS "
                 f"(known: nan_src, die_at_move, transient_at_move, "
-                f"corrupt_ckpt, seed)"
+                f"corrupt_ckpt, bitflip_flux, sdc_walk, hang_at_move, "
+                f"hang_seconds, seed)"
             )
     return FaultPlan(**fields)
 
@@ -116,6 +160,9 @@ class FaultInjector:
         self.plan = plan if plan is not None else plan_from_env()
         self._died = False
         self._transient_fired = False
+        self._bitflip_fired = False
+        self._sdc_fired = False
+        self._hang_fired = False
 
     # ------------------------------------------------------------------ #
     def maybe_die(self, move: int) -> None:
@@ -141,6 +188,49 @@ class FaultInjector:
                 f"injected transient device error at move {move} "
                 f"(PUMI_TPU_FAULTS transient_at_move)"
             )
+
+    def bitflip_at(self, move: int) -> bool:
+        """``bitflip_flux``: True exactly once, after the matching move
+        — the facade then flips one accumulator entry so the NEXT
+        move's on-device flux invariant must catch it."""
+        if (
+            self.plan.bitflip_flux is not None
+            and move == self.plan.bitflip_flux
+            and not self._bitflip_fired
+        ):
+            self._bitflip_fired = True
+            return True
+        return False
+
+    def sdc_at(self, move: int) -> bool:
+        """``sdc_walk``: True exactly once, at the matching move's
+        shadow audit — the audit then perturbs one sampled lane's
+        production result so the float64 re-walk must flag it."""
+        if (
+            self.plan.sdc_walk is not None
+            and move == self.plan.sdc_walk
+            and not self._sdc_fired
+        ):
+            self._sdc_fired = True
+            return True
+        return False
+
+    def maybe_hang(self, move: int) -> bool:
+        """``hang_at_move``: sleep ``hang_seconds`` inside the dispatch
+        closure at the matching move (once) — a wedged device dispatch
+        the watchdog deadline must convert into a retryable timeout.
+        Returns True when the hang fired (for fault accounting)."""
+        if (
+            self.plan.hang_at_move is not None
+            and move == self.plan.hang_at_move
+            and not self._hang_fired
+        ):
+            self._hang_fired = True
+            import time
+
+            time.sleep(self.plan.hang_seconds)
+            return True
+        return False
 
     def corrupt_destinations(self, dest, move: int) -> int:
         """NaN destination lanes IN PLACE with probability ``nan_src``,

@@ -38,6 +38,11 @@ import time
 
 import numpy as np
 
+from ..integrity.policy import (
+    FatalIntegrityViolation,
+    TransientIntegrityViolation,
+)
+from ..integrity.watchdog import DispatchTimeoutError
 from ..utils.checkpoint import restore_state, snapshot_state
 from ..utils.log import log_info, log_warn
 from .faultinject import (
@@ -53,10 +58,20 @@ except ImportError:  # pragma: no cover
         """Placeholder when jax.errors lacks JaxRuntimeError."""
 
 
-#: Error types a move retry can plausibly fix: injected transients and
-#: JAX runtime errors (preempted device, RESOURCE_EXHAUSTED, collective
-#: timeouts). Anything else — including InjectedKill — propagates.
-RETRYABLE = (InjectedTransientFault, _JaxRuntimeError)
+#: Error types a move retry can plausibly fix: injected transients, JAX
+#: runtime errors (preempted device, RESOURCE_EXHAUSTED, collective
+#: timeouts), watchdog dispatch timeouts (integrity/watchdog.py — a
+#: hung dispatch re-arms and replays instead of wedging), and
+#: integrity="retry" violations (a one-shot SDC does not recur on
+#: replay; a deterministic kernel bug exhausts the bounded retries and
+#: propagates). Anything else — including InjectedKill and
+#: integrity="halt" violations — propagates.
+RETRYABLE = (
+    InjectedTransientFault,
+    DispatchTimeoutError,
+    TransientIntegrityViolation,
+    _JaxRuntimeError,
+)
 
 
 class ResilientRunner:
@@ -167,10 +182,27 @@ class ResilientRunner:
             self._c_fault.inc(n_nan, kind="nan_src")
         self._in_move = True
         try:
-            self._move_with_retry(
-                move, particle_destinations, flying, weights, groups,
-                material_ids, size,
-            )
+            try:
+                self._move_with_retry(
+                    move, particle_destinations, flying, weights, groups,
+                    material_ids, size,
+                )
+            except FatalIntegrityViolation:
+                # integrity="halt": flush the last GOOD generation —
+                # never the suspect post-violation state — so the
+                # campaign can be resumed from verified data, then let
+                # the halt propagate.
+                if self._good is not None:
+                    restore_state(self.tally, self._good)
+                    try:
+                        path = self.checkpoint()
+                        log_warn(
+                            f"integrity halt at move {move}: flushed "
+                            f"last-good checkpoint {path} before raising"
+                        )
+                    except Exception as e:  # pragma: no cover
+                        log_warn(f"integrity-halt flush failed: {e}")
+                raise
             if self._want_snapshot():
                 self._good = snapshot_state(self.tally)
             self._maybe_checkpoint()

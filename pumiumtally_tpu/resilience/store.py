@@ -17,6 +17,7 @@ import re
 
 from ..utils.checkpoint import (
     CheckpointIntegrityError,
+    fsync_dir,
     verify_checkpoint,
 )
 from ..utils.log import log_info, log_warn
@@ -75,13 +76,22 @@ class CheckpointStore:
         return path
 
     def _rotate(self) -> None:
+        removed = False
         for _, path in self.entries()[: -self.keep]:
             try:
                 os.unlink(path)
+                removed = True
             except OSError as e:
                 log_warn(
                     f"checkpoint rotation could not remove {path}: {e}"
                 )
+        if removed:
+            # Make the unlinks durable: without the directory fsync a
+            # power cut can resurrect a rotated-out generation while
+            # losing the newest rename — find_latest would then resume
+            # an OLDER state than the rotation promised survives
+            # (utils/checkpoint.fsync_dir).
+            fsync_dir(self.directory)
 
     # ------------------------------------------------------------------ #
     def find_latest(self) -> tuple[int, str] | None:

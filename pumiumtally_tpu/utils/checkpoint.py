@@ -77,6 +77,27 @@ def _normalize(filename: str) -> str:
     return filename if filename.endswith(".npz") else filename + ".npz"
 
 
+def fsync_dir(directory: str) -> None:
+    """Best-effort fsync of a DIRECTORY, making the renames/unlinks
+    inside it durable across power loss (a data fsync alone only makes
+    the file contents durable — the directory entry pointing at them
+    lives in the directory's own metadata block). Shared by
+    ``atomic_savez`` (after the rename) and ``CheckpointStore``'s
+    keep-N rotation (after the deletions): without the latter, a power
+    cut after rotation could resurrect a deleted older generation AND
+    lose the rename of the newest, leaving ``find_latest`` a stale
+    view. Unsupported filesystems (some network mounts) are tolerated —
+    the data fsync + rename already rule out torn files there."""
+    try:
+        dfd = os.open(directory, os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except OSError:
+        pass
+
+
 def atomic_savez(filename: str, **arrays) -> str:
     """``np.savez_compressed`` with crash-safe semantics: write to a
     same-directory temp file, flush + fsync, then ``os.replace`` over
@@ -94,17 +115,7 @@ def atomic_savez(filename: str, **arrays) -> str:
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, filename)
-        try:
-            dfd = os.open(directory, os.O_RDONLY)
-            try:
-                os.fsync(dfd)
-            finally:
-                os.close(dfd)
-        except OSError:
-            # Directory fsync is best-effort (unsupported on some
-            # filesystems); the data fsync + rename already rule out a
-            # truncated file.
-            pass
+        fsync_dir(directory)
     except BaseException:
         try:
             os.unlink(tmp)

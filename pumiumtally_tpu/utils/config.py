@@ -171,6 +171,42 @@ class TallyConfig:
         when record_xpoints or checkify_invariants is set (those paths
         need the un-packed result surface).
 
+    integrity: the self-verification escalation mode
+        (integrity/policy.py). "off" (default): no invariant programs,
+        today's exact behavior. Any other mode folds the on-device
+        conservation invariants into the walk programs (weighted
+        scored-vs-path track length over completed lanes, flux
+        non-negativity/finiteness, lane-count conservation — riding the
+        PR 3 packed readback tail at zero extra transfers) and
+        escalates violations: "warn" counts
+        (``pumi_integrity_violations_total{check=...}``) and warns;
+        "retry" raises a RETRYABLE ``TransientIntegrityViolation`` the
+        ``ResilientRunner`` absorbs with its last-good rollback;
+        "halt" raises fatally (the runner flushes a last-good
+        checkpoint first). Outputs are bit-identical in every mode —
+        the checks read, never write.
+    integrity_tol: per-lane conservation-residual threshold (default:
+        dtype- and mesh-scale-aware, integrity/invariants.py
+        conservation_tolerance).
+    audit_lanes: shadow-audit sample size K (integrity/audit.py). When
+        > 0, every ``audit_every``-th move re-walks K randomly sampled
+        completed lanes through an independent float64 host-reference
+        walker and compares final positions and scored track lengths
+        within ``audit_tol`` — a continuous SDC / kernel-regression
+        detector. Mismatches are ``sdc_audit`` violations under the
+        ``integrity`` policy; outcomes land in the flight recorder and
+        ``telemetry()["integrity"]``. 0 (default) pays nothing.
+    audit_every / audit_tol / audit_seed: audit cadence, comparison
+        threshold (default dtype-aware) and sampling seed (the sample
+        is deterministic per (seed, move), so replays audit the same
+        lanes).
+    move_deadline_s: dispatch-watchdog deadline around each compiled
+        step + readback (integrity/watchdog.py). A hung dispatch
+        surfaces as a retryable ``DispatchTimeoutError`` (counted under
+        check="watchdog") instead of blocking forever, so the PR 2
+        retry machinery re-arms and replays. None (default): no
+        watchdog thread, zero overhead.
+
     Scope: ``ledger`` and ``gathers`` are honored by the single-chip and
     streaming-pipeline walks only. The partitioned walk
     (ops/walk_partitioned.py) always accumulates and migrates the ledger
@@ -205,6 +241,52 @@ class TallyConfig:
     quarantine: bool = False
     truncation_retries: int = 0
     io_pipeline: str = "packed"
+    integrity: str = "off"
+    integrity_tol: float | None = None
+    audit_lanes: int = 0
+    audit_every: int = 1
+    audit_tol: float | None = None
+    audit_seed: int = 0
+    move_deadline_s: float | None = None
+
+    def resolve_integrity(self) -> str:
+        """Validate and return the self-verification mode
+        (integrity/policy.py escalation ladder). Conservation invariants
+        need the track-length ledger; the shadow-audit knobs must be
+        coherent."""
+        mode = self.integrity
+        if mode not in ("off", "warn", "retry", "halt"):
+            raise ValueError(
+                "integrity must be 'off', 'warn', 'retry' or 'halt': "
+                f"{mode!r}"
+            )
+        if mode != "off" and not self.ledger:
+            raise ValueError(
+                "integrity checks need the track-length conservation "
+                "ledger: keep ledger=True (the default) or set "
+                "integrity='off'"
+            )
+        if self.audit_lanes < 0:
+            raise ValueError(
+                f"audit_lanes must be >= 0: {self.audit_lanes}"
+            )
+        if self.audit_every < 1:
+            raise ValueError(
+                f"audit_every must be >= 1: {self.audit_every}"
+            )
+        if self.audit_lanes and not self.ledger:
+            raise ValueError(
+                "shadow audits compare the track-length ledger: keep "
+                "ledger=True (the default) or set audit_lanes=0"
+            )
+        if (
+            self.move_deadline_s is not None
+            and self.move_deadline_s <= 0
+        ):
+            raise ValueError(
+                f"move_deadline_s must be positive: {self.move_deadline_s}"
+            )
+        return mode
 
     def resolve_io_pipeline(self) -> str:
         """The effective move-loop I/O mode: the env override

@@ -5,7 +5,14 @@ truncation (fast mode), the per-particle conservation ledger, and the
 ledger-vs-flux total. A manual, longer-running complement to
 tests/test_jittered_mesh.py — run before shipping walk changes.
 
-Usage: python scripts/soak_walk.py [n_seeds]
+Usage: python scripts/soak_walk.py [n_seeds] [--audit-every N]
+
+--audit-every N additionally shadow-audits every N-th seed: an 8-lane
+random sample of finished walks is re-walked through the independent
+float64 host reference (pumiumtally_tpu/integrity/audit.py) and the
+kernel's positions/track lengths must agree within the dtype-aware
+audit tolerance — the soak-scale exercise of the production SDC
+detector.
 """
 import os
 import sys
@@ -25,8 +32,19 @@ from pumiumtally_tpu.mesh.box import build_box_arrays
 from pumiumtally_tpu.mesh.core import TetMesh
 from pumiumtally_tpu.ops.walk import trace_impl
 
+from pumiumtally_tpu.integrity.audit import HostReference, audit_sample
+from pumiumtally_tpu.integrity.invariants import audit_tolerance, mesh_scale
+
+args = sys.argv[1:]
+audit_every = 0
+if "--audit-every" in args:
+    i = args.index("--audit-every")
+    audit_every = int(args[i + 1])
+    del args[i:i + 2]
+n_seeds = int(args[0]) if args else 12
+
 fails = 0
-for seed in range(int(sys.argv[1]) if len(sys.argv) > 1 else 12):
+for seed in range(n_seeds):
     rng = np.random.default_rng(1000 + seed)
     nx = int(rng.integers(3, 8)); jitter = float(rng.uniform(0.0, 0.28))
     coords, tets = build_box_arrays(1.0, 1.0, 1.0, nx, nx, nx)
@@ -61,8 +79,30 @@ for seed in range(int(sys.argv[1]) if len(sys.argv) > 1 else 12):
           and np.allclose(tl, np.linalg.norm(pos - origin, axis=1), atol=3e-4)
           and np.isclose(float(np.asarray(r.flux)[..., 0].sum()), tl.sum(), rtol=1e-4)
           and (not robust or bool(np.asarray(r.done).all())))
+    audit_note = ""
+    if audit_every and seed % audit_every == 0:
+        done_h = np.asarray(r.done)
+        rows = np.nonzero(done_h)[0]
+        rng_a = np.random.default_rng(seed)
+        sel = rng_a.choice(rows, size=min(8, rows.size), replace=False)
+        out = audit_sample(
+            HostReference(mesh),
+            origin[sel].astype(np.float64),
+            dest[sel].astype(np.float64),
+            np.asarray(elem)[sel],
+            pos[sel], tl[sel],
+            tolerance=1e-6, max_crossings=mesh.ntet + 64,
+            tol=audit_tolerance(
+                None, np.float32, mesh_scale(mesh.coords), 1e-6
+            ),
+        )
+        ok = ok and out.mismatches == 0
+        audit_note = (
+            f" audit={out.audited - out.mismatches}/{out.audited}"
+            f"(+{out.skipped} skipped)"
+        )
     print(f"seed {seed}: nx={nx} jitter={jitter:.2f} robust={robust} "
           f"{scatter}/{gath} done={int(np.asarray(r.done).sum())}/{n} "
-          f"{'OK' if ok else 'FAIL'}", flush=True)
+          f"{'OK' if ok else 'FAIL'}{audit_note}", flush=True)
     fails += 0 if ok else 1
 print("SOAK", "PASS" if fails == 0 else f"{fails} FAILURES")
