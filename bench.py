@@ -414,11 +414,18 @@ def run_event_loop(
     from pumiumtally_tpu.models.pipeline import StreamingTallyPipeline
 
     rng = np.random.default_rng(seed + 1)
+    # BENCH_CONVERGENCE=1: run the event loop with the fused
+    # uncertainty reduction on, so the bench JSON prices the
+    # convergence-observability overhead (the transfer-count invariant
+    # is pinned by tests; this prices the in-program reductions) and
+    # carries the run's convergence block.
+    convergence = os.environ.get("BENCH_CONVERGENCE", "0") == "1"
     cfg = TallyConfig(
         dtype=dtype, n_groups=n_groups, tolerance=1e-6, unroll=8,
         compact_stages="auto",  # same dense ladder as the kernel bench,
         # so the event-loop vs kernel gap is dispatch overhead, not a
         # scheduling difference
+        convergence=convergence,
     )
     tally = PumiTally(mesh, n_particles, cfg)
     cents = np.asarray(mesh.centroids())
@@ -526,13 +533,19 @@ def run_event_loop(
     psegs = sum(r.n_segments for r in pipe.results() if r.index > 0)
     pipe_rate = psegs / dt_p
 
-    return {
+    out = {
         "event_loop_segments_per_sec": round(event_rate, 1),
         "event_call_overhead_ms": round(overhead_ms, 2),
         "event_particles": n_particles,
         "event_moves": moves,
         "pipeline_segments_per_sec": round(pipe_rate, 1),
     }
+    if convergence:
+        # The run's final convergence block (rel-err / converged
+        # fraction / FOM) rides the bench record, so a soak's JSON is
+        # self-describing about how converged its tallies were.
+        out["convergence"] = tally.telemetry()["convergence"]
+    return out
 
 
 def _stages_from_env() -> tuple | str | None:

@@ -28,7 +28,7 @@ from .integrity import (
 from .mesh.io import load_mesh, save_npz
 from .models.pipeline import StreamingTallyPipeline
 from .models.transport import Material, SyntheticTransport
-from .obs import FlightRecorder, MetricsRegistry
+from .obs import FlightRecorder, MetricsExporter, MetricsRegistry
 from .ops.walk import trace, TraceResult
 from .resilience import CheckpointStore, FaultInjector, ResilientRunner
 from .utils.config import TallyConfig
@@ -56,6 +56,7 @@ __all__ = [
     "Material",
     "SyntheticTransport",
     "MetricsRegistry",
+    "MetricsExporter",
     "FlightRecorder",
     "ResilientRunner",
     "CheckpointStore",

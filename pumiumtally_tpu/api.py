@@ -46,7 +46,13 @@ from .core.tally import (
 )
 from .io.vtk import write_flux_vtk
 from .mesh.core import TetMesh
-from .obs import TallyTelemetry, stats_to_dict
+from .obs import (
+    ConvergenceMonitor,
+    TallyTelemetry,
+    conv_to_dict,
+    maybe_start_exporter,
+    stats_to_dict,
+)
 from .ops import staging
 from .ops.walk import trace, trace_packed
 from .utils.config import TallyConfig
@@ -204,10 +210,42 @@ class PumiTally:
                 from .integrity.audit import HostReference
 
                 self._auditor = HostReference(mesh)
+            # Statistical-convergence observability (obs/convergence.py):
+            # device-resident batch accumulators — the even-entry
+            # snapshot Σ T_b, Σ T_b², and the batch/move counters — plus
+            # the gauge-feeding monitor. All None/absent when off — the
+            # hot path pays nothing and stays bit-identical.
+            self._batch_moves = cfg.resolve_convergence()
+            self._monitor = None
+            self._conv = None
+            if self._batch_moves is not None:
+                nbins = mesh.ntet * cfg.n_groups
+                self._conv = (
+                    jnp.zeros(nbins, cfg.dtype),
+                    jnp.zeros(nbins, cfg.dtype),
+                    jnp.zeros((), jnp.int32),
+                    jnp.zeros((), jnp.int32),
+                )
+                self._monitor = ConvergenceMonitor(
+                    self._telemetry,
+                    rel_err_target=cfg.rel_err_target,
+                    converged_fraction=cfg.converged_fraction,
+                    batch_moves=self._batch_moves,
+                )
             timer.sync((self.state, self.flux))
         # Phase-boundary memory sample (HBM peaks where the backend
         # reports them — construction allocated the mesh tables + flux).
         self._telemetry.record_memory("initialization")
+        # Live scrape endpoint (obs/exporter.py): serves this tally's
+        # registry as Prometheus text when PUMI_TPU_PROM_PORT is set.
+        # Stopped by close(); the GC finalizer releases the port for
+        # tallies that are simply dropped (the handler closure would
+        # otherwise pin the registry and the socket forever).
+        self._exporter = maybe_start_exporter(self.metrics)
+        if self._exporter is not None:
+            import weakref
+
+            weakref.finalize(self, self._exporter.stop)
 
     # ------------------------------------------------------------------ #
     def _trace(self, *args, **kwargs):
@@ -537,6 +575,10 @@ class PumiTally:
                 )
                 io["d2h_bytes"] += int(host_rb.nbytes)
                 io["d2h_transfers"] += 1
+                # Re-walk merges never carry a convergence tail (the
+                # batch fold belongs to the move's MAIN dispatch; see
+                # staging.pack_trace_readback_cold) — split accordingly
+                # and let the caller keep the main readback's summary.
                 parts = staging.split_trace_readback(
                     host_rb, self.num_particles, self.config.dtype,
                     integrity=self._integrity != "off",
@@ -629,7 +671,7 @@ class PumiTally:
                 result, readback, dest, _fly, _w, _g = out
                 io["d2h_bytes"] += int(host_rb.nbytes)
                 io["d2h_transfers"] += 1
-                _pos, _mats, done_h, tail, integ = (
+                _pos, _mats, done_h, tail, integ, _conv = (
                     staging.split_trace_readback(
                         host_rb, n, self.config.dtype,
                         integrity=self._integrity != "off",
@@ -813,6 +855,18 @@ class PumiTally:
                 record_xpoints=cfg.record_xpoints,
                 n_groups=cfg.n_groups,
             )
+            # Convergence observability: the batch accumulators ride the
+            # move's MAIN dispatch only (escalation re-walks score into
+            # the same flux, and the NEXT batch's delta picks their
+            # contributions up — the batches stay an exact partition of
+            # all scores). Bound pre-closure like the donated flux.
+            ckw = {}
+            if self._monitor is not None:
+                ckw = dict(
+                    conv_state=self._conv,
+                    rel_err_target=cfg.rel_err_target,
+                    batch_moves=self._batch_moves,
+                )
             if self._io != "legacy":
                 # Packed pipeline (ops/staging.py): ONE contiguous host
                 # record up (dest/weight/group/flying), slot permutation
@@ -839,7 +893,7 @@ class PumiTally:
                     out = self._trace(
                         self.mesh, s.origin, s.elem, s.material_id,
                         rec_dev, flux_in,
-                        perm_in, _packed=True, **tkw,
+                        perm_in, _packed=True, **tkw, **ckw,
                     )
                     if self._io == "overlap" and not deadline:
                         # Deferred bookkeeping of the PREVIOUS move
@@ -855,12 +909,17 @@ class PumiTally:
                 if self._io == "overlap" and deadline:
                     self._drain_pending()
                 result, readback, dest, in_flight, weight, group = out
+                # Updated batch accumulators from the MAIN dispatch — an
+                # escalation re-walk below replaces ``result`` with a
+                # merged TraceResult that has no conv fields.
+                conv_new = result.conv_state
                 io["d2h_bytes"] += int(host_rb.nbytes)
                 io["d2h_transfers"] += 1
-                final_pos, final_mats, done_h, tail, integ = (
+                final_pos, final_mats, done_h, tail, integ, conv_h = (
                     staging.split_trace_readback(
                         host_rb, n, cfg.dtype,
                         integrity=self._integrity != "off",
+                        convergence=self._monitor is not None,
                     )
                 )
                 stats_d = (
@@ -871,7 +930,10 @@ class PumiTally:
                     self.iter_count + 1, done_h=done_h, io=io,
                 )
                 if parts is not None:
-                    final_pos, final_mats, done_h, tail, integ = parts
+                    # The refreshed cold readback has no convergence
+                    # tail; the main dispatch's summary stands (the
+                    # re-walk's scores enter the NEXT batch's delta).
+                    final_pos, final_mats, done_h, tail, integ, _ = parts
             else:
                 dest = jnp.asarray(
                     self._gather_in(dest3_h), dtype=cfg.dtype
@@ -905,12 +967,21 @@ class PumiTally:
                         s.material_id,
                         flux_in,
                         **tkw,
+                        **ckw,
                     )
                     return r, self._read_stats(r)
 
                 result, stats_d = self._dispatch(
                     _step, self.iter_count + 1
                 )
+                conv_new = result.conv_state  # main dispatch (see above)
+                conv_h = None
+                if result.convergence is not None:
+                    # Legacy pipeline: the summary vector is its own
+                    # small fetch (this path is multi-transfer anyway).
+                    conv_h = np.asarray(result.convergence, np.float64)
+                    io["d2h_bytes"] += int(result.convergence.nbytes)
+                    io["d2h_transfers"] += 1
                 if result.stats is not None:
                     io["d2h_bytes"] += int(result.stats.nbytes)
                     io["d2h_transfers"] += 1
@@ -927,6 +998,8 @@ class PumiTally:
                     io["d2h_transfers"] += 1
                 done_h = None
             self.flux = result.flux
+            if self._monitor is not None:
+                self._conv = conv_new
             if self._prev_even is not None:
                 self.flux, self._prev_even = accumulate_batch_squares(
                     self.flux, self._prev_even
@@ -1030,6 +1103,19 @@ class PumiTally:
                 synced=cfg.measure_time,
                 **io,
             )
+        if self._monitor is not None and conv_h is not None:
+            # Fold the move's on-device convergence summary into the
+            # gauges / per-batch flight records; under "overlap" the
+            # host fold is deferred with the telemetry fold (drained at
+            # every read surface, including converged()).
+            fields = conv_to_dict(conv_h)
+            secs_total = self.tally_times.total_time_to_tally
+            if self._io == "overlap":
+                self._pending_folds.append(
+                    lambda: self._monitor.update(fields, secs_total)
+                )
+            else:
+                self._monitor.update(fields, secs_total)
 
     # ------------------------------------------------------------------ #
     def _store_xpoints(self, result) -> None:
@@ -1106,15 +1192,95 @@ class PumiTally:
             np.asarray(sigma, self.config.dtype),
         )
 
-    def write_pumi_tally_mesh(self, filename: str | None = None) -> str:
-        """Normalize flux, attach per-group cell fields + volume, write VTK
-        (finalizeAndWritePumiFlux, cpp:685-705), print phase times."""
+    # ------------------------------------------------------------------ #
+    # Statistical convergence (obs/convergence.py)
+    # ------------------------------------------------------------------ #
+    def _require_convergence(self):
+        if self._monitor is None:
+            raise ValueError(
+                "convergence observability is off: construct with "
+                "TallyConfig(convergence=True)"
+            )
+        return self._monitor
+
+    def _reset_convergence(self) -> None:
+        """Re-base the batch statistics on the CURRENT accumulator
+        (checkpoint restore / supervisor rollback — the persisted state
+        carries no batch history, so statistics restart from here).
+        Called via the utils/checkpoint apply hooks."""
+        if self._monitor is None:
+            return
         self._drain_pending()
+        self._conv = (
+            self.flux[0::2],
+            jnp.zeros_like(self._conv[1]),
+            jnp.zeros((), jnp.int32),
+            jnp.zeros((), jnp.int32),
+        )
+        self._monitor.reset()
+
+    def end_batch(self) -> dict:
+        """Close the current statistical batch NOW, regardless of the
+        ``batch_moves`` cadence (which restarts from here), fold it into
+        the batch accumulators on device, and return the refreshed
+        convergence summary — one tiny dispatch plus one [CONV_LEN]
+        fetch, an API call rather than a move-loop step."""
+        self._require_convergence()
+        from .obs.convergence import end_batch_fold
+
+        self._drain_pending()
+        self._conv, vec = end_batch_fold(
+            self.flux, *self._conv,
+            rel_err_target=self.config.rel_err_target,
+        )
+        return self._monitor.update(
+            conv_to_dict(np.asarray(vec, np.float64)),
+            self.tally_times.total_time_to_tally,
+        )
+
+    def converged(self) -> bool:
+        """Caller-driven early stop: True once at least 2 batches are
+        folded, and the fraction of scored bins with relative error at
+        or below ``rel_err_target`` has reached
+        ``converged_fraction``."""
+        self._require_convergence()
+        self._drain_pending()
+        return self._monitor.converged
+
+    def relative_error(self) -> np.ndarray:
+        """Per-bin [ntet, n_groups] float64 relative error from the
+        batch accumulators (the fused reduction's per-bin input,
+        materialized host-side — a cold-path fetch for VTK export and
+        analysis; unscored bins report 0, scored bins with < 2 batches
+        report 1)."""
+        self._require_convergence()
+        from .obs.convergence import host_relative_error
+
+        self._drain_pending()
+        snap, sumsq, nb, _ = self._conv
+        rel = host_relative_error(
+            jax.device_get(snap), jax.device_get(sumsq),
+            int(jax.device_get(nb)),
+        )
+        return rel.reshape(self.mesh.ntet, self.config.n_groups)
+
+    def write_pumi_tally_mesh(
+        self, filename: str | None = None, uncertainty: bool = False
+    ) -> str:
+        """Normalize flux, attach per-group cell fields + volume, write VTK
+        (finalizeAndWritePumiFlux, cpp:685-705), print phase times.
+        ``uncertainty=True`` additionally writes the per-group relative
+        error next to the flux (``rel_err_group_<g>`` cell fields —
+        requires convergence observability)."""
+        self._drain_pending()
+        rel = self.relative_error() if uncertainty else None
         with annotate("PumiTally.write_pumi_tally_mesh"), phase_timer(
             self.tally_times, "vtk_file_write_time", True
         ):
             out = filename or self.config.output_filename
-            write_flux_vtk(out, self.mesh, self.normalized_flux())
+            write_flux_vtk(
+                out, self.mesh, self.normalized_flux(), rel_err=rel
+            )
         self._telemetry.record_memory("vtk_write")
         self.tally_times.print_times()
         return out
@@ -1124,16 +1290,33 @@ class PumiTally:
         """Run-wide telemetry snapshot (obs/): counter totals
         (segments/crossings/truncations/chase hops), the per-move flight
         records, phase times (TallyTimes), a fresh per-device memory
-        sample, and the full metrics-registry snapshot. Per-record JSONL
-        streaming: set ``PUMI_TPU_METRICS=jsonl:/path``."""
+        sample, the convergence block, and the full metrics-registry
+        snapshot. Per-record JSONL streaming: set
+        ``PUMI_TPU_METRICS=jsonl:/path``."""
         self._drain_pending()
-        return self._telemetry.snapshot(times=self.tally_times)
+        out = self._telemetry.snapshot(times=self.tally_times)
+        out["convergence"] = (
+            self._monitor.snapshot()
+            if self._monitor is not None
+            else {"enabled": False}
+        )
+        return out
 
     @property
     def metrics(self):
         """This tally's MetricsRegistry (Prometheus text via
         ``tally.metrics.render_prometheus()``)."""
         return self._telemetry.registry
+
+    def close(self) -> None:
+        """Release facade-owned background resources: flush deferred
+        telemetry folds and stop the metrics scrape endpoint (frees the
+        port for the next tally).  Idempotent; a tally that is simply
+        dropped is cleaned up by the GC finalizer instead."""
+        self._drain_pending()
+        if self._exporter is not None:
+            self._exporter.stop()
+            self._exporter = None
 
     # ------------------------------------------------------------------ #
     def save_checkpoint(self, filename: str) -> None:

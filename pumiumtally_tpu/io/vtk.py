@@ -145,15 +145,31 @@ def write_flux_vtk(
     mesh,
     normalized_flux: np.ndarray,
     volumes: np.ndarray | None = None,
+    rel_err: np.ndarray | None = None,
 ) -> None:
     """Write the finalized tally in the reference's output layout: one
     'flux_group_<g>' cell field per energy group plus a 'volume' field
     (finalizeAndWritePumiFlux, cpp:685-705). The format follows the
-    extension: .vtu → XML UnstructuredGrid, .vtk → legacy ASCII."""
+    extension: .vtu → XML UnstructuredGrid, .vtk → legacy ASCII.
+
+    ``rel_err`` (the [ntet, n_groups] per-bin relative error from the
+    convergence accumulators — ``tally.relative_error()``) additionally
+    writes one 'rel_err_group_<g>' cell field next to each flux group,
+    so the uncertainty map rides the same file as the answer it
+    qualifies."""
     normalized_flux = np.asarray(normalized_flux)
     cell_data: dict[str, np.ndarray] = {}
     for g in range(normalized_flux.shape[1]):
         cell_data[f"flux_group_{g}"] = normalized_flux[:, g, 0]
+    if rel_err is not None:
+        rel_err = np.asarray(rel_err)
+        if rel_err.shape != normalized_flux.shape[:2]:
+            raise ValueError(
+                f"rel_err must be [ntet, n_groups] = "
+                f"{normalized_flux.shape[:2]}, got {rel_err.shape}"
+            )
+        for g in range(rel_err.shape[1]):
+            cell_data[f"rel_err_group_{g}"] = rel_err[:, g]
     cell_data["volume"] = (
         np.asarray(volumes)
         if volumes is not None

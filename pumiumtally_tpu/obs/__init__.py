@@ -12,8 +12,19 @@ Three layers (see each module's docstring):
 
 Env knobs: ``PUMI_TPU_METRICS=jsonl:/path`` streams every flight record
 to that file; ``PUMI_TPU_LOG_JSON=1`` renders the debug-level copies the
-recorder sends through the standard logger as JSON.
+recorder sends through the standard logger as JSON;
+``PUMI_TPU_PROM_PORT=<port>`` serves the registry's Prometheus text over
+HTTP on a daemon thread (``exporter`` — port 0 picks an ephemeral one).
 """
+from .convergence import (
+    CONV_FIELDS,
+    CONV_IDX,
+    CONV_LEN,
+    ConvergenceMonitor,
+    conv_to_dict,
+    reduce_chip_conv,
+)
+from .exporter import MetricsExporter, maybe_start_exporter
 from .recorder import FlightRecorder
 from .registry import (
     Counter,
@@ -39,9 +50,17 @@ __all__ = [
     "default_registry",
     "FlightRecorder",
     "TallyTelemetry",
+    "MetricsExporter",
+    "maybe_start_exporter",
     "WALK_STATS_FIELDS",
     "WALK_STATS_LEN",
     "IDX",
     "stats_to_dict",
     "reduce_chip_stats",
+    "CONV_FIELDS",
+    "CONV_LEN",
+    "CONV_IDX",
+    "ConvergenceMonitor",
+    "conv_to_dict",
+    "reduce_chip_conv",
 ]

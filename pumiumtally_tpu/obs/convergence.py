@@ -1,0 +1,378 @@
+"""Statistical convergence observability: on-device uncertainty
+reduction, batch statistics, and the ConvergenceMonitor.
+
+The reference accumulates per-segment squared contributions
+(cpp:640-641) but never turns the second moment into the quantities
+Monte Carlo practitioners actually steer runs by: per-element relative
+error, converged fraction, and figure of merit (PUMI-Tally,
+arXiv:2504.19048; exascale frameworks treat in-flight statistical
+diagnostics as a first-class subsystem, arXiv:2603.24508).  This module
+is that subsystem for both facades:
+
+  * **batch statistics** — the run is divided into *batches* (every
+    ``TallyConfig.batch_moves`` moves, or explicit ``tally.end_batch()``)
+    and the flux accumulator's per-bin batch totals ``T_b`` are folded
+    into device-resident accumulators ``S1 = Σ T_b`` and ``S2 = Σ T_b²``
+    so the relative error is a proper N-batch estimator:
+
+        R = sqrt((N·S2 − S1²)/(N − 1)) / S1        per scored bin
+
+    ``S1`` is exactly the even (Σc) flux entries at the last batch
+    boundary, so the state is (snapshot, Σ T², n_batches, move counter)
+    — two bin-sized arrays and two scalars.
+  * **on-device reduction** — ``fold_and_reduce`` runs INSIDE the walk
+    programs (ops/walk.py trace with ``conv_state``, ops/
+    walk_partitioned.py make_partitioned_step(convergence=True)): the
+    batch fold plus a [CONV_LEN] summary vector (scored-bin count,
+    Σ rel-err, max rel-err, converged-bin count) that rides the packed
+    readback tail — ZERO extra dispatches or transfers; the
+    steady-state 1 H2D + 1 D2H invariant of the I/O pipeline holds
+    with convergence on (pinned in tests/test_convergence.py).
+  * **ConvergenceMonitor** — folds the per-move summary into the gauge
+    families ``pumi_rel_err_max`` / ``pumi_rel_err_mean`` /
+    ``pumi_converged_fraction`` / ``pumi_fom``, emits one flight-recorder
+    record per completed batch, and answers ``tally.converged()`` for
+    caller-driven early stop.
+
+The reductions READ the accumulator and never write it: with
+``TallyConfig.convergence=False`` (the default) nothing here exists and
+outputs are bit-identical to a build without this module.
+
+Counts travel as walk-dtype floats through the readback tail (the
+integrity-vector encoding); above 2^24 scored bins an f32 count loses
+ulps — statistically irrelevant for a monitor, and the f64 path is
+exact.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Field order of the on-device convergence summary vector.  The single
+# source of truth for both walk kernels and the staging pack/split
+# (ops/staging.py appends CONV_LEN carrier words to the readback tail).
+CONV_FIELDS = (
+    # Completed batches N (replicated per chip on the partitioned walk).
+    "n_batches",
+    # Bins with a nonzero accumulated score (the rel-err denominator
+    # population; per-chip partials sum — every element is owned by
+    # exactly one chip and halo rows return zeroed).
+    "scored",
+    # Σ over scored bins of the per-bin relative error (host divides by
+    # ``scored`` for the mean; bins with N < 2 batches report rel-err 1,
+    # i.e. unconverged, so early gauges cannot read as converged).
+    "sum_rel_err",
+    # max over scored bins of the per-bin relative error.
+    "max_rel_err",
+    # Scored bins with rel-err <= TallyConfig.rel_err_target.
+    "converged",
+)
+
+CONV_LEN = len(CONV_FIELDS)
+
+CONV_IDX = {name: i for i, name in enumerate(CONV_FIELDS)}
+
+
+# --------------------------------------------------------------------- #
+# Traced reductions (run inside the walk programs and end_batch folds)
+# --------------------------------------------------------------------- #
+def conv_reduce(snap, sumsq, nb, rel_err_target):
+    """Per-bin relative error reduced to the [CONV_LEN] summary vector.
+
+    ``snap``/``sumsq`` are the batch accumulators with bins on the LAST
+    axis; ``nb`` is the completed-batch count with one fewer dimension
+    (scalar for a single chip / one shard, [n_parts] for assembled
+    slabs).  Returns [..., CONV_LEN] in ``snap.dtype``.
+
+    Bins with fewer than 2 batches have no variance estimate: scored
+    bins there report rel-err 1.0 (unconverged), unscored bins 0 and
+    are excluded everywhere.
+    """
+    dtype = snap.dtype
+    nbf = jnp.maximum(nb, 1).astype(dtype)[..., None]
+    scored = snap > 0
+    denom = jnp.maximum(nbf - 1.0, 1.0)
+    var_num = jnp.maximum(nbf * sumsq - snap * snap, 0.0)
+    rel = jnp.sqrt(var_num / denom) / jnp.where(scored, snap, 1.0)
+    defined = (nb >= 2)[..., None]
+    rel = jnp.where(scored, jnp.where(defined, rel, 1.0), 0.0)
+    n_scored = jnp.sum(scored, axis=-1)
+    n_conv = jnp.sum(
+        scored & defined & (rel <= rel_err_target), axis=-1
+    )
+    return jnp.stack(
+        [
+            nb.astype(dtype),
+            n_scored.astype(dtype),
+            jnp.sum(rel, axis=-1).astype(dtype),
+            jnp.max(rel, axis=-1).astype(dtype),
+            n_conv.astype(dtype),
+        ],
+        axis=-1,
+    )
+
+
+def fold_and_reduce(
+    flux,
+    snap,
+    sumsq,
+    nb,
+    mv,
+    *,
+    batch_moves: int,
+    rel_err_target: float,
+    enable=None,
+    force: bool = False,
+):
+    """One move's (or one explicit end_batch's) convergence step.
+
+    ``flux`` is the stride-2 accumulator with the interleaved (Σc, Σc²)
+    pairs on the LAST axis (flat single-chip vector, flat per-chip slab
+    inside shard_map, or [n_parts, 2L] assembled slabs); only the even
+    (Σc) entries are read — convergence therefore composes with
+    ``score_squares=False`` and ``sd_mode="batch"`` alike.
+
+    ``mv`` counts enabled moves since the last explicit batch end; a
+    batch completes when ``mv % batch_moves == 0`` (or always, with
+    ``force=True`` — the explicit ``end_batch()`` path, which also
+    resets the cadence counter).  ``enable`` gates the whole fold
+    (device-resident 0/1 scalar): the partitioned facade passes 0 for
+    initial-search and escalation re-walk dispatches so they never
+    advance the batch cadence.
+
+    Returns ``((snap', sumsq', nb', mv'), summary_vec)``.  The checks
+    read ``flux`` and never write it.
+    """
+    even = flux[..., 0::2]
+    if force:
+        mv_new = mv * 0
+        b_end = nb >= 0  # device-varying all-True in nb's shape
+    else:
+        en = (
+            jnp.int32(1)
+            if enable is None
+            else enable.astype(jnp.int32)
+        )
+        mv_new = mv + en
+        b_end = (en != 0) & (mv_new % batch_moves == 0)
+    gate = b_end[..., None] if even.ndim > b_end.ndim else b_end
+    delta = even - snap
+    sumsq = jnp.where(gate, sumsq + delta * delta, sumsq)
+    snap = jnp.where(gate, even, snap)
+    nb = nb + b_end.astype(nb.dtype)
+    return (snap, sumsq, nb, mv_new), conv_reduce(
+        snap, sumsq, nb, rel_err_target
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("rel_err_target",),
+    donate_argnames=("snap", "sumsq"),
+)
+def end_batch_fold(flux, snap, sumsq, nb, mv, *, rel_err_target):
+    """The explicit ``tally.end_batch()`` program: unconditionally close
+    the current batch (whatever the ``batch_moves`` cadence says), reset
+    the cadence counter, and return the fresh summary vector.  One tiny
+    dispatch + one [CONV_LEN] fetch — an API call, not the move loop."""
+    return fold_and_reduce(
+        flux, snap, sumsq, nb, mv,
+        batch_moves=1, rel_err_target=rel_err_target, force=True,
+    )
+
+
+# --------------------------------------------------------------------- #
+# Host-side views
+# --------------------------------------------------------------------- #
+def conv_to_dict(vec) -> dict:
+    """Named host view of one summary vector (single-chip facades)."""
+    v = np.asarray(vec, np.float64)
+    if v.shape != (CONV_LEN,):
+        raise ValueError(
+            f"expected a [{CONV_LEN}] convergence vector, got {v.shape}"
+        )
+    return {
+        "n_batches": int(v[CONV_IDX["n_batches"]]),
+        "scored": int(v[CONV_IDX["scored"]]),
+        "sum_rel_err": float(v[CONV_IDX["sum_rel_err"]]),
+        "max_rel_err": float(v[CONV_IDX["max_rel_err"]]),
+        "converged": int(v[CONV_IDX["converged"]]),
+    }
+
+
+def reduce_chip_conv(mat) -> dict:
+    """Aggregate per-chip [n_parts, CONV_LEN] partials into the run-level
+    dict: counts and sums add (each bin is owned by exactly one chip),
+    ``max_rel_err`` maxes, ``n_batches`` is replicated (max guards a
+    ragged read)."""
+    m = np.asarray(mat, np.float64)
+    if m.ndim != 2 or m.shape[1] != CONV_LEN:
+        raise ValueError(
+            f"expected [n_parts, {CONV_LEN}] chip partials, got {m.shape}"
+        )
+    return {
+        "n_batches": int(m[:, CONV_IDX["n_batches"]].max(initial=0)),
+        "scored": int(m[:, CONV_IDX["scored"]].sum()),
+        "sum_rel_err": float(m[:, CONV_IDX["sum_rel_err"]].sum()),
+        "max_rel_err": float(m[:, CONV_IDX["max_rel_err"]].max(initial=0)),
+        "converged": int(m[:, CONV_IDX["converged"]].sum()),
+    }
+
+
+def host_relative_error(snap, sumsq, nb: int) -> np.ndarray:
+    """Per-bin relative error on HOST float64 — the same estimator the
+    fused reduction computes, exposed for ``tally.relative_error()`` and
+    the VTK uncertainty export (and pinned against an independent NumPy
+    oracle in tests/test_convergence.py).  Unscored bins report 0;
+    scored bins with fewer than 2 batches report 1."""
+    s1 = np.asarray(snap, np.float64)
+    s2 = np.asarray(sumsq, np.float64)
+    n = int(nb)
+    scored = s1 > 0
+    if n < 2:
+        return np.where(scored, 1.0, 0.0)
+    var_num = np.maximum(n * s2 - s1 * s1, 0.0)
+    rel = np.sqrt(var_num / (n - 1)) / np.where(scored, s1, 1.0)
+    return np.where(scored, rel, 0.0)
+
+
+# --------------------------------------------------------------------- #
+# Monitor
+# --------------------------------------------------------------------- #
+class ConvergenceMonitor:
+    """Folds per-move convergence summaries into gauges, per-batch
+    flight records, and the ``converged()`` early-stop answer.
+
+    One instance per tally (like TallyTelemetry, which it feeds): the
+    gauge families land in the tally's private registry so the live
+    scrape endpoint (obs/exporter.py) and ``telemetry()`` see them
+    without any cross-tally interleaving.
+    """
+
+    def __init__(
+        self,
+        telemetry,
+        *,
+        rel_err_target: float,
+        converged_fraction: float,
+        batch_moves: int,
+    ):
+        self.telemetry = telemetry
+        self.rel_err_target = float(rel_err_target)
+        self.converged_fraction = float(converged_fraction)
+        self.batch_moves = int(batch_moves)
+        r = telemetry.registry
+        self._g_max = r.gauge(
+            "pumi_rel_err_max",
+            "max per-bin relative error over scored tally bins",
+        )
+        self._g_mean = r.gauge(
+            "pumi_rel_err_mean",
+            "mean per-bin relative error over scored tally bins",
+        )
+        self._g_frac = r.gauge(
+            "pumi_converged_fraction",
+            "fraction of scored tally bins with relative error at or "
+            "below TallyConfig.rel_err_target",
+        )
+        self._g_fom = r.gauge(
+            "pumi_fom",
+            "figure of merit 1/(rel_err_mean^2 * tally_seconds) — "
+            "constant once a run is variance-dominated",
+        )
+        self._c_batches = r.counter(
+            "pumi_batches_total",
+            "statistical batches completed (batch_moves cadence plus "
+            "explicit end_batch calls)",
+        )
+        self._last: dict = {}
+        self._batches_seen = 0
+
+    # ------------------------------------------------------------------ #
+    def update(self, fields: dict, seconds: float) -> dict:
+        """Fold one summary (conv_to_dict / reduce_chip_conv output).
+        ``seconds`` is the cumulative tally wall-clock driving the FOM.
+        Emits a flight-recorder record per COMPLETED batch (the per-move
+        cadence stays in the walk records)."""
+        nb = int(fields["n_batches"])
+        scored = int(fields["scored"])
+        mean = fields["sum_rel_err"] / scored if scored else 0.0
+        frac = fields["converged"] / scored if scored else 0.0
+        fom = (
+            1.0 / (mean * mean * seconds)
+            if mean > 0 and seconds > 0
+            else 0.0
+        )
+        self._g_max.set(float(fields["max_rel_err"]))
+        self._g_mean.set(mean)
+        self._g_frac.set(frac)
+        self._g_fom.set(fom)
+        self._last = {
+            "n_batches": nb,
+            "scored": scored,
+            "rel_err_mean": mean,
+            "rel_err_max": float(fields["max_rel_err"]),
+            "converged_fraction": frac,
+            "fom": fom,
+            "seconds": float(seconds),
+        }
+        if nb > self._batches_seen:
+            self._c_batches.inc(nb - self._batches_seen)
+            self._batches_seen = nb
+            self.telemetry.recorder.record(
+                "convergence",
+                batch=nb,
+                scored=scored,
+                rel_err_mean=round(mean, 9),
+                rel_err_max=round(float(fields["max_rel_err"]), 9),
+                converged_fraction=round(frac, 6),
+                fom=round(fom, 3),
+            )
+        return self._last
+
+    @property
+    def converged(self) -> bool:
+        """True once at least 2 batches exist, something scored, and the
+        converged fraction has reached ``converged_fraction``."""
+        d = self._last
+        return bool(
+            d
+            and d["n_batches"] >= 2
+            and d["scored"] > 0
+            and d["converged_fraction"] >= self.converged_fraction
+        )
+
+    def reset(self) -> None:
+        """Forget the statistical history (checkpoint restore / rollback
+        re-bases the batch accumulators — see the facades'
+        ``_reset_convergence``)."""
+        self._last = {}
+        self._batches_seen = 0
+        for g in (self._g_max, self._g_mean, self._g_frac, self._g_fom):
+            g.set(0.0)
+
+    def snapshot(self) -> dict:
+        """The ``telemetry()["convergence"]`` payload."""
+        out = {
+            "enabled": True,
+            "rel_err_target": self.rel_err_target,
+            "converged_fraction_target": self.converged_fraction,
+            "batch_moves": self.batch_moves,
+            "converged": self.converged,
+        }
+        out.update(
+            self._last
+            or {
+                "n_batches": 0,
+                "scored": 0,
+                "rel_err_mean": 0.0,
+                "rel_err_max": 0.0,
+                "converged_fraction": 0.0,
+                "fom": 0.0,
+                "seconds": 0.0,
+            }
+        )
+        return out

@@ -1,0 +1,114 @@
+"""Live Prometheus scrape endpoint for a MetricsRegistry.
+
+A multi-hour soak should be watchable without touching the JSONL metrics
+stream: this serves ``MetricsRegistry.render_prometheus()`` over plain
+HTTP (stdlib ``http.server`` on a daemon thread — no dependencies, dies
+with the process).  Endpoints:
+
+  * ``/metrics`` (and ``/``) — the registry's Prometheus text
+    exposition, content-type ``text/plain; version=0.0.4``;
+  * ``/healthz`` — ``ok`` (liveness for scrapers/orchestrators).
+
+Started by the facades (and, for wrapped tallies that did not start
+one, by ``ResilientRunner``) when ``PUMI_TPU_PROM_PORT`` is set; port 0
+binds an ephemeral port (``exporter.port`` reports the real one — the
+tests use this).  Binding is best-effort: a taken port logs one warning
+and the run continues — observability must never take a run down.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..utils.log import log_info, log_warn
+
+PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+ENV_PORT = "PUMI_TPU_PROM_PORT"
+
+
+class MetricsExporter:
+    """One HTTP server serving one registry's Prometheus text."""
+
+    def __init__(self, registry, port: int, host: str = "127.0.0.1"):
+        self.registry = registry
+        exporter = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 — http.server API
+                if self.path in ("/", "/metrics"):
+                    body = exporter.registry.render_prometheus().encode()
+                    ctype = PROM_CONTENT_TYPE
+                elif self.path == "/healthz":
+                    body, ctype = b"ok\n", "text/plain"
+                else:
+                    self.send_error(404)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # scrapes are not log events
+                pass
+
+        self._server = ThreadingHTTPServer((host, int(port)), _Handler)
+        self._server.daemon_threads = True
+        self._stopped = False
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name="pumi-metrics-exporter",
+            daemon=True,
+        )
+        self._thread.start()
+
+    @property
+    def port(self) -> int:
+        """The bound port (resolves port 0 to the ephemeral choice)."""
+        return self._server.server_address[1]
+
+    @property
+    def url(self) -> str:
+        host, port = self._server.server_address[:2]
+        return f"http://{host}:{port}/metrics"
+
+    def stop(self) -> None:
+        """Shut the server down and release the socket (idempotent —
+        called from facade close() AND the facade's GC finalizer)."""
+        if self._stopped:
+            return
+        self._stopped = True
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout=5)
+
+
+def maybe_start_exporter(registry, port=None):
+    """Start an exporter when configured, else None.
+
+    ``port`` defaults to the ``PUMI_TPU_PROM_PORT`` env var (unset →
+    no exporter, zero cost).  Bind failures warn and return None."""
+    if port is None:
+        spec = os.environ.get(ENV_PORT, "").strip()
+        if not spec:
+            return None
+        try:
+            port = int(spec)
+        except ValueError:
+            log_warn(
+                f"{ENV_PORT}={spec!r} is not a port number; "
+                "metrics endpoint disabled"
+            )
+            return None
+    try:
+        exp = MetricsExporter(registry, port)
+    except OSError as e:
+        log_warn(
+            f"metrics endpoint could not bind port {port} ({e}); "
+            "continuing without it"
+        )
+        return None
+    log_info(f"metrics endpoint serving at {exp.url}")
+    return exp

@@ -11,6 +11,7 @@ its whole per-move history on disk, not just whatever the ring held.
 from __future__ import annotations
 
 import collections
+import threading
 
 from ..utils.log import emit_metric
 
@@ -23,26 +24,37 @@ class FlightRecorder:
             maxlen=capacity
         )
         self._seq = 0
+        # Writers are not single-threaded: the integrity watchdog
+        # dispatches from a worker thread and the Prometheus exporter
+        # reads concurrently, so sequencing + the ring append happen
+        # under a lock (an unlocked _seq increment can duplicate or
+        # skip sequence numbers under interleaving).
+        self._lock = threading.Lock()
         # None defers to PUMI_TPU_METRICS at record time (env can change
         # between moves, e.g. under pytest monkeypatch).
         self._sink = sink
 
     def record(self, kind: str, **fields) -> dict:
         """Append one record; ``kind`` names the event ("move",
-        "initial_search", "memory", ...). Returns the stored record."""
-        rec = {"seq": self._seq, "kind": str(kind), **fields}
-        self._seq += 1
-        self._records.append(rec)
+        "initial_search", "memory", ...). Returns the stored record.
+        Thread-safe: concurrent recorders get unique, gap-free
+        sequence numbers."""
+        with self._lock:
+            rec = {"seq": self._seq, "kind": str(kind), **fields}
+            self._seq += 1
+            self._records.append(rec)
         emit_metric(rec, path=self._sink)
         return rec
 
     def records(self) -> list[dict]:
-        return list(self._records)
+        with self._lock:
+            return list(self._records)
 
     def tail(self, n: int) -> list[dict]:
         if n <= 0:
             return []
-        return list(self._records)[-n:]
+        with self._lock:
+            return list(self._records)[-n:]
 
     def __len__(self) -> int:
         return len(self._records)

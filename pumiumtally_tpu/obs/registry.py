@@ -163,6 +163,17 @@ class MetricsRegistry:
                     f"metric {name!r} already registered as {m.kind}, "
                     f"requested {cls.kind}"
                 )
+            elif help and m.help and help != m.help:
+                # Same name re-registered with a DIFFERENT meaning is the
+                # cross-family drift the metrics lint exists to catch —
+                # refuse instead of silently serving one family's help
+                # text for the other's observations. (Re-registering
+                # with the identical help, or looking a metric up with
+                # no help, stays a create-or-get.)
+                raise ValueError(
+                    f"metric {name!r} already registered with help "
+                    f"{m.help!r}; conflicting help {help!r}"
+                )
             return m
 
     def counter(self, name: str, help: str = "") -> Counter:
@@ -175,16 +186,24 @@ class MetricsRegistry:
                   buckets=DEFAULT_BUCKETS) -> Histogram:
         return self._get(Histogram, name, help, buckets=buckets)
 
+    def _families(self) -> dict:
+        """Stable copy of the family table: readers (snapshot, the
+        exporter's scrape thread) iterate the copy, never the live dict
+        — lazy mid-run registration (e.g. the fault counters on first
+        injection) would otherwise mutate it under a concurrent scrape."""
+        with self._lock:
+            return dict(self._metrics)
+
     def snapshot(self) -> dict:
         """{name: {type, help, series: [{labels, value}, ...]}} — the
         structured view ``telemetry()`` and the bench JSON embed."""
-        return {name: m.snapshot() for name, m in self._metrics.items()}
+        return {name: m.snapshot() for name, m in self._families().items()}
 
     def render_prometheus(self) -> str:
         """Prometheus text exposition (content-type
         ``text/plain; version=0.0.4``) of every registered series."""
         lines: list[str] = []
-        for name, m in sorted(self._metrics.items()):
+        for name, m in sorted(self._families().items()):
             if m.help:
                 lines.append(f"# HELP {name} {m.help}")
             lines.append(f"# TYPE {name} {m.kind}")

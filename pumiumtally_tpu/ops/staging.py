@@ -252,16 +252,19 @@ def unpack_move_record(rec, dtype, perm, initial: bool):
 
 
 def pack_trace_readback(position, material_id, done, stats, n_segments,
-                        perm, integrity=None):
+                        perm, integrity=None, convergence=None):
     """Device-side (traced) readback pack: [n, READBACK_COLS] slot
     record scattered back into host pid order (the inverse of the
     unpack's perm gather), flattened, with the walk-stats vector — or,
     when walk stats are off, the scalar segment count — appended as an
-    int64-encoded tail, and the integrity-invariant vector
+    int64-encoded tail, the integrity-invariant vector
     (integrity/invariants.py; walk-dtype floats bitcast into carrier
-    words) appended after that when self-verification is on.  ONE
-    ``device_get`` then carries everything the facade needs per move —
-    the invariants cost zero extra transfers."""
+    words) appended after that when self-verification is on, and the
+    convergence summary vector (obs/convergence.py CONV_FIELDS, same
+    float encoding) appended LAST when convergence observability is on.
+    ONE ``device_get`` then carries everything the facade needs per
+    move — the invariants and the uncertainty reduction cost zero extra
+    transfers."""
     carrier = _jnp_carrier(position.dtype)
     slot = jnp.concatenate(
         [
@@ -278,6 +281,10 @@ def pack_trace_readback(position, material_id, done, stats, n_segments,
     parts = [slot.reshape(-1), tail]
     if integrity is not None:
         parts.append(_enc_f_dev(integrity.astype(position.dtype), carrier))
+    if convergence is not None:
+        parts.append(
+            _enc_f_dev(convergence.astype(position.dtype), carrier)
+        )
     return jnp.concatenate(parts)
 
 
@@ -287,25 +294,36 @@ _pack_trace_readback_jit = jax.jit(pack_trace_readback)
 def pack_trace_readback_cold(result, perm):
     """Standalone jitted readback pack for cold paths (truncation
     escalation re-walks produce a merged TraceResult outside the packed
-    step)."""
+    step).  Re-walk merges carry no convergence vector (the batch fold
+    belongs to the move's main dispatch only), so the cold record never
+    has a convergence tail — split it with convergence=False."""
     return _pack_trace_readback_jit(
         result.position, result.material_id, result.done, result.stats,
-        result.n_segments, perm, result.integrity,
+        result.n_segments, perm, result.integrity, None,
     )
 
 
-def split_trace_readback(host_rec, n: int, dtype, integrity: bool = False):
+def split_trace_readback(host_rec, n: int, dtype, integrity: bool = False,
+                         convergence: bool = False):
     """Host-side inverse of pack_trace_readback.  Returns
     ``(position [n,3] walk-dtype, material_id [n] int32, done [n] bool,
-    tail int64 array, integrity float64 vector or None)`` where ``tail``
-    is the stats vector (walk stats on) or ``[n_segments]`` (off)."""
+    tail int64 array, integrity float64 vector or None, convergence
+    float64 vector or None)`` where ``tail`` is the stats vector (walk
+    stats on) or ``[n_segments]`` (off)."""
     npdt = np.dtype(dtype)
     slot = host_rec[: n * READBACK_COLS].reshape(n, READBACK_COLS)
     position = _dec_f_host(slot[:, 0:3], npdt)
     material_id = _dec_i32_host(slot[:, 3], np_carrier(npdt))
     done = slot[:, 4] != 0
-    integ = None
+    integ = conv = None
     tail_words = host_rec[n * READBACK_COLS:]
+    if convergence:
+        from ..obs.convergence import CONV_LEN
+
+        conv = _dec_f_host(
+            tail_words[-CONV_LEN:], npdt
+        ).astype(np.float64)
+        tail_words = tail_words[:-CONV_LEN]
     if integrity:
         from ..integrity.invariants import INTEGRITY_LEN
 
@@ -314,7 +332,7 @@ def split_trace_readback(host_rec, n: int, dtype, integrity: bool = False):
         ).astype(np.float64)
         tail_words = tail_words[:-INTEGRITY_LEN]
     tail = _dec_i64_host(tail_words)
-    return position, material_id, done, tail, integ
+    return position, material_id, done, tail, integ, conv
 
 
 # --------------------------------------------------------------------- #
@@ -423,11 +441,23 @@ def pack_partitioned_readback(res, n_parts: int):
         cols.append(_widen_counts(res.integrity))
     tail_i64 = jnp.concatenate(cols, axis=1)
     tail = _enc_i64_tail_dev(tail_i64, carrier)
-    return jnp.concatenate([slot, tail], axis=1)
+    parts = [slot, tail]
+    if res.convergence is not None:
+        # Per-chip convergence partials (obs/convergence.py CONV_FIELDS)
+        # travel as walk-dtype floats bitcast into carrier words,
+        # appended AFTER the int64 tail — the uncertainty reduction adds
+        # zero transfers on the partitioned facade too.
+        parts.append(
+            _enc_f_dev(
+                res.convergence.astype(res.position.dtype), carrier
+            )
+        )
+    return jnp.concatenate(parts, axis=1)
 
 
 def split_partitioned_readback(host_rec, n_parts: int, cap: int,
-                               dtype, integrity: bool = False) -> dict:
+                               dtype, integrity: bool = False,
+                               convergence: bool = False) -> dict:
     """Host-side inverse of pack_partitioned_readback.  ``cap`` is the
     facade's per-chip slot count; the round-stats bound R is recovered
     from the remaining tail width."""
@@ -436,6 +466,17 @@ def split_partitioned_readback(host_rec, n_parts: int, cap: int,
     from ..integrity.invariants import PART_INTEGRITY_LEN
     from ..obs import WALK_STATS_LEN
 
+    conv = None
+    if convergence:
+        from ..obs.convergence import CONV_LEN
+
+        # The convergence partials are the LAST CONV_LEN carrier words
+        # of each row (appended after the int64 tail) — strip them
+        # before the int64 decode below.
+        conv = _dec_f_host(host_rec[:, -CONV_LEN:], npdt).astype(
+            np.float64
+        )
+        host_rec = host_rec[:, :-CONV_LEN]
     ilen = PART_INTEGRITY_LEN if integrity else 0
     w = tail_words_per_i64(carrier.itemsize)
     width = host_rec.shape[1]
@@ -477,6 +518,8 @@ def split_partitioned_readback(host_rec, n_parts: int, cap: int,
     if integrity:
         base = WALK_STATS_LEN + 6 * R + 3
         out["integrity"] = tail_i64[:, base: base + ilen]
+    if conv is not None:
+        out["convergence"] = conv
     return out
 
 

@@ -350,6 +350,17 @@ class TraceResult(NamedTuple):
     # dispatches or transfers (the packed pipeline appends it to the
     # readback tail). None with integrity=False.
     integrity: jax.Array | None = None
+    # [CONV_LEN] convergence summary vector (obs/convergence.py
+    # CONV_FIELDS: batches, scored bins, Σ/max rel-err, converged bins),
+    # computed from the batch accumulators passed as ``conv_state`` —
+    # the statistical-convergence analog of the integrity tail, riding
+    # the same packed readback at zero extra transfers. None unless
+    # conv_state was supplied.
+    convergence: jax.Array | None = None
+    # Updated (snapshot, Σbatch², n_batches, move counter) batch
+    # accumulators (donated through; the facade re-binds them each
+    # move). None unless conv_state was supplied.
+    conv_state: tuple | None = None
 
 
 def resolve_tally_scatter(
@@ -410,6 +421,9 @@ def trace_impl(
     debug_checks: bool = False,
     record_xpoints: int | None = None,
     n_groups: int | None = None,
+    conv_state: tuple | None = None,
+    rel_err_target: float = 0.05,
+    batch_moves: int = 1,
 ) -> TraceResult:
     """Advance all particles from origin to dest through the mesh.
 
@@ -519,6 +533,21 @@ def trace_impl(
         straggler gather/scatter-back like all other per-particle state,
         so the production config can record too. The hot path pays
         nothing when the flag is off.
+      conv_state: statistical-convergence batch accumulators
+        ``(snapshot, Σbatch², n_batches, move_counter)``
+        (obs/convergence.py; the facades own them, device-resident and
+        donated).  When supplied on a non-initial trace the program
+        appends the batch fold — close the current batch every
+        ``batch_moves`` enabled moves — and the [CONV_LEN] rel-err
+        summary reduction AFTER the walk: the reductions read the flux
+        and never write it, so tally outputs are bit-identical with the
+        feature on or off, and the packed pipeline carries the summary
+        in the existing readback tail (zero extra transfers).  None
+        (default): no convergence machinery is traced at all.
+      rel_err_target: per-bin relative-error threshold for the
+        converged-bin count (static; only read with conv_state).
+      batch_moves: moves per statistical batch (static; only read with
+        conv_state).
       debug_checks: thread `checkify` device assertions through the walk
         body — the functional analog of the reference's
         OMEGA_H_CHECK_PRINTF kernel asserts (finite intersection points
@@ -1157,6 +1186,24 @@ def trace_impl(
             nseg,
             it.astype(sd_t),
         ])
+    conv_vec = conv_out = None
+    if conv_state is not None:
+        # Statistical-convergence fold + summary (obs/convergence.py):
+        # reads the flat flux's even (Σc) entries only, after all
+        # scoring — never writes the accumulator, so the tally output
+        # is bit-identical with or without it.
+        if initial:
+            raise ValueError(
+                "conv_state is a move-loop feature: the initial "
+                "location search scores nothing and must not advance "
+                "the batch cadence"
+            )
+        from ..obs.convergence import fold_and_reduce
+
+        conv_out, conv_vec = fold_and_reduce(
+            flux, *conv_state,
+            batch_moves=batch_moves, rel_err_target=rel_err_target,
+        )
     return TraceResult(
         position=cur,
         elem=elem,
@@ -1170,6 +1217,8 @@ def trace_impl(
         track_length=pseg if ledger else None,
         stats=stats_vec,
         integrity=integ_vec,
+        convergence=conv_vec,
+        conv_state=conv_out,
     )
 
 
@@ -1239,8 +1288,12 @@ _trace_jit = jax.jit(
         "debug_checks",
         "record_xpoints",
         "n_groups",
+        "rel_err_target",
+        "batch_moves",
     ),
-    donate_argnames=("flux",),
+    # conv_state's batch accumulators are carried exactly like the flux:
+    # donated in, fresh buffers out (None → no leaves, no donation).
+    donate_argnames=("flux", "conv_state"),
 )
 
 
@@ -1264,6 +1317,7 @@ def trace_packed_impl(
     perm=None,
     weight=None,
     group=None,
+    conv_state=None,
     **kwargs,
 ):
     """The fused packed-I/O step: device-side record unpack (with the
@@ -1293,11 +1347,11 @@ def trace_packed_impl(
         w, g = weight, group
     r = trace_impl(
         mesh, origin, dest, elem, in_flight, w, g, material_id, flux,
-        **kwargs,
+        conv_state=conv_state, **kwargs,
     )
     readback = pack_trace_readback(
         r.position, r.material_id, r.done, r.stats, r.n_segments, perm,
-        r.integrity,
+        r.integrity, r.convergence,
     )
     return r, readback, dest, in_flight, w, g
 
@@ -1322,14 +1376,17 @@ _trace_packed_jit = jax.jit(
         "debug_checks",
         "record_xpoints",
         "n_groups",
+        "rel_err_target",
+        "batch_moves",
     ),
     # The flux carry is donated exactly like the unpacked trace — a
     # supervisor retry re-sees its original inputs because the facade
     # re-packs the staging record from the caller's untouched host
-    # arrays (PR 2's re-arm contract).  The record itself is NOT
-    # donated: no output shares its carrier shape, so XLA would only
-    # warn.
-    donate_argnames=("flux",),
+    # arrays (PR 2's re-arm contract).  The convergence batch
+    # accumulators ride the same contract (None → no leaves).  The
+    # record itself is NOT donated: no output shares its carrier shape,
+    # so XLA would only warn.
+    donate_argnames=("flux", "conv_state"),
 )
 
 _PACKED_FLUX_ARG_INDEX = list(

@@ -168,6 +168,19 @@ class PartitionedTraceResult(NamedTuple):
     # HOST-side by the facade from the migrating track-length ledger —
     # per-lane and cut-aware, strictly stronger than a chip-local sum.
     integrity: jax.Array | None = None
+    # Statistical-convergence surface, present with
+    # make_partitioned_step(convergence=True) (obs/convergence.py):
+    # [n_parts, CONV_LEN] per-chip summary partials over each chip's
+    # OWNED bins (halo rows return zeroed, so the partials sum exactly
+    # to the global reduction), plus the updated batch accumulators —
+    # per-chip snapshot/Σbatch² slabs [n_parts, max_local*n_groups] and
+    # the replicated-per-chip batch/move counters [n_parts].  The
+    # reductions read the flux slabs and never write them.
+    convergence: jax.Array | None = None
+    conv_snap: jax.Array | None = None
+    conv_sumsq: jax.Array | None = None
+    conv_nb: jax.Array | None = None
+    conv_mv: jax.Array | None = None
 
 
 def _walk_phase(
@@ -564,6 +577,9 @@ def make_partitioned_step(
     record_xpoints: int | None = None,
     packed_io: bool = False,
     integrity: bool = False,
+    convergence: bool = False,
+    rel_err_target: float = 0.05,
+    batch_moves: int = 1,
 ):
     """Build the jitted distributed trace step for one mesh partition.
 
@@ -617,9 +633,24 @@ def make_partitioned_step(
         lane-conservation check. End-of-step reductions only — the
         packed readback carries them in its existing int64 tail, so
         the one-H2D/one-D2H invariant of PR 3 is untouched.
+      convergence: fold the statistical-convergence batch accumulators
+        and the per-chip uncertainty reduction into the program
+        (obs/convergence.py; PartitionedTraceResult.convergence +
+        conv_* fields).  The step then takes FIVE extra trailing
+        per-chip arrays — snapshot and Σbatch² slabs
+        [n_parts, max_local*n_groups], batch and move counters
+        [n_parts], and an int enable gate [n_parts] (0 suppresses the
+        fold entirely: the facade passes 0 for initial-search and
+        escalation re-walk dispatches so they never advance the batch
+        cadence).  End-of-step elementwise passes + reductions over
+        arrays already resident — the packed readback appends CONV_LEN
+        carrier words per chip, so the one-H2D/one-D2H invariant still
+        holds.  ``rel_err_target`` / ``batch_moves`` are the static
+        knobs of the reduction.
 
     Returns step(cur, dest, elem, done, material, weight, group, pid, valid,
-    flux) -> PartitionedTraceResult, where per-particle arrays are
+    flux[, conv]) -> PartitionedTraceResult (``conv`` is the 5-tuple
+    above, required iff convergence=True), where per-particle arrays are
     [n_parts * cap] sharded over the device axis and flux is
     [n_parts, max_local, n_groups, 2] — or FLAT [n_parts,
     max_local*n_groups*2], the TPU production layout (the 3-D slab pads
@@ -686,8 +717,12 @@ def make_partitioned_step(
         if has_halo:
             (row_owner_t, row_owner_local_t, halo_send_t, halo_recv_t,
              n_owned_t) = args[6:11]
+        tail_args = args[6 + len(halo_tables):]
         (cur, dest, elem, done, material_id, weight, group, pid, valid,
-         flux) = args[6 + len(halo_tables):]
+         flux) = tail_args[:10]
+        if convergence:
+            (conv_snap_t, conv_sumsq_t, conv_nb_t, conv_mv_t,
+             conv_en_t) = tail_args[10:]
         # Per-chip blocks arrive with a leading axis of 1; squeeze it.
         tables_l = (
             normals_t[0], faced_t[0], enc_t[0], class_t[0], nbrclass_t[0],
@@ -1032,6 +1067,25 @@ def make_partitioned_step(
                 jnp.sum(valid & done).astype(sd_t),
             ])
 
+        cvec = cs = css = cnb = cmv = None
+        if convergence:
+            # Statistical-convergence fold + per-chip summary partials
+            # (obs/convergence.py): runs AFTER the halo fold, so the
+            # even (Σc) entries read here are the chip's complete owned
+            # scores for this move (halo rows are already zeroed — they
+            # never count as scored bins).  Reads the slab, never
+            # writes it.
+            from ..obs.convergence import fold_and_reduce
+
+            (cs, css, cnb, cmv), cvec = fold_and_reduce(
+                flux_l.reshape(-1),
+                conv_snap_t[0], conv_sumsq_t[0], conv_nb_t[0],
+                conv_mv_t[0],
+                batch_moves=batch_moves,
+                rel_err_target=rel_err_target,
+                enable=conv_en_t[0],
+            )
+
         return PartitionedTraceResult(
             position=cur,
             dest=dest,
@@ -1052,14 +1106,22 @@ def make_partitioned_step(
             n_xpoints=xpk[1] if xpk else None,
             stats=svec[None],
             integrity=None if ivec is None else ivec[None],
+            convergence=None if cvec is None else cvec[None],
+            conv_snap=None if cs is None else cs[None],
+            conv_sumsq=None if css is None else css[None],
+            conv_nb=None if cnb is None else cnb[None],
+            conv_mv=None if cmv is None else cmv[None],
         )
 
     table_specs = tuple(P(AXIS) for _ in (*tables, *halo_tables))
     particle_spec = P(AXIS)
+    conv_specs = (P(AXIS),) * 5 if convergence else ()
+    conv_out_spec = P(AXIS) if convergence else None
     mapped = shard_map(
         shard_body,
         mesh=device_mesh,
-        in_specs=table_specs + (particle_spec,) * 9 + (P(AXIS),),
+        in_specs=table_specs + (particle_spec,) * 9 + (P(AXIS),)
+        + conv_specs,
         out_specs=PartitionedTraceResult(
             position=particle_spec,
             dest=particle_spec,
@@ -1082,6 +1144,11 @@ def make_partitioned_step(
             ),
             stats=P(AXIS),
             integrity=P(AXIS) if integrity else None,
+            convergence=conv_out_spec,
+            conv_snap=conv_out_spec,
+            conv_sumsq=conv_out_spec,
+            conv_nb=conv_out_spec,
+            conv_mv=conv_out_spec,
         ),
     )
     if packed_io:
@@ -1095,12 +1162,18 @@ def make_partitioned_step(
             unpack_partitioned_record,
         )
 
-        def packed_impl(record, flux):
+        def packed_impl(record, flux, conv_snap=None, conv_sumsq=None,
+                        conv_nb=None, conv_mv=None, conv_enable=None):
             (cur, dest, elem, done, material_id, weight, group, pid,
              valid) = unpack_partitioned_record(record)
+            extra = (
+                (conv_snap, conv_sumsq, conv_nb, conv_mv, conv_enable)
+                if convergence
+                else ()
+            )
             res = mapped(
                 *tables, *halo_tables, cur, dest, elem, done,
-                material_id, weight, group, pid, valid, flux,
+                material_id, weight, group, pid, valid, flux, *extra,
             )
             return res._replace(
                 readback=pack_partitioned_readback(res, n_parts)
@@ -1109,19 +1182,38 @@ def make_partitioned_step(
         # Donate the flux slab exactly like the unpacked step; a
         # supervisor retry re-sees its original inputs because the
         # facade re-packs the staging record from the caller's
-        # untouched host arrays (PR 2's re-arm contract).  The record
+        # untouched host arrays (PR 2's re-arm contract).  The
+        # convergence snapshot/Σbatch² slabs carry the same way (the
+        # counters and the reusable enable gate are NOT donated — the
+        # facade passes the same enable array every move).  The record
         # is not donated — no output shares its carrier shape.
-        return jax.jit(packed_impl, donate_argnums=(1,))
+        return jax.jit(
+            packed_impl,
+            donate_argnames=("flux", "conv_snap", "conv_sumsq"),
+        )
 
+    flux_ix = 6 + len(halo_tables) + 9
     jitted = jax.jit(
-        mapped, donate_argnums=(6 + len(halo_tables) + 9,)  # the flux slab
+        mapped,
+        # The flux slab, plus (with convergence) the snapshot/Σbatch²
+        # slabs that immediately follow it.
+        donate_argnums=(flux_ix,)
+        + ((flux_ix + 1, flux_ix + 2) if convergence else ()),
     )
 
     def step(cur, dest, elem, done, material_id, weight, group, pid, valid,
-             flux):
+             flux, conv=None):
+        extra = ()
+        if convergence:
+            if conv is None:
+                raise ValueError(
+                    "this step was built with convergence=True and "
+                    "needs the (snap, sumsq, nb, mv, enable) tuple"
+                )
+            extra = tuple(conv)
         return jitted(
             *tables, *halo_tables, cur, dest, elem, done, material_id,
-            weight, group, pid, valid, flux,
+            weight, group, pid, valid, flux, *extra,
         )
 
     return step

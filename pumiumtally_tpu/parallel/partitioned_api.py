@@ -41,8 +41,11 @@ import warnings
 from ..api import _check_group_range, _out_param
 from ..obs import (
     IDX,
+    ConvergenceMonitor,
     TallyTelemetry,
     WALK_STATS_FIELDS,
+    maybe_start_exporter,
+    reduce_chip_conv,
     reduce_chip_stats,
 )
 from ..ops import staging
@@ -184,6 +187,9 @@ class PartitionedTally:
             exchange_size=exchange_size,
             max_rounds=max_rounds,
             integrity=self.config.resolve_integrity() != "off",
+            convergence=self.config.resolve_convergence() is not None,
+            rel_err_target=self.config.rel_err_target,
+            batch_moves=self.config.resolve_convergence() or 1,
         )
         self._steps: dict = {}
         # Move-loop I/O pipelining (ops/staging.py; PumiTally mirror):
@@ -280,8 +286,49 @@ class PartitionedTally:
             and self.config.score_squares
             else None
         )
+        # Statistical-convergence observability (obs/convergence.py):
+        # per-chip batch accumulators sharded like the flux slabs, the
+        # replicated-per-chip counters, and the device-resident enable
+        # gates (ones for main move dispatches, zeros for initial /
+        # escalation dispatches — created ONCE, so steady-state moves
+        # stage nothing extra).
+        self._batch_moves = self.config.resolve_convergence()
+        self._monitor = None
+        self._conv = None
+        if self._batch_moves is not None:
+            sh = NamedSharding(device_mesh, P(AXIS))
+            L = self.partition.max_local * self.config.n_groups
+            self._conv = (
+                jax.device_put(
+                    jnp.zeros((self.n_parts, L), self.config.dtype), sh
+                ),
+                jax.device_put(
+                    jnp.zeros((self.n_parts, L), self.config.dtype), sh
+                ),
+                jax.device_put(jnp.zeros(self.n_parts, jnp.int32), sh),
+                jax.device_put(jnp.zeros(self.n_parts, jnp.int32), sh),
+            )
+            self._conv_on = jax.device_put(
+                jnp.ones(self.n_parts, jnp.int32), sh
+            )
+            self._conv_off = jax.device_put(
+                jnp.zeros(self.n_parts, jnp.int32), sh
+            )
+            self._monitor = ConvergenceMonitor(
+                self._telemetry,
+                rel_err_target=self.config.rel_err_target,
+                converged_fraction=self.config.converged_fraction,
+                batch_moves=self._batch_moves,
+            )
         # Phase-boundary memory sample (tables + flux slabs are placed).
         self._telemetry.record_memory("initialization")
+        # Live scrape endpoint (obs/exporter.py; PUMI_TPU_PROM_PORT).
+        # Stopped by close(); the GC finalizer covers dropped tallies.
+        self._exporter = maybe_start_exporter(self.metrics)
+        if self._exporter is not None:
+            import weakref
+
+            weakref.finalize(self, self._exporter.stop)
 
     # ------------------------------------------------------------------ #
     def _check_finite(self, name: str, arr: np.ndarray) -> None:
@@ -514,6 +561,7 @@ class PartitionedTally:
         kind = "initial_search" if initial else "move"
         move_no = self.iter_count + (0 if initial else 1)
         agg = stats.pop("agg")
+        conv_dev = stats.pop("conv_dev", None)
         seconds = getattr(self.tally_times, field) - t_before
         if self._io == "overlap" and not initial:
             # Defer the fold so this move's bookkeeping overlaps the
@@ -533,6 +581,18 @@ class PartitionedTally:
                 synced=self.config.measure_time,
                 **stats,
             )
+        if self._monitor is not None and not initial and conv_dev is not None:
+            # Reduce the per-chip convergence partials and feed the
+            # monitor; deferred with the other host folds under
+            # "overlap" (drained at every read surface).
+            fields = reduce_chip_conv(conv_dev)
+            secs_total = self.tally_times.total_time_to_tally
+            if self._io == "overlap":
+                self._pending_folds.append(
+                    lambda: self._monitor.update(fields, secs_total)
+                )
+            else:
+                self._monitor.update(fields, secs_total)
         return got, moving
 
     def _run_inner(self, dest, in_flight, weight, group, initial):
@@ -569,8 +629,10 @@ class PartitionedTally:
             trunc = np.zeros(n, bool)
             trunc[np.nonzero(moving)[0][sub_trunc]] = True
             n_re += int(trunc.sum())
+            # first=False: escalation re-walks never advance the batch
+            # cadence (their scores enter the next closed batch).
             got2, stats2 = self._walk_once(
-                dest, trunc, weight, group, initial
+                dest, trunc, weight, group, initial, first=False
             )
             _merge_got(got, sub_trunc, got2)
             stats["agg"] = _merge_agg(stats["agg"], stats2["agg"])
@@ -631,13 +693,24 @@ class PartitionedTally:
             self._maybe_inject_bitflip(move)
         return got, moving, stats
 
-    def _walk_once(self, dest, moving, weight, group, initial):
+    def _conv_in(self, initial: bool, first: bool):
+        """The step's convergence 5-tuple (or None when the feature is
+        off). The enable gate is 0 for initial-search and escalation
+        re-walk dispatches: they must not advance the batch cadence —
+        their scores are picked up by the next closed batch's delta."""
+        if self._conv is None:
+            return None
+        gate = self._conv_on if (first and not initial) else self._conv_off
+        return (*self._conv, gate)
+
+    def _walk_once(self, dest, moving, weight, group, initial,
+                   first=True):
         """One distribute → partitioned step → collect/fold pass over
         the ``moving`` subset (the pre-escalation ``_run_inner`` body).
         Dispatches to the packed pipeline unless io_pipeline="legacy"."""
         if self._io != "legacy":
             return self._walk_once_packed(
-                dest, moving, weight, group, initial
+                dest, moving, weight, group, initial, first
             )
         placed = distribute_particles(
             self.partition,
@@ -655,6 +728,7 @@ class PartitionedTally:
         flux_in = self.flux_slabs  # bound pre-closure: an abandoned
         # watchdog worker must consume the stale buffer, never the
         # restored live slabs (PumiTally._dispatch contract).
+        conv_in = self._conv_in(initial, first)
 
         def _go():
             res = self._step(initial)(
@@ -668,6 +742,7 @@ class PartitionedTally:
                 placed["particle_id"],
                 placed["valid"],
                 flux_in,
+                conv_in,
             )
             # The collect's np.asarray fetches are the blocking reads,
             # so they belong inside the watchdog-supervised closure
@@ -680,6 +755,10 @@ class PartitionedTally:
             _go, self.iter_count + (0 if initial else 1)
         )
         self.flux_slabs = res.flux
+        if self._conv is not None:
+            self._conv = (
+                res.conv_snap, res.conv_sumsq, res.conv_nb, res.conv_mv
+            )
         n_dropped = int(np.asarray(res.n_dropped).sum())
         if n_dropped != 0:
             raise RuntimeError(
@@ -706,6 +785,8 @@ class PartitionedTally:
         ] + ([res.xpoints, res.n_xpoints] if res.xpoints is not None
              else []) + (
             [res.integrity] if res.integrity is not None else []
+        ) + (
+            [res.convergence] if res.convergence is not None else []
         )
         stats = {
             "agg": agg,
@@ -728,11 +809,14 @@ class PartitionedTally:
             sel = np.asarray(res.valid) & (pid_h >= 0)
             stats["pid_seen"] = int(sel.sum())
             stats["pid_unique"] = int(np.unique(pid_h[sel]).size)
+        if res.convergence is not None:
+            stats["conv_dev"] = np.asarray(res.convergence, np.float64)
         self.total_segments += agg["segments"]
         self.total_rounds += n_rounds
         return got, stats
 
-    def _walk_once_packed(self, dest, moving, weight, group, initial):
+    def _walk_once_packed(self, dest, moving, weight, group, initial,
+                          first=True):
         """The _walk_once body over the packed pipeline (ops/staging.py):
         the slot distribution is packed into ONE carrier record and
         device_put once; the step unpacks it in-program and returns a
@@ -762,11 +846,14 @@ class PartitionedTally:
         )
 
         flux_in = self.flux_slabs  # bound pre-closure (see _walk_once)
+        conv_in = self._conv_in(initial, first)
 
         deadline = self.config.move_deadline_s is not None
 
         def _go():
-            res = self._step(initial)(rec, flux_in)
+            res = self._step(initial)(
+                rec, flux_in, *(conv_in if conv_in is not None else ())
+            )
             if self._io == "overlap" and not deadline:
                 # The previous move's deferred bookkeeping overlaps
                 # this step's device execution. Under the watchdog the
@@ -782,11 +869,16 @@ class PartitionedTally:
         if self._io == "overlap" and deadline:
             self._drain_pending()
         self.flux_slabs = res.flux
+        if self._conv is not None:
+            self._conv = (
+                res.conv_snap, res.conv_sumsq, res.conv_nb, res.conv_mv
+            )
         io["d2h_bytes"] += int(host_rb.nbytes)
         io["d2h_transfers"] += 1
         parsed = staging.split_partitioned_readback(
             host_rb, self.n_parts, self.cap, self.config.dtype,
             integrity=self._integrity != "off",
+            convergence=self._conv is not None,
         )
         got = staging.collect_packed(
             parsed, int(moving.sum()), self.partition
@@ -821,6 +913,8 @@ class PartitionedTally:
             sel = parsed["valid"] & (pid_h >= 0)
             stats["pid_seen"] = int(sel.sum())
             stats["pid_unique"] = int(np.unique(pid_h[sel]).size)
+        if "convergence" in parsed:
+            stats["conv_dev"] = parsed["convergence"]
         self.total_segments += agg["segments"]
         self.total_rounds += n_rounds
         return got, stats
@@ -985,17 +1079,97 @@ class PartitionedTally:
         # them up after a resume.
         self._last_xpoints = None
 
-    def write_pumi_tally_mesh(self, filename: str | None = None) -> str:
+    # ------------------------------------------------------------------ #
+    # Statistical convergence (obs/convergence.py; PumiTally contract)
+    # ------------------------------------------------------------------ #
+    def _require_convergence(self):
+        if self._monitor is None:
+            raise ValueError(
+                "convergence observability is off: construct with "
+                "TallyConfig(convergence=True)"
+            )
+        return self._monitor
+
+    def _reset_convergence(self) -> None:
+        """Re-base the batch statistics on the CURRENT slabs (checkpoint
+        restore / supervisor rollback; utils/checkpoint apply hooks)."""
+        if self._monitor is None:
+            return
+        self._drain_pending()
+        self._conv = (
+            self.flux_slabs[:, 0::2],
+            jnp.zeros_like(self._conv[1]),
+            jnp.zeros_like(self._conv[2]),
+            jnp.zeros_like(self._conv[3]),
+        )
+        self._monitor.reset()
+
+    def end_batch(self) -> dict:
+        """Close the current statistical batch NOW (the ``batch_moves``
+        cadence restarts), fold it into the per-chip accumulators on
+        device, reduce the per-chip partials, and return the refreshed
+        convergence summary (PumiTally.end_batch contract)."""
+        self._require_convergence()
+        from ..obs.convergence import end_batch_fold
+
+        self._drain_pending()
+        self._conv, vec = end_batch_fold(
+            self.flux_slabs, *self._conv,
+            rel_err_target=self.config.rel_err_target,
+        )
+        return self._monitor.update(
+            reduce_chip_conv(np.asarray(vec, np.float64)),
+            self.tally_times.total_time_to_tally,
+        )
+
+    def converged(self) -> bool:
+        """Caller-driven early stop (PumiTally.converged contract)."""
+        self._require_convergence()
+        self._drain_pending()
+        return self._monitor.converged
+
+    def relative_error(self) -> np.ndarray:
+        """Per-bin [ntet, n_groups] float64 relative error, assembled
+        from the per-chip batch accumulators (every bin is owned by
+        exactly one chip, so assembly is a permutation — the same
+        contract as raw_flux)."""
+        self._require_convergence()
+        from ..obs.convergence import host_relative_error
+
+        self._drain_pending()
+        snap, sumsq, nb, _ = self._conv
+        g = self.config.n_groups
+
+        def _assemble(slabs):
+            return assemble_global_flux(
+                self.partition,
+                np.asarray(slabs).reshape(
+                    self.n_parts, self.partition.max_local, g, 1
+                ),
+            )[:, :, 0]
+
+        return host_relative_error(
+            _assemble(snap), _assemble(sumsq),
+            int(np.asarray(nb)[0]),
+        )
+
+    def write_pumi_tally_mesh(
+        self, filename: str | None = None, uncertainty: bool = False
+    ) -> str:
         """Single-file VTK of the assembled normalized flux (PumiTally
-        contract, including the phase-time report); per-host PVTU pieces
+        contract, including the phase-time report and the
+        ``uncertainty=True`` rel-err cell fields); per-host PVTU pieces
         live in parallel/multihost.py."""
         from ..io.vtk import write_flux_vtk
 
         self._drain_pending()
+        rel = self.relative_error() if uncertainty else None
         with annotate("PartitionedTally.write_pumi_tally_mesh"), \
                 phase_timer(self.tally_times, "vtk_file_write_time", True):
             name = filename or self.config.output_filename
-            write_flux_vtk(name, self.mesh, self.normalized_flux())
+            write_flux_vtk(
+                name, self.mesh, self.normalized_flux(), rel_err=rel
+            )
         self._telemetry.record_memory("vtk_write")
         self.tally_times.print_times()
         return name
@@ -1005,12 +1179,27 @@ class PartitionedTally:
         """Run-wide telemetry snapshot — the PumiTally.telemetry()
         contract over the partitioned walk, with per-move migration
         extras in the flight records (rounds, emigrants sent, immigrants
-        adopted, per-chip segment/crossing splits)."""
+        adopted, per-chip segment/crossing splits) and the convergence
+        block."""
         self._drain_pending()
-        return self._telemetry.snapshot(times=self.tally_times)
+        out = self._telemetry.snapshot(times=self.tally_times)
+        out["convergence"] = (
+            self._monitor.snapshot()
+            if self._monitor is not None
+            else {"enabled": False}
+        )
+        return out
 
     @property
     def metrics(self):
         """This tally's MetricsRegistry (Prometheus text via
         ``tally.metrics.render_prometheus()``)."""
         return self._telemetry.registry
+
+    def close(self) -> None:
+        """Release facade-owned background resources (the PumiTally
+        contract): flush deferred folds, stop the scrape endpoint."""
+        self._drain_pending()
+        if self._exporter is not None:
+            self._exporter.stop()
+            self._exporter = None

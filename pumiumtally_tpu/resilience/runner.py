@@ -132,6 +132,17 @@ class ResilientRunner:
             "faults injected through PUMI_TPU_FAULTS (labeled by kind)",
         )
 
+        # Live scrape endpoint (obs/exporter.py): the facades start one
+        # when PUMI_TPU_PROM_PORT is set; pick up the duty for wrapped
+        # tallies that did not (e.g. constructed before the env was
+        # set), so a supervised soak is always scrapable. Owned (and
+        # stopped on close) only when started HERE.
+        self._exporter = None
+        if getattr(tally, "_exporter", None) is None:
+            from ..obs import maybe_start_exporter
+
+            self._exporter = maybe_start_exporter(r)
+
         self.resumed_from: int | None = None
         if resume:
             it = self.store.restore_latest(tally)
@@ -363,6 +374,9 @@ class ResilientRunner:
         ):
             self.checkpoint()
         self._uninstall_signal_handlers()
+        if self._exporter is not None:
+            self._exporter.stop()
+            self._exporter = None
 
     def __enter__(self):
         return self

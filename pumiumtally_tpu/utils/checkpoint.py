@@ -337,6 +337,10 @@ def _apply_plain(tally, meta: dict, arrays: dict) -> None:
             tally._compact_stages = tuple(
                 tuple(int(x) for x in s) for s in planned
             )
+    if hasattr(tally, "_reset_convergence"):
+        # Batch statistics are monitor state, not resumable tally state:
+        # re-base them on the restored accumulator (obs/convergence.py).
+        tally._reset_convergence()
     _apply_quarantined(tally, arrays)
     if getattr(tally, "_prev_even", None) is not None:
         # sd_mode="batch": the even-entry snapshot is derived state —
@@ -438,6 +442,10 @@ def _apply_partitioned(tally, meta: dict, arrays: dict) -> None:
         # Batch-sd snapshot is derived state (== current even
         # entries at any move boundary), re-slabbed alongside flux.
         tally._prev_even = tally.flux_slabs[:, 0::2]
+    if hasattr(tally, "_reset_convergence"):
+        # Batch statistics re-base on the restored slabs (see
+        # _apply_plain).
+        tally._reset_convergence()
 
 
 def save_partitioned_checkpoint(filename: str, tally) -> None:

@@ -207,6 +207,33 @@ class TallyConfig:
         retry machinery re-arms and replays. None (default): no
         watchdog thread, zero overhead.
 
+    convergence: statistical-convergence observability
+        (obs/convergence.py). When True, both facades keep
+        device-resident batch accumulators, fuse the per-bin
+        relative-error reduction into the walk programs (riding the
+        packed readback tail — the steady-state 1 H2D + 1 D2H
+        invariant still holds), feed the ``pumi_rel_err_max`` /
+        ``pumi_rel_err_mean`` / ``pumi_converged_fraction`` /
+        ``pumi_fom`` gauges and per-batch flight records, and answer
+        ``tally.converged()`` / ``tally.relative_error()`` /
+        ``telemetry()["convergence"]``.  The reductions READ the
+        accumulator and never write it: flux outputs are bit-identical
+        with the flag on or off.  Works with ``score_squares=False``
+        and ``sd_mode="batch"`` (only the even Σc entries are read).
+        Off (default): nothing is traced, allocated, or transferred.
+    rel_err_target: per-bin relative-error threshold defining a
+        "converged" bin (the MCNP-style steering statistic; default
+        0.05).
+    batch_moves: moves per statistical batch (default: 1 — every move
+        closes a batch, the finest monitoring grain). Larger values
+        give fewer, better-estimated batches; ``tally.end_batch()``
+        closes one explicitly regardless of cadence (and restarts it).
+        Only meaningful with ``convergence=True``.
+    converged_fraction: fraction of scored bins that must be at or
+        below ``rel_err_target`` before ``tally.converged()`` answers
+        True (default 0.95; at least 2 completed batches are always
+        required — before that every scored bin reports rel-err 1).
+
     Scope: ``ledger`` and ``gathers`` are honored by the single-chip and
     streaming-pipeline walks only. The partitioned walk
     (ops/walk_partitioned.py) always accumulates and migrates the ledger
@@ -248,6 +275,10 @@ class TallyConfig:
     audit_tol: float | None = None
     audit_seed: int = 0
     move_deadline_s: float | None = None
+    convergence: bool = False
+    rel_err_target: float = 0.05
+    batch_moves: int | None = None
+    converged_fraction: float = 0.95
 
     def resolve_integrity(self) -> str:
         """Validate and return the self-verification mode
@@ -287,6 +318,40 @@ class TallyConfig:
                 f"move_deadline_s must be positive: {self.move_deadline_s}"
             )
         return mode
+
+    def resolve_convergence(self) -> int | None:
+        """Validate the convergence-observability knobs and return the
+        effective moves-per-batch (None when the feature is off)."""
+        if not self.convergence:
+            if self.batch_moves is not None:
+                raise ValueError(
+                    "batch_moves only applies to convergence "
+                    "observability: set convergence=True or drop it"
+                )
+            return None
+        if not self.rel_err_target > 0:
+            raise ValueError(
+                f"rel_err_target must be positive: {self.rel_err_target}"
+            )
+        if not 0 < self.converged_fraction <= 1:
+            raise ValueError(
+                "converged_fraction must be in (0, 1]: "
+                f"{self.converged_fraction}"
+            )
+        bm = 1 if self.batch_moves is None else int(self.batch_moves)
+        if bm < 1:
+            raise ValueError(f"batch_moves must be >= 1: {bm}")
+        if self.checkify_invariants:
+            # The checkify debug wrapper treats every trace kwarg as
+            # static and cannot thread the device-resident batch
+            # accumulators; the two debug surfaces are mutually
+            # exclusive rather than silently dropping one.
+            raise ValueError(
+                "convergence observability does not compose with "
+                "checkify_invariants (the checkified walk cannot carry "
+                "the batch accumulators); disable one of them"
+            )
+        return bm
 
     def resolve_io_pipeline(self) -> str:
         """The effective move-loop I/O mode: the env override

@@ -1,0 +1,193 @@
+#!/usr/bin/env python
+"""Summarize a ``PUMI_TPU_METRICS=jsonl:`` stream and optionally emit a
+Chrome-trace timeline.
+
+The flight recorder streams one JSON line per record (moves, initial
+searches, quarantine/rewalk/integrity/audit events, per-batch
+convergence summaries, memory samples — obs/recorder.py).  This tool
+turns a stream (possibly from a crashed or still-running soak) into:
+
+  * a per-kind table — count, total/mean/max wall seconds where the
+    records carry ``seconds`` — plus headline totals (segments,
+    crossings, truncations, batches, final rel-err) so a multi-hour run
+    is judged at a glance;
+  * optionally (``--trace out.json``) a Chrome-trace JSON timeline of
+    the timed records, loadable in ``chrome://tracing`` or Perfetto —
+    each kind gets its own track, each record one complete ("X") slice
+    ending at its stream timestamp.
+
+Usage:
+    python scripts/teleview.py run.metrics.jsonl
+    python scripts/teleview.py run.metrics.jsonl --trace run.trace.json
+
+Pure stdlib; malformed lines (a crash mid-write leaves at most one) are
+counted and skipped, never fatal.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def read_records(path: str) -> tuple[list[dict], int]:
+    records, bad = [], 0
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                bad += 1
+                continue
+            if isinstance(rec, dict) and "kind" in rec:
+                records.append(rec)
+    return records, bad
+
+
+def summarize(records: list[dict]) -> tuple[list[tuple], dict]:
+    """Per-kind rows (kind, count, total_s, mean_s, max_s) plus headline
+    totals folded from the move/convergence records."""
+    by_kind: dict[str, dict] = {}
+    totals = {
+        "moves": 0, "segments": 0, "crossings": 0, "truncated": 0,
+        "quarantined": 0, "rewalked_lost": 0, "batches": 0,
+        "last_rel_err_mean": None, "last_converged_fraction": None,
+    }
+    for rec in records:
+        kind = str(rec["kind"])
+        row = by_kind.setdefault(
+            kind, {"count": 0, "total_s": 0.0, "timed": 0, "max_s": 0.0}
+        )
+        row["count"] += 1
+        sec = rec.get("seconds")
+        if isinstance(sec, (int, float)):
+            row["total_s"] += sec
+            row["timed"] += 1
+            row["max_s"] = max(row["max_s"], sec)
+        if kind == "move":
+            totals["moves"] += 1
+            for f in ("segments", "crossings", "truncated"):
+                if isinstance(rec.get(f), (int, float)):
+                    totals[f] += int(rec[f])
+        elif kind == "quarantine":
+            totals["quarantined"] += int(rec.get("lanes", 0))
+        elif kind == "rewalk":
+            totals["rewalked_lost"] += int(rec.get("lost", 0))
+        elif kind == "convergence":
+            totals["batches"] = max(
+                totals["batches"], int(rec.get("batch", 0))
+            )
+            totals["last_rel_err_mean"] = rec.get("rel_err_mean")
+            totals["last_converged_fraction"] = rec.get(
+                "converged_fraction"
+            )
+    rows = [
+        (
+            kind,
+            row["count"],
+            row["total_s"],
+            row["total_s"] / row["timed"] if row["timed"] else None,
+            row["max_s"] if row["timed"] else None,
+        )
+        for kind, row in sorted(by_kind.items())
+    ]
+    return rows, totals
+
+
+def print_table(rows: list[tuple], totals: dict, bad: int) -> None:
+    print(f"{'kind':<16} {'count':>8} {'total s':>10} "
+          f"{'mean s':>10} {'max s':>10}")
+    print("-" * 58)
+    for kind, count, tot, mean, mx in rows:
+        fmt = lambda v: f"{v:10.4f}" if v is not None else f"{'-':>10}"
+        print(
+            f"{kind:<16} {count:>8} {fmt(tot if mean is not None else None)}"
+            f" {fmt(mean)} {fmt(mx)}"
+        )
+    print("-" * 58)
+    for key, val in totals.items():
+        if val is not None:
+            print(f"{key}: {val}")
+    if bad:
+        print(f"(skipped {bad} malformed line(s))")
+
+
+def chrome_trace(records: list[dict]) -> dict:
+    """Complete-event ("X") timeline: a record's stream timestamp marks
+    the END of the phase it reports, so each slice spans
+    [ts − seconds, ts], in microseconds from the first event's start."""
+    timed = [
+        r for r in records
+        if isinstance(r.get("ts"), (int, float))
+        and isinstance(r.get("seconds"), (int, float))
+    ]
+    if not timed:
+        return {"traceEvents": []}
+    t0 = min(r["ts"] - r["seconds"] for r in timed)
+    kinds = sorted({str(r["kind"]) for r in timed})
+    tid = {k: i + 1 for i, k in enumerate(kinds)}
+    events = [
+        {
+            "name": k,
+            "ph": "M",
+            "pid": 1,
+            "tid": tid[k],
+            "cat": "__metadata",
+            "args": {"name": k},
+        }
+        for k in kinds
+    ]
+    # Thread-name metadata uses the dedicated event name.
+    for e in events:
+        e["name"] = "thread_name"
+    for r in timed:
+        args = {
+            k: v
+            for k, v in r.items()
+            if k not in ("ts", "level", "msg") and isinstance(
+                v, (int, float, str, bool)
+            )
+        }
+        events.append(
+            {
+                "name": f"{r['kind']} #{r.get('move', r.get('seq', ''))}",
+                "ph": "X",
+                "pid": 1,
+                "tid": tid[str(r["kind"])],
+                "ts": (r["ts"] - r["seconds"] - t0) * 1e6,
+                "dur": r["seconds"] * 1e6,
+                "args": args,
+            }
+        )
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Summarize a PUMI_TPU_METRICS jsonl stream"
+    )
+    ap.add_argument("stream", help="path to the jsonl metrics file")
+    ap.add_argument(
+        "--trace",
+        metavar="OUT.json",
+        help="also write a chrome://tracing / Perfetto timeline",
+    )
+    args = ap.parse_args(argv)
+    records, bad = read_records(args.stream)
+    if not records:
+        print(f"no metric records in {args.stream}", file=sys.stderr)
+        return 1
+    rows, totals = summarize(records)
+    print_table(rows, totals, bad)
+    if args.trace:
+        with open(args.trace, "w") as f:
+            json.dump(chrome_trace(records), f)
+        print(f"trace written: {args.trace}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
