@@ -352,6 +352,15 @@ def run(
         # rehearsal/fallback measurements, never comparable to TPU rows.
         "backend": jax.default_backend(),
         "vs_baseline": round(segments_per_sec / per_chip_baseline, 4),
+        # Dispatch-amortization axes (the megastep tentpole's tracked
+        # win): moves retired per wall-second, and how many host→device
+        # program dispatches each move cost. The fused kernel loop is
+        # the megastep shape (steps moves per ONE dispatch); fused=0 is
+        # the per-move shape (1 dispatch per move). The event-loop /
+        # megastep facade measurements carry their own copies in
+        # detail.
+        "moves_per_sec": round(steps / elapsed, 2),
+        "dispatches_per_move": round((1.0 / steps) if fused else 1.0, 4),
         # Per-move walk depth (obs/walk_stats.py schema): crossings,
         # max crossings/particle, chase hops, truncations, compaction
         # occupancy, segments, loop iters — one row per step of the
@@ -538,8 +547,51 @@ def run_event_loop(
         "event_call_overhead_ms": round(overhead_ms, 2),
         "event_particles": n_particles,
         "event_moves": moves,
+        # Per-move dispatch accounting for the facade loop (each
+        # move_to_next_location is one program dispatch).
+        "event_moves_per_sec": round(moves / dt, 2),
+        "event_dispatches_per_move": 1.0,
         "pipeline_segments_per_sec": round(pipe_rate, 1),
     }
+
+    # Megastep facade loop (the device-sourced fused move loop): the
+    # SAME mesh and batch size driven through run_source_moves with
+    # K = BENCH_MEGASTEP moves per dispatch, so the JSON tracks the
+    # dispatch-amortization win against the per-move event loop above.
+    mk = int(os.environ.get("BENCH_MEGASTEP", "8"))
+    if mk > 0:
+        from pumiumtally_tpu.ops.source import SourceParams
+
+        mcfg = TallyConfig(
+            dtype=dtype, n_groups=n_groups, tolerance=1e-6,
+            unroll=8, compact_stages="auto", megastep=mk,
+        )
+        # PUMI_TPU_MEGASTEP beats the config field in resolve_megastep();
+        # account with the EFFECTIVE chunk size so dispatches_per_move
+        # and the warm-dispatch count stay truthful under the override.
+        mk = mcfg.resolve_megastep()
+        mt = PumiTally(mesh, n_particles, mcfg)
+        mt.initialize_particle_location(pos0.reshape(-1).copy())
+        msrc = SourceParams(default_sigma_t=1.0 / mean_path, seed=seed)
+        ones = np.ones(n_particles)
+        zer = np.zeros(n_particles, np.int32)
+        # Warm/compile one full-K dispatch outside the clock.
+        mt.run_source_moves(mk, msrc, weights=ones, groups=zer,
+                            alive=np.ones(n_particles, bool))
+        seg0 = mt.total_segments
+        t0 = time.perf_counter()
+        mres = mt.run_source_moves(
+            mk, msrc, weights=ones, alive=np.ones(n_particles, bool)
+        )
+        dt_m = time.perf_counter() - t0
+        out.update(
+            megastep_k=mk,
+            megastep_segments_per_sec=round(
+                (mt.total_segments - seg0) / dt_m, 1
+            ),
+            megastep_moves_per_sec=round(mres["moves"] / dt_m, 2),
+            megastep_dispatches_per_move=round(1.0 / mk, 4),
+        )
     if convergence:
         # The run's final convergence block (rel-err / converged
         # fraction / FOM) rides the bench record, so a soak's JSON is

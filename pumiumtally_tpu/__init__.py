@@ -29,6 +29,7 @@ from .mesh.io import load_mesh, save_npz
 from .models.pipeline import StreamingTallyPipeline
 from .models.transport import Material, SyntheticTransport
 from .obs import FlightRecorder, MetricsExporter, MetricsRegistry
+from .ops.source import SourceParams
 from .ops.walk import trace, TraceResult
 from .resilience import CheckpointStore, FaultInjector, ResilientRunner
 from .utils.config import TallyConfig
@@ -65,6 +66,7 @@ __all__ = [
     "TransientIntegrityViolation",
     "FatalIntegrityViolation",
     "DispatchTimeoutError",
+    "SourceParams",
     "trace",
     "TraceResult",
     "TallyConfig",

@@ -270,7 +270,7 @@ class PumiTally:
         return trace(*args, **kwargs)
 
     # ------------------------------------------------------------------ #
-    def _dispatch(self, fn, move: int):
+    def _dispatch(self, fn, move: int, kind: str | None = None):
         """One compiled-step dispatch + blocking readback, under the
         integrity watchdog deadline when configured
         (integrity/watchdog.py). ``fn`` must be MUTATION-FREE (pure
@@ -279,14 +279,15 @@ class PumiTally:
         recovery is the supervisor's last-good rollback, which rebuilds
         every donated buffer from host copies.
 
-        The FIRST dispatch of each kind (initial search / move) runs
-        un-deadlined: it legitimately includes XLA compilation, which
-        can exceed any deadline sized for steady-state moves (minutes
-        on real hardware). The watchdog arms from the second dispatch
-        on — the regime where a stall means a wedged device."""
+        The FIRST dispatch of each kind (initial search / move /
+        megastep) runs un-deadlined: it legitimately includes XLA
+        compilation, which can exceed any deadline sized for
+        steady-state moves (minutes on real hardware). The watchdog
+        arms from the second dispatch on — the regime where a stall
+        means a wedged device."""
         if self.config.move_deadline_s is None:
             return fn()
-        key = "init" if move == 0 else "move"
+        key = kind or ("init" if move == 0 else "move")
         warm = getattr(self, "_watchdog_warm", None)
         if warm is None:
             warm = self._watchdog_warm = set()
@@ -1116,6 +1117,250 @@ class PumiTally:
                 )
             else:
                 self._monitor.update(fields, secs_total)
+
+    # ------------------------------------------------------------------ #
+    # Megastep: device-sourced fused move loop (ops/walk.py megastep)
+    # ------------------------------------------------------------------ #
+    def _source_tables(self, src):
+        """Device Σt/absorption tables for one SourceParams, cached by
+        its identity (staged once — never on the per-megastep path)."""
+        from .ops.source import staged_tables
+
+        self._src_tables = staged_tables(
+            src, self.mesh.class_id, self.config.dtype,
+            getattr(self, "_src_tables", None), put=jax.device_put,
+        )
+        return self._src_tables[1], self._src_tables[2]
+
+    def _rng_key(self, seed: int):
+        """Device PRNG key for one source seed, staged once (cold) and
+        reused by every megastep dispatch of that stream."""
+        from .ops.source import staged_rng_key
+
+        self._rng_key_cache = staged_rng_key(
+            seed, getattr(self, "_rng_key_cache", None)
+        )
+        return self._rng_key_cache[1]
+
+    def _megastep_statics(self, src) -> dict:
+        cfg = self.config
+        from .ops.source import near_epsilon
+
+        return dict(
+            n_groups=cfg.n_groups,
+            survival_weight=float(src.survival_weight),
+            downscatter=float(src.downscatter),
+            eps_near=near_epsilon(np.asarray(self.mesh.coords)),
+            max_crossings=self._max_crossings,
+            score_squares=(
+                cfg.score_squares and cfg.sd_mode == "segment"
+            ),
+            tolerance=cfg.tolerance,
+            compact_after=self._compact[0],
+            compact_size=self._compact[1],
+            compact_stages=self._compact_stages,
+            unroll=cfg.unroll,
+            robust=cfg.robust,
+            tally_scatter=cfg.tally_scatter,
+            gathers=cfg.gathers,
+            ledger=cfg.ledger,
+            stats=cfg.walk_stats,
+            integrity=self._integrity != "off",
+            rel_err_target=cfg.rel_err_target,
+            batch_moves=self._batch_moves or 1,
+        )
+
+    def _stage_source_lanes(self, weights, groups, alive, io) -> None:
+        """Cold-path staging of caller-provided physics lanes into
+        device state (slot order). Counted in the CALLING chunk's I/O
+        accounting; the steady-state megastep stages only the move
+        counter."""
+        n = self.num_particles
+        repl = {}
+        if weights is not None:
+            w = np.asarray(weights, np.float64).reshape(-1)[:n]
+            repl["weight"] = jnp.asarray(
+                self._gather_in(w), self.config.dtype
+            )
+        if groups is not None:
+            g = np.asarray(groups, np.int32).reshape(-1)[:n]
+            self._check_groups(g)
+            repl["group"] = jnp.asarray(self._gather_in(g), jnp.int32)
+        if alive is not None:
+            a = np.asarray(alive).astype(bool).reshape(-1)[:n]
+            repl["in_flight"] = jnp.asarray(self._gather_in(a))
+        if repl:
+            self.state = self.state._replace(**repl)
+            io["h2d_transfers"] += len(repl)
+            io["h2d_bytes"] += sum(int(v.nbytes) for v in repl.values())
+
+    def run_source_moves(
+        self,
+        n_moves: int,
+        source=None,
+        weights: np.ndarray | None = None,
+        groups: np.ndarray | None = None,
+        alive: np.ndarray | None = None,
+    ) -> dict:
+        """Run ``n_moves`` DEVICE-SOURCED moves: per-lane flight
+        sampling (counter-based RNG keyed by (seed, move, particle id)
+        over the per-region Σt table), the fused walk, and the
+        collision/roulette physics of models/transport.py's inner loop
+        all execute on device, fused ``TallyConfig(megastep=K)`` moves
+        per dispatch — the host performs ONE H2D (the move counter) and
+        ONE D2H (the stats/integrity/convergence/physics tail) per K
+        moves instead of per move.
+
+        ``weights``/``groups``/``alive`` (host pid order) re-stage the
+        persistent physics lanes when given (a cold-path transfer, e.g.
+        at batch start); omitted, the lanes continue from device state
+        — ``state.in_flight`` is the alive flag between calls, so
+        consecutive calls chain bitwise-identically to one bigger call.
+        Results are bitwise identical for any megastep K (pinned by
+        tests/test_megastep.py), and the RNG stream is keyed by the
+        persistent ``iter_count``, so checkpoint restores resume it
+        exactly.
+
+        Per-move-facade-only features do not ride the megastep: shadow
+        audits and truncation-escalation re-walks are skipped (truncated
+        lanes stay alive and continue next move — counted + warned),
+        and the periodic element sort never fires inside a dispatch
+        (sampling is layout-invariant, so it is pure scheduling either
+        way). Returns the accumulated physics counters
+        (ops/source.py MEGA_PHYS_FIELDS + ``moves`` + ``segments``).
+        """
+        assert self._initialized, (
+            "initialize_particle_location must run before source moves"
+        )
+        cfg = self.config
+        if cfg.record_xpoints is not None or cfg.checkify_invariants:
+            raise NotImplementedError(
+                "run_source_moves needs the packed megastep program; "
+                "record_xpoints / checkify_invariants require the "
+                "per-move facade path"
+            )
+        from .ops.source import SourceParams, phys_to_dict
+        from .ops.walk import megastep as megastep_fn
+
+        src = source if source is not None else SourceParams()
+        K = cfg.resolve_megastep()
+        sig_dev, ab_dev = self._source_tables(src)
+        rng_key = self._rng_key(src.seed)
+        statics = self._megastep_statics(src)
+        totals = {
+            "moves": 0, "segments": 0, "collisions": 0, "escaped": 0,
+            "rouletted": 0, "absorbed_weight": 0.0, "alive": 0,
+            "truncated": 0,
+        }
+        stage = dict(h2d_bytes=0, h2d_transfers=0)
+        self._stage_source_lanes(weights, groups, alive, stage)
+        done_moves = 0
+        while done_moves < n_moves:
+            k = min(K, n_moves - done_moves)
+            t_before = self.tally_times.total_time_to_tally
+            with annotate("PumiTally.run_source_moves"), phase_timer(
+                self.tally_times, "total_time_to_tally", True
+            ) as timer:
+                s = self.state
+                move0 = jax.device_put(np.int32(self.iter_count))
+                io = dict(
+                    h2d_bytes=4 + stage.pop("h2d_bytes", 0),
+                    h2d_transfers=1 + stage.pop("h2d_transfers", 0),
+                    d2h_bytes=0, d2h_transfers=0,
+                )
+                stage = {}
+                flux_in, conv_in = self.flux, self._conv
+                prev_in = self._prev_even
+
+                def _go():
+                    out = megastep_fn(
+                        self.mesh, s.origin, s.elem, s.material_id,
+                        s.weight, s.group, s.in_flight, s.particle_id,
+                        flux_in, move0, rng_key, sig_dev, ab_dev,
+                        prev_in, conv_in, n_moves=k, **statics,
+                    )
+                    return out, jax.device_get(out.readback)
+
+                # Amnesty key includes k: each distinct chunk length
+                # compiles its own program (n_moves is static), and the
+                # remainder chunk's compile must not run under an armed
+                # steady-state deadline.
+                out, host_rb = self._dispatch(
+                    _go, self.iter_count + 1, kind=f"megastep:{k}"
+                )
+                self.flux = out.flux
+                if self._monitor is not None:
+                    self._conv = out.conv_state
+                if self._prev_even is not None:
+                    self._prev_even = out.prev_even
+                self.state = s._replace(
+                    origin=out.position,
+                    dest=out.dest,
+                    in_flight=out.alive,
+                    weight=out.weight,
+                    group=out.group,
+                    elem=out.elem,
+                    material_id=out.material_id,
+                )
+                self.iter_count += k
+                self._traces_since_sort += 1
+                io["d2h_bytes"] += int(host_rb.nbytes)
+                io["d2h_transfers"] += 1
+                tail, integ, conv_h, phys = staging.split_megastep_tail(
+                    host_rb, cfg.dtype, cfg.walk_stats,
+                    statics["integrity"], self._monitor is not None,
+                )
+                stats_d = (
+                    stats_to_dict(tail) if cfg.walk_stats else None
+                )
+                segs = (
+                    stats_d["segments"] if stats_d is not None
+                    else int(tail[0])
+                )
+                self.total_segments += segs
+                p = phys_to_dict(phys)
+                self._warn_if_truncated(p["truncated"])
+                if integ is not None:
+                    from .integrity import invariants, policy
+
+                    fields = invariants.integrity_to_dict(integ)
+                    violations = invariants.check_megastep(
+                        fields, p["truncated"], self._integrity_tol,
+                        dtype=cfg.dtype, n_moves=k,
+                    )
+                    if fields or violations:
+                        self._telemetry.record_integrity(
+                            self.iter_count, fields, violations
+                        )
+                    policy.escalate(
+                        self._integrity, violations, self.iter_count
+                    )
+                self._maybe_inject_bitflip(self.iter_count)
+                if cfg.measure_time:
+                    timer.sync(self.state)
+            self.tally_times.n_moves += k
+            seconds = self.tally_times.total_time_to_tally - t_before
+            self._telemetry.record_walk(
+                "megastep", self.iter_count, stats_d,
+                seconds=seconds, synced=cfg.measure_time, moves=k,
+                collisions=p["collisions"], escaped=p["escaped"],
+                rouletted=p["rouletted"], alive=p["alive"], **io,
+            )
+            if self._monitor is not None and conv_h is not None:
+                self._monitor.update(
+                    conv_to_dict(conv_h),
+                    self.tally_times.total_time_to_tally,
+                )
+            totals["moves"] += k
+            totals["segments"] += segs
+            for f in ("collisions", "escaped", "rouletted", "truncated"):
+                totals[f] += p[f]
+            totals["absorbed_weight"] += p["absorbed_weight"]
+            totals["alive"] = p["alive"]
+            done_moves += k
+            if p["alive"] == 0:
+                break
+        return totals
 
     # ------------------------------------------------------------------ #
     def _store_xpoints(self, result) -> None:

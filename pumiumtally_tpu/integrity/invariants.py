@@ -135,3 +135,41 @@ def check_move(
     ):
         violations.append("lanes")
     return violations
+
+
+def check_megastep(
+    fields: dict,
+    n_truncated: int,
+    tol: float,
+    *,
+    dtype=np.float64,
+    n_moves: int = 1,
+) -> list[str]:
+    """Evaluate one MEGASTEP's reduced invariant vector → violated
+    check names (ops/walk.py merge_megastep_integrity semantics: the
+    conservation sums and lane counts are summed over the fused moves,
+    the residual is the max, ``bad_flux`` reflects the final
+    accumulator). The lane check is the device's own self-consistency
+    — Σ per-move completions + Σ per-move truncations must equal
+    Σ per-move in-flight counts — since the host never sees the
+    intra-megastep flying counts."""
+    violations = []
+    if fields["max_residual"] > tol:
+        violations.append("conservation")
+    if fields["bad_flux"] > 0:
+        violations.append("flux")
+    # The lane counts are integer counts accumulated in the WALK dtype
+    # over the fused moves: exact while the running totals stay below
+    # 1/eps (2^24 in f32), after which each of the ~2·n_moves additions
+    # can round by up to ulp(total). Allow exactly that rounding slack —
+    # zero in the exact range, so a genuine lane miscount still trips.
+    total = float(fields["lanes_flying"])
+    eps = float(np.finfo(np.dtype(dtype)).eps)
+    slack = 2.0 * max(int(n_moves), 1) * eps * max(abs(total), 1.0)
+    if slack < 1.0:
+        slack = 0.0
+    if abs(
+        fields["lanes_done"] + float(n_truncated) - total
+    ) > slack:
+        violations.append("lanes")
+    return violations

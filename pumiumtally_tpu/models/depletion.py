@@ -48,6 +48,10 @@ class DepletionLoop:
       inventory: region id → RegionNuclide.
       dt: depletion time step (arbitrary units; rates are per unit flux).
       seed: RNG seed for the transport driver.
+      mode: transport drive mode — "megastep" (default: each step's
+        batch runs the device-sourced fused loop, one dispatch per
+        TallyConfig(megastep=K) moves) or "host" (the per-event
+        OpenMC-shaped loop). See models/transport.py.
     """
 
     def __init__(
@@ -56,11 +60,13 @@ class DepletionLoop:
         inventory: dict[int, RegionNuclide],
         dt: float = 0.1,
         seed: int = 0,
+        mode: str = "megastep",
     ):
         self.tally = tally
         self.inventory = inventory
         self.dt = float(dt)
         self.seed = seed
+        self.mode = mode
         self.history: list[DepletionStepResult] = []
         self._region_elems = {
             rid: np.asarray(tally.mesh.class_id) == rid for rid in inventory
@@ -88,7 +94,8 @@ class DepletionLoop:
         # Fresh accumulator per step so rates reflect this step's flux.
         self.tally.flux = self.tally.flux * 0
         driver = SyntheticTransport(
-            self.tally, materials=self._materials(), seed=self.seed + i
+            self.tally, materials=self._materials(), seed=self.seed + i,
+            mode=self.mode,
         )
         driver.run_batch()
 

@@ -50,6 +50,10 @@ class BatchResult(NamedTuple):
     xpoints: np.ndarray | None = None
     n_xpoints: np.ndarray | None = None
     stats: dict | None = None
+    # Megastep batches (submit_source): the accumulated physics
+    # counters (ops/source.py MEGA_PHYS_FIELDS). None for plain
+    # submit() batches.
+    physics: dict | None = None
 
 
 class StreamingTallyPipeline:
@@ -160,8 +164,95 @@ class StreamingTallyPipeline:
         while len(self._inflight) > self.depth:
             self._drain_one()
 
+    def submit_source(
+        self, origin, elem, n_moves: int, source=None, weight=None,
+        group=None,
+    ) -> None:
+        """Dispatch one DEVICE-SOURCED batch: the whole ``n_moves``
+        event loop — re-source (RNG keyed by (source.seed, move,
+        particle id)), walk, collision/roulette physics — runs as ONE
+        megastep program (ops/walk.py ``megastep``), so a batch is a
+        single dispatch regardless of its event count. Batches are
+        independent (give each its own ``source.seed``); results drain
+        like ``submit()`` batches with the physics counters attached
+        (BatchResult.physics)."""
+        cfg = self.config
+        if cfg.record_xpoints is not None or cfg.checkify_invariants:
+            raise NotImplementedError(
+                "submit_source needs the packed megastep program; "
+                "record_xpoints / checkify_invariants require submit()"
+            )
+        from ..ops.source import SourceParams, near_epsilon, staged_tables
+        from ..ops.walk import megastep
+
+        src = source if source is not None else SourceParams()
+        self._src_tables = staged_tables(
+            src, self.mesh.class_id, cfg.dtype,
+            getattr(self, "_src_tables", None),
+        )
+        _, sig_dev, ab_dev = self._src_tables
+        n = np.asarray(origin).shape[0]
+        dt = cfg.dtype
+        out = megastep(
+            self.mesh,
+            jnp.asarray(origin, dt),
+            jnp.asarray(elem, jnp.int32),
+            jnp.full(n, -1, jnp.int32),
+            (
+                jnp.ones(n, dt)
+                if weight is None
+                else jnp.asarray(weight, dt)
+            ),
+            (
+                jnp.zeros(n, jnp.int32)
+                if group is None
+                else jnp.asarray(group, jnp.int32)
+            ),
+            jnp.ones(n, bool),
+            jnp.arange(n, dtype=jnp.int32),
+            self.flux,
+            jnp.zeros((), jnp.int32),
+            jax.random.PRNGKey(int(src.seed)),
+            sig_dev,
+            ab_dev,
+            None,
+            None,
+            n_moves=int(n_moves),
+            n_groups=cfg.n_groups,
+            survival_weight=float(src.survival_weight),
+            downscatter=float(src.downscatter),
+            eps_near=near_epsilon(np.asarray(self.mesh.coords)),
+            max_crossings=cfg.resolve_max_crossings(self.mesh.ntet),
+            score_squares=cfg.score_squares,
+            tolerance=cfg.tolerance,
+            **dict(
+                zip(
+                    ("compact_after", "compact_size"),
+                    cfg.resolve_compaction(n),
+                )
+            ),
+            compact_stages=cfg.resolve_compact_stages(
+                n, ntet=self.mesh.ntet
+            ),
+            unroll=cfg.unroll,
+            robust=cfg.robust,
+            tally_scatter=cfg.tally_scatter,
+            gathers=cfg.gathers,
+            ledger=cfg.ledger,
+            stats=cfg.walk_stats,
+            integrity=False,
+        )
+        self.flux = out.flux
+        self._inflight.append((self._n_submitted, out))
+        self._n_submitted += 1
+        while len(self._inflight) > self.depth:
+            self._drain_one()
+
     def _drain_one(self) -> None:
         idx, r = self._inflight.popleft()
+        if getattr(r, "readback", None) is not None:
+            self._drain_megastep(idx, r)
+            return
         if self.want_outputs:
             if r.stats is not None:
                 from ..obs import stats_to_dict
@@ -190,6 +281,48 @@ class StreamingTallyPipeline:
                     stats=stats,
                 )
             )
+
+    def _drain_megastep(self, idx: int, r) -> None:
+        """Drain one submit_source() batch: one readback fetch carries
+        the stats/physics tails; per-lane outputs come back only when
+        the pipeline wants them."""
+        from ..ops import staging
+        from ..ops.source import phys_to_dict
+
+        if not self.want_outputs:
+            # No host sync: fetching the readback here would stall on
+            # the in-flight megastep, defeating the depth-N overlap the
+            # pipeline exists to provide (the only sync is finish()).
+            return
+        tail, _integ, _conv, phys = staging.split_megastep_tail(
+            jax.device_get(r.readback), self.config.dtype,
+            self.config.walk_stats, False, False,
+        )
+        if self.config.walk_stats:
+            from ..obs import stats_to_dict
+
+            stats = stats_to_dict(tail)
+            n_segments = stats["segments"]
+        else:
+            stats = None
+            n_segments = int(tail[0])
+        p = phys_to_dict(phys)
+        self._results.append(
+            BatchResult(
+                index=idx,
+                position=np.asarray(r.position),
+                elem=np.asarray(r.elem),
+                material_id=np.asarray(r.material_id),
+                n_segments=n_segments,
+                # A megastep batch is finished only when every particle
+                # terminated (absorbed/escaped/rouletted) AND no walk
+                # was cut off mid-move; lanes still alive when n_moves
+                # ran out are unfinished work, not a clean batch.
+                all_done=p["alive"] == 0 and p["truncated"] == 0,
+                stats=stats,
+                physics=p,
+            )
+        )
 
     # ------------------------------------------------------------------ #
     def results(self) -> Iterator[BatchResult]:

@@ -17,8 +17,21 @@ ids when a particle crosses a region boundary; the driver then re-samples
 the remaining flight in the new material — mirroring how OpenMC re-asks for
 the next advance after a surface crossing).
 
-This is host-side orchestration; the per-event compute stays in the fused
-device kernel behind move_to_next_location.
+Two drive modes:
+
+  * ``mode="megastep"`` (the default for this self-driven loop): the
+    inner event loop runs ON DEVICE through
+    ``tally.run_source_moves`` — re-source (counter-based RNG),
+    walk, and collision/roulette physics fused
+    ``TallyConfig(megastep=K)`` moves per dispatch (ops/source.py),
+    so a whole batch is a handful of dispatches instead of one per
+    advance event. Physics parameters are identical; the RNG streams
+    are device-side (jax.random), so per-event outcomes differ from
+    host mode statistically, not physically.
+  * ``mode="host"`` — the original per-event host loop, the exact
+    call sequence the reference receives from OpenMC
+    (move_to_next_location per advance event). Per-event compute
+    still runs in the fused device kernel.
 """
 from __future__ import annotations
 
@@ -51,11 +64,14 @@ class SyntheticTransport:
     """Event-based transport of ``n`` particles per batch on a PumiTally mesh.
 
     Args:
-      tally: the PumiTally facade to drive.
+      tally: the PumiTally (or PartitionedTally) facade to drive.
       materials: class_id → Material map; ids not present use the default.
       source_box: axis-aligned (lo, hi) corners of the uniform source region.
       survival_weight: weight floor below which Russian roulette triggers.
       max_events: safety cap on advance events per batch.
+      mode: "megastep" (default — the device-sourced fused loop through
+        ``run_source_moves``) or "host" (the per-event
+        move_to_next_location loop, the OpenMC call pattern).
     """
 
     def __init__(
@@ -66,7 +82,13 @@ class SyntheticTransport:
         survival_weight: float = 0.1,
         max_events: int = 1000,
         seed: int = 0,
+        mode: str = "megastep",
     ):
+        if mode not in ("megastep", "host"):
+            raise ValueError(
+                f"mode must be 'megastep' or 'host': {mode!r}"
+            )
+        self.mode = mode
         self.tally = tally
         self.materials = materials or {}
         self.default_material = Material()
@@ -107,6 +129,25 @@ class SyntheticTransport:
         return np.stack([s * np.cos(phi), s * np.sin(phi), mu], axis=1)
 
     # ------------------------------------------------------------------ #
+    def _source_params(self):
+        """The Material map as megastep SourceParams (one seed draw per
+        batch keeps batches statistically independent while staying
+        deterministic for a given construction seed + call order)."""
+        from ..ops.source import SourceParams
+
+        return SourceParams(
+            sigma_t={
+                int(c): m.sigma_t for c, m in self.materials.items()
+            },
+            absorption={
+                int(c): m.absorption for c, m in self.materials.items()
+            },
+            default_sigma_t=self.default_material.sigma_t,
+            default_absorption=self.default_material.absorption,
+            survival_weight=self.survival_weight,
+            seed=int(self.rng.integers(0, 2**31 - 1)),
+        )
+
     def run_batch(self) -> None:
         """One source batch: sample sources, then advance events until every
         particle is absorbed, escaped, or rouletted."""
@@ -116,6 +157,25 @@ class SyntheticTransport:
         pos = self.rng.uniform(lo, hi, (n, 3))
         t.initialize_particle_location(pos.ravel())
 
+        if self.mode == "megastep":
+            # Device-sourced fused loop: the whole inner event loop runs
+            # on device (re-source → walk → physics), megastep-K moves
+            # per dispatch, early-stopped when every particle is dead.
+            out = t.run_source_moves(
+                self.max_events,
+                self._source_params(),
+                weights=np.ones(n),
+                groups=np.zeros(n, np.int32),
+                alive=np.ones(n, bool),
+            )
+            self.stats.events += out["moves"]
+            self.stats.collisions += out["collisions"]
+            self.stats.absorbed_weight += out["absorbed_weight"]
+            self.stats.boundary_escapes += out["escaped"]
+            self.stats.roulette_kills += out["rouletted"]
+            self.stats.batches += 1
+            return
+
         # Host-side particle bookkeeping (OpenMC's role in the pairing).
         cur = pos.copy()
         weight = np.ones(n)
@@ -124,12 +184,13 @@ class SyntheticTransport:
         n_groups = t.config.n_groups
         # Material at the source site from the parent element's region id.
         material = self._class_id[t.element_ids].astype(np.int32)
-        coords = np.asarray(t.mesh.coords, np.float64)
         # "Reached destination" test must tolerate the device float dtype:
         # positions round-trip through (typically) float32 on the TPU.
-        eps = 1e-4 * float(
-            np.linalg.norm(coords.max(axis=0) - coords.min(axis=0))
-        )
+        # Shared with the megastep's on-device decode so host-mode and
+        # megastep-mode outcomes can never drift apart.
+        from ..ops.source import near_epsilon
+
+        eps = near_epsilon(t.mesh.coords)
 
         for _ in range(self.max_events):
             if not alive.any():
@@ -141,18 +202,27 @@ class SyntheticTransport:
 
             flying = alive.astype(np.int8)
             mats_out = material.copy()
-            dest_inout = dest.copy()
-            t.move_to_next_location(
-                dest_inout, flying, weight.copy(), group.copy(), mats_out
-            )
+            # weights/groups are read-only facade inputs (packed staging
+            # reads, never mutates — pinned by the no-mutation test in
+            # tests/test_megastep.py) and ``dest`` itself is the in/out
+            # buffer: the defensive per-event copies the original loop
+            # made were pure host overhead. Only ``mats_out`` stays a
+            # copy — the facade writes -1 into reached/escaped lanes,
+            # and the collision physics below still needs the pre-move
+            # region map.
+            t.move_to_next_location(dest, flying, weight, group, mats_out)
             self.stats.events += 1
 
             # Outcome decoding per the reference's out-param contract
             # (apply_boundary_condition, cpp:452-515): material_id >= 0 ⇒
             # stopped at a region boundary; material_id == -1 ⇒ either the
             # destination was reached or the particle left the domain —
-            # disambiguated by whether the returned position was clipped.
-            near = np.linalg.norm(dest_inout - dest, axis=1) < eps
+            # disambiguated by whether the walked distance covers the
+            # sampled flight (``dest`` was clipped in place, so the
+            # requested endpoint is reconstructed from cur + dist along
+            # the ray: traveled == dist ⟺ the endpoint was reached).
+            traveled = np.linalg.norm(dest - cur, axis=1)
+            near = dist - traveled < eps
             reached = alive & (mats_out < 0) & near
             crossed = alive & (mats_out >= 0)
             escaped = alive & (mats_out < 0) & ~near
@@ -183,7 +253,7 @@ class SyntheticTransport:
             alive[killed] = False
             self.stats.roulette_kills += int(killed.sum())
 
-            cur = dest_inout
+            cur = dest
         self.stats.batches += 1
 
     def run(self, batches: int, output: str | None = None) -> TransportStats:

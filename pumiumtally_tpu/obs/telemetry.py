@@ -155,6 +155,11 @@ class TallyTelemetry:
                 self._move_s.observe(float(seconds))
         if kind == "move":
             self._moves.inc()
+        elif kind == "megastep":
+            # One megastep record covers K fused device moves; the
+            # moves counter advances by the fused count so the totals
+            # stay per-MOVE comparable across loop modes.
+            self._moves.inc(int(extra.get("moves", 1)))
         if stats is not None:
             fields.update(stats)
             self._segments.inc(stats["segments"])

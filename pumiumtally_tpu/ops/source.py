@@ -1,0 +1,286 @@
+"""Device-resident source sampling + flight physics for the megastep.
+
+The reference pairs OpenMC's host loop with a per-advance-event GPU
+walk: every move, the HOST samples the next flight (direction, distance)
+and re-dispatches. The megastep (ops/walk.py ``megastep``, ops/
+walk_partitioned.py ``make_partitioned_megastep``) moves that inner loop
+— the body of models/transport.py ``run_batch`` — into the compiled
+step, so the host only sees batch boundaries. This module is the shared
+sampling/physics layer for both facades:
+
+  * **counter-based RNG keyed by (seed, move, particle id)** — every
+    move ``m`` derives ``fold_in(PRNGKey(seed), m)`` and each lane
+    derives its variates from a per-lane ``fold_in`` of that key with
+    its PARTICLE id, costing O(lanes on this chip). Sampling is
+    therefore invariant to the device layout: megastep-K and K
+    megastep-1 dispatches see identical streams (the bitwise-identity
+    contract of tests/test_megastep.py), slot migration on the
+    partitioned facade never perturbs a particle's stream, and a
+    checkpoint restore resumes the exact sequence (the move counter is
+    persisted).
+  * **flight sampling** — isotropic direction (mu/phi) and an
+    exponential flight distance scaled by the lane's current region Σt
+    (a per-region table lookup; the region is the parent element's
+    class, exactly models/transport.py ``_sigma_t``).
+  * **collision/termination physics** (``apply_physics``) — the
+    outcome decode of the reference's out-param contract
+    (material_id >= 0 ⇒ region crossing; -1 ⇒ reached or escaped,
+    disambiguated by the clipped position) plus survival-weighting
+    absorption, 1/2-probability downscatter, domain-escape termination
+    and Russian roulette, elementwise on device.
+
+Nothing here is used by the OpenMC-facade ``move_to_next_location``
+path, whose destinations come from the caller.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Per-megastep physics tail (walk-dtype floats riding the single
+# coalesced readback; counts are exact to 2^24 lanes in f32):
+#   collisions — lanes that completed their sampled flight (summed
+#     over the fused moves);
+#   escaped — lanes terminated at the domain boundary;
+#   rouletted — lanes killed by Russian roulette;
+#   absorbed_weight — Σ weight·absorption over collisions;
+#   alive — in-flight lanes at megastep END (the host's early-stop
+#     signal);
+#   truncated — lanes left mid-walk by max_crossings, summed over the
+#     fused moves (each would have warned on the per-move facade; they
+#     stay alive and continue from their mid-walk position next move).
+MEGA_PHYS_FIELDS = (
+    "collisions",
+    "escaped",
+    "rouletted",
+    "absorbed_weight",
+    "alive",
+    "truncated",
+)
+MEGA_PHYS_LEN = len(MEGA_PHYS_FIELDS)
+MEGA_PHYS_IDX = {name: i for i, name in enumerate(MEGA_PHYS_FIELDS)}
+
+
+@dataclasses.dataclass(frozen=True)
+class SourceParams:
+    """Per-region one-speed flight physics for device-resident
+    re-sourcing (the models/transport.py Material map, as data the
+    megastep program can table-look-up).
+
+    Attributes:
+      sigma_t: region class_id → total macroscopic cross-section
+        [1/cm] (regions absent from the map use ``default_sigma_t``).
+      absorption: region class_id → absorbed fraction per collision.
+      survival_weight: weight floor below which Russian roulette fires.
+      downscatter: per-collision probability of dropping one energy
+        group (multi-group configs only; transport.py hardcodes 1/2).
+      seed: RNG stream seed. The per-move key is
+        ``fold_in(PRNGKey(seed), move)`` with the facade's persistent
+        move counter, so a restored run resumes the exact stream.
+    """
+
+    sigma_t: dict | None = None
+    absorption: dict | None = None
+    default_sigma_t: float = 1.0
+    default_absorption: float = 0.3
+    survival_weight: float = 0.1
+    downscatter: float = 0.5
+    seed: int = 0
+
+    def tables(self, class_id) -> tuple[np.ndarray, np.ndarray]:
+        """Host [max_class+1] Σt / absorption tables indexed by region
+        class value (the megastep gathers them by the parent element's
+        class)."""
+        cid = np.asarray(class_id)
+        hi = int(cid.max(initial=0)) + 1
+        for d in (self.sigma_t, self.absorption):
+            if d:
+                hi = max(hi, max(int(k) for k in d) + 1)
+        sig = np.full(hi, float(self.default_sigma_t), np.float64)
+        ab = np.full(hi, float(self.default_absorption), np.float64)
+        for k, v in (self.sigma_t or {}).items():
+            sig[int(k)] = float(v)
+        for k, v in (self.absorption or {}).items():
+            ab[int(k)] = float(v)
+        return sig, ab
+
+    def physics_key(self) -> tuple:
+        """Hashable identity of everything COMPILED into a megastep
+        program (tables + static physics knobs). The seed is excluded:
+        the RNG key is a runtime input, so re-seeding (e.g. one draw
+        per transport batch) never recompiles."""
+        return (
+            tuple(sorted((self.sigma_t or {}).items())),
+            tuple(sorted((self.absorption or {}).items())),
+            self.default_sigma_t,
+            self.default_absorption,
+            self.survival_weight,
+            self.downscatter,
+        )
+
+    def cache_key(self) -> tuple:
+        """Hashable identity for facade-side device-table caches."""
+        return self.physics_key() + (self.seed,)
+
+
+def staged_tables(params, class_id, dtype, cache, put=None):
+    """Device Σt/absorption tables for one ``SourceParams``, staged once
+    per distinct PHYSICS identity (``physics_key`` — the seed is
+    excluded: the tables are seed-independent, so a driver that draws a
+    fresh seed per batch, like SyntheticTransport, never re-uploads
+    them; the RNG key is cached separately by ``staged_rng_key``).
+
+    ``cache`` is a previous return value (or None); the caller stores it
+    and unpacks the tables: ``cache = staged_tables(...)`` then
+    ``_, sig_dev, ab_dev = cache``. ``put`` (e.g. ``jax.device_put`` or
+    a sharded placement) commits the arrays; None leaves them
+    uncommitted. Shared by PumiTally._source_tables and
+    StreamingTallyPipeline.submit_source so the invalidation rule lives
+    in one place.
+    """
+    key = params.physics_key()
+    if cache is not None and cache[0] == key:
+        return cache
+    sig, ab = params.tables(np.asarray(class_id))
+    sig_d = jnp.asarray(sig, dtype)
+    ab_d = jnp.asarray(ab, dtype)
+    if put is not None:
+        sig_d, ab_d = put(sig_d), put(ab_d)
+    return (key, sig_d, ab_d)
+
+
+def staged_rng_key(seed, cache, put=None):
+    """Device PRNG key for one source seed, staged once per distinct
+    seed and reused by every megastep dispatch of that stream. ``cache``
+    is a previous return value (or None): ``cache = staged_rng_key(...)``
+    then ``_, key_dev = cache``. ``put`` commits the key (the
+    partitioned facade places it replicated across the mesh — an
+    uncommitted single-device key would be re-replicated on every
+    dispatch, which jax.transfer_guard rightly flags)."""
+    if cache is not None and cache[0] == int(seed):
+        return cache
+    import jax.random as jrandom
+
+    k = jrandom.PRNGKey(int(seed))
+    return (int(seed), put(k) if put is not None else jax.device_put(k))
+
+
+def near_epsilon(coords) -> float:
+    """Static reached-destination tolerance: 1e-4 of the bounding-box
+    diagonal, exactly models/transport.py's ``eps`` (positions
+    round-trip through the walk dtype)."""
+    c = np.asarray(coords, np.float64)
+    return 1e-4 * float(np.linalg.norm(c.max(axis=0) - c.min(axis=0)))
+
+
+def sample_move(base_key, move, pid, n_total: int, dtype):
+    """Draw one move's variates, keyed by (seed, move, particle id).
+
+    Counter-based: each lane's five variates derive directly from its
+    per-lane key ``fold_in(fold_in(base_key, move), pid)``, so the cost
+    is O(lanes on this chip) — a partitioned chip never materializes
+    the global [n_total] stream — while staying invariant to the device
+    layout: slot migration never perturbs a particle's stream, and
+    megastep-K matches K megastep-1 dispatches bitwise. Empty
+    partitioned slots carry pid −1 (clipped — they draw particle 0's
+    stream, which their invalid/parked state discards). Returns
+    ``(direction [m,3], ell [m], coll_u [m], roul_u [m])`` where ``ell``
+    is a unit-rate exponential draw (the caller divides by the lane's
+    region Σt).
+    """
+    key = jax.random.fold_in(base_key, move)
+    p = jnp.clip(pid, 0, n_total - 1)
+    lane_keys = jax.vmap(lambda q: jax.random.fold_in(key, q))(p)
+    u = jax.vmap(lambda k: jax.random.uniform(k, (5,), dtype))(
+        lane_keys
+    )
+    mu = u[:, 0] * 2.0 - 1.0
+    phi = u[:, 1] * (2.0 * np.pi)
+    s = jnp.sqrt(jnp.maximum(1.0 - mu * mu, 0.0))
+    direction = jnp.stack(
+        [s * jnp.cos(phi), s * jnp.sin(phi), mu], axis=1
+    )
+    # Unit-rate exponential by inverse CDF; uniform draws land in
+    # [0, 1) so log1p stays finite.
+    ell = -jnp.log1p(-u[:, 2])
+    return direction, ell, u[:, 3], u[:, 4]
+
+
+def apply_physics(
+    position,
+    dest,
+    done,
+    mat_out,
+    weight,
+    group,
+    alive,
+    absorb,
+    coll_u,
+    roul_u,
+    *,
+    eps_near: float,
+    survival_weight: float,
+    downscatter: float,
+    n_groups: int,
+):
+    """One move's collision/termination physics (the models/transport.py
+    outcome decode + update, elementwise on device).
+
+    ``done``/``mat_out``/``position`` are the walk's per-lane outputs;
+    ``dest`` the sampled destination (on the partitioned facade it must
+    be the result's MIGRATED dest — the payload travels with its
+    particle); ``absorb`` the per-lane absorbed fraction of the lane's
+    collision region (the class of the FINAL parent element — identical
+    to the move-start region for collided lanes, which never cross a
+    material boundary on their final leg). Lanes the walk truncated
+    (done=False) see no physics this move: they stay alive and continue
+    from their mid-walk position.
+
+    Returns ``(weight', group', alive', phys [4])`` with phys =
+    (collisions, escaped, rouletted, absorbed_weight) in the walk dtype.
+    """
+    dtype = weight.dtype
+    dist = jnp.linalg.norm(position - dest, axis=-1)
+    near = dist < jnp.asarray(eps_near, dtype)
+    finished = alive & done
+    reached = finished & (mat_out < 0) & near
+    escaped = finished & (mat_out < 0) & ~near
+    absorbed = jnp.sum(jnp.where(reached, weight * absorb, 0.0))
+    weight = jnp.where(reached, weight * (1.0 - absorb), weight)
+    if n_groups > 1:
+        down = reached & (coll_u < downscatter)
+        group = jnp.where(
+            down, jnp.minimum(group + 1, n_groups - 1), group
+        )
+    alive = alive & ~escaped
+    low = alive & (weight < jnp.asarray(survival_weight, dtype))
+    lucky = low & (roul_u < 0.5)
+    weight = jnp.where(lucky, weight * 2.0, weight)
+    killed = low & ~lucky
+    alive = alive & ~killed
+    phys = jnp.stack(
+        [
+            jnp.sum(reached).astype(dtype),
+            jnp.sum(escaped).astype(dtype),
+            jnp.sum(killed).astype(dtype),
+            absorbed.astype(dtype),
+        ]
+    )
+    return weight, group, alive, phys
+
+
+def phys_to_dict(vec) -> dict:
+    """Named host view of one [MEGA_PHYS_LEN] physics tail vector."""
+    v = np.asarray(vec, np.float64)
+    if v.shape != (MEGA_PHYS_LEN,):
+        raise ValueError(
+            f"expected a [{MEGA_PHYS_LEN}] megastep physics vector, "
+            f"got {v.shape}"
+        )
+    out = {f: float(v[i]) for i, f in enumerate(MEGA_PHYS_FIELDS)}
+    for f in ("collisions", "escaped", "rouletted", "alive", "truncated"):
+        out[f] = int(out[f])
+    return out

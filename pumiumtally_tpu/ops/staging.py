@@ -336,6 +336,126 @@ def split_trace_readback(host_rec, n: int, dtype, integrity: bool = False,
 
 
 # --------------------------------------------------------------------- #
+# Megastep readback tails (device-sourced fused move loop)
+# --------------------------------------------------------------------- #
+def pack_megastep_tail(stats, n_segments, integrity, convergence, phys,
+                       dtype):
+    """Device-side (traced) single-chip megastep readback: the whole
+    megastep's host-visible surface in ONE flat carrier vector — the
+    per-megastep walk-stats reduction (or the scalar segment count when
+    walk stats are off) int64-encoded, then the reduced integrity
+    vector / last convergence summary / physics tail as walk-dtype
+    floats. Per-lane state never rides it: it stays device-resident
+    between megasteps, which is the whole point."""
+    carrier = _jnp_carrier(dtype)
+    tail_src = stats if stats is not None else n_segments[None]
+    parts = [_enc_i64_tail_dev(_widen_counts(tail_src), carrier)]
+    if integrity is not None:
+        parts.append(_enc_f_dev(integrity.astype(dtype), carrier))
+    if convergence is not None:
+        parts.append(_enc_f_dev(convergence.astype(dtype), carrier))
+    parts.append(_enc_f_dev(phys.astype(dtype), carrier))
+    return jnp.concatenate(parts)
+
+
+def split_megastep_tail(host_vec, dtype, walk_stats: bool,
+                        integrity: bool, convergence: bool):
+    """Host-side inverse of pack_megastep_tail. Returns ``(tail int64
+    array — the stats vector or [n_segments], integrity float64 or
+    None, convergence float64 or None, phys float64)``."""
+    from .source import MEGA_PHYS_LEN
+
+    npdt = np.dtype(dtype)
+    words = np.asarray(host_vec)
+    phys = _dec_f_host(words[-MEGA_PHYS_LEN:], npdt).astype(np.float64)
+    words = words[:-MEGA_PHYS_LEN]
+    conv = integ = None
+    if convergence:
+        from ..obs.convergence import CONV_LEN
+
+        conv = _dec_f_host(words[-CONV_LEN:], npdt).astype(np.float64)
+        words = words[:-CONV_LEN]
+    if integrity:
+        from ..integrity.invariants import INTEGRITY_LEN
+
+        integ = _dec_f_host(words[-INTEGRITY_LEN:], npdt).astype(
+            np.float64
+        )
+        words = words[:-INTEGRITY_LEN]
+    return _dec_i64_host(words), integ, conv, phys
+
+
+def pack_partitioned_megastep_tail(stats, n_rounds, n_dropped,
+                                   n_segments, integrity, convergence,
+                                   phys, dtype):
+    """Device-side (traced) partitioned megastep readback: ONE
+    [n_parts, W] array (sharded on its leading axis) carrying each
+    chip's accumulated stats vector + round/drop/segment counters in
+    the int64 tail encoding, the per-chip integrity counters when on,
+    the per-chip convergence partials when on, and the (replicated)
+    global physics tail as walk-dtype floats."""
+    carrier = _jnp_carrier(dtype)
+    n_parts = stats.shape[0]
+    cols = [
+        _widen_counts(stats),
+        _widen_counts(n_rounds)[:, None],
+        _widen_counts(n_dropped)[:, None],
+        _widen_counts(n_segments)[:, None],
+    ]
+    if integrity is not None:
+        cols.append(_widen_counts(integrity))
+    tail = _enc_i64_tail_dev(jnp.concatenate(cols, axis=1), carrier)
+    parts = [tail]
+    if convergence is not None:
+        parts.append(_enc_f_dev(convergence.astype(dtype), carrier))
+    parts.append(
+        _enc_f_dev(
+            jnp.broadcast_to(
+                phys.astype(dtype), (n_parts,) + phys.shape
+            ),
+            carrier,
+        )
+    )
+    return jnp.concatenate(parts, axis=1)
+
+
+def split_partitioned_megastep_tail(host_rec, dtype, integrity: bool,
+                                    convergence: bool) -> dict:
+    """Host-side inverse of pack_partitioned_megastep_tail."""
+    from ..integrity.invariants import PART_INTEGRITY_LEN
+    from ..obs import WALK_STATS_LEN
+    from .source import MEGA_PHYS_LEN
+
+    npdt = np.dtype(dtype)
+    rec = np.asarray(host_rec)
+    phys_rows = _dec_f_host(rec[:, -MEGA_PHYS_LEN:], npdt).astype(
+        np.float64
+    )
+    rec = rec[:, :-MEGA_PHYS_LEN]
+    conv = None
+    if convergence:
+        from ..obs.convergence import CONV_LEN
+
+        conv = _dec_f_host(rec[:, -CONV_LEN:], npdt).astype(np.float64)
+        rec = rec[:, :-CONV_LEN]
+    tail = _dec_i64_host(rec).reshape(rec.shape[0], -1)
+    out = {
+        "stats": tail[:, :WALK_STATS_LEN],
+        "n_rounds": tail[:, WALK_STATS_LEN],
+        "n_dropped": tail[:, WALK_STATS_LEN + 1],
+        "n_segments": tail[:, WALK_STATS_LEN + 2],
+        # The physics tail is replicated per chip; row 0 is the value.
+        "phys": phys_rows[0],
+    }
+    if integrity:
+        base = WALK_STATS_LEN + 3
+        out["integrity"] = tail[:, base: base + PART_INTEGRITY_LEN]
+    if conv is not None:
+        out["convergence"] = conv
+    return out
+
+
+# --------------------------------------------------------------------- #
 # Partitioned facade records
 # --------------------------------------------------------------------- #
 def pack_partitioned_record(

@@ -1411,6 +1411,289 @@ trace_packed.__doc__ = trace_packed_impl.__doc__
 
 
 # --------------------------------------------------------------------- #
+# Megastep: K device-sourced moves fused into one compiled program
+# --------------------------------------------------------------------- #
+class MegastepResult(NamedTuple):
+    """Outputs of one megastep dispatch (ops/source.py module
+    docstring). Per-lane state stays DEVICE-RESIDENT — the facade
+    re-binds it for the next megastep; only ``readback`` (the packed
+    stats/integrity/convergence/physics tail,
+    staging.pack_megastep_tail) is fetched, so a whole megastep is one
+    H2D (the move counter) and one D2H (this tail)."""
+
+    position: jax.Array
+    dest: jax.Array
+    elem: jax.Array
+    material_id: jax.Array
+    weight: jax.Array
+    group: jax.Array
+    alive: jax.Array
+    flux: jax.Array
+    readback: jax.Array
+    prev_even: jax.Array | None = None
+    conv_state: tuple | None = None
+
+
+def merge_megastep_stats(acc, stats):
+    """Fold one fused move's stats vector into the megastep reduction:
+    sums everywhere, max of ``max_crossings``, and ``truncated``
+    SUMMED over moves (each fused move's truncation is a distinct
+    would-have-warned event — unlike a re-walk merge, where attempts
+    revisit the same lanes and only the final count stands)."""
+    from ..obs import IDX
+
+    out = acc + stats
+    return out.at[IDX["max_crossings"]].set(
+        jnp.maximum(acc[IDX["max_crossings"]], stats[IDX["max_crossings"]])
+    )
+
+
+def merge_megastep_integrity(acc, integ):
+    """Fold one fused move's integrity vector into the megastep
+    reduction (integrity/invariants.py field order): the conservation
+    sums and lane counts ADD across moves, the per-lane residual MAXES,
+    and ``bad_flux`` reflects the final accumulator."""
+    from ..integrity.invariants import IIDX as II
+
+    out = acc + integ
+    out = out.at[II["max_residual"]].set(
+        jnp.maximum(acc[II["max_residual"]], integ[II["max_residual"]])
+    )
+    return out.at[II["bad_flux"]].set(integ[II["bad_flux"]])
+
+
+def megastep_impl(
+    mesh,
+    origin,
+    elem,
+    material_id,
+    weight,
+    group,
+    alive,
+    pid,
+    flux,
+    move0,
+    rng_key,
+    sigma_t,
+    absorb_t,
+    prev_even=None,
+    conv_state=None,
+    *,
+    n_moves: int,
+    n_groups: int,
+    survival_weight: float,
+    downscatter: float,
+    eps_near: float,
+    max_crossings: int,
+    score_squares: bool = True,
+    tolerance: float = 1e-8,
+    compact_after: int | None = None,
+    compact_size: int | None = None,
+    compact_stages: tuple | None = None,
+    unroll: int = 1,
+    robust: bool = True,
+    tally_scatter: str = "auto",
+    gathers: str = "merged",
+    ledger: bool = True,
+    stats: bool = True,
+    integrity: bool = False,
+    rel_err_target: float = 0.05,
+    batch_moves: int = 1,
+) -> MegastepResult:
+    """Run ``n_moves`` complete device-sourced moves as ONE program.
+
+    Each fused move ``m = move0 + k``: re-source every alive lane with
+    counter-based RNG keyed by ``(rng_key, m, pid)`` (ops/source.py —
+    isotropic direction, exponential flight distance over the lane's
+    region Σt from ``sigma_t[class_id[elem]]``), walk it with the
+    standard fused tracer body (``trace_impl``), then apply the
+    collision/termination physics of models/transport.py's inner loop
+    (absorption survival weighting, downscatter, domain-escape
+    termination, Russian roulette). The per-move stats/integrity
+    vectors become per-megastep reductions (``merge_megastep_stats`` /
+    ``merge_megastep_integrity``); the convergence batch cadence counts
+    DEVICE moves (``conv_state`` folds once per fused move, exactly as
+    if each were a facade move).
+
+    ``move0`` is a device scalar (the facade's persistent move counter
+    — its ONE H2D per megastep); ``rng_key`` a device PRNG key the
+    facade stages once per seed (a runtime input, so re-seeding never
+    recompiles); ``pid`` is the device-resident particle-id lane
+    (``state.particle_id``), which keys the RNG so sampling is
+    invariant to slot layout. ``prev_even`` threads the
+    sd_mode="batch" snapshot (one squared per-bin delta folded per
+    fused move, the bench run_fused contract). Sampling runs for every
+    lane each move (dead lanes discard theirs) — the cost class of one
+    elementwise pass, and the price of layout-invariant streams.
+    """
+    from .source import apply_physics, sample_move
+    from .staging import pack_megastep_tail
+
+    dtype = origin.dtype
+    n = origin.shape[0]
+    base_key = rng_key
+    nclass = sigma_t.shape[0]
+    tiny = jnp.asarray(np.finfo(np.dtype(dtype)).tiny, dtype)
+    walk_kw = dict(
+        initial=False,
+        max_crossings=max_crossings,
+        score_squares=score_squares,
+        tolerance=tolerance,
+        compact_after=compact_after,
+        compact_size=compact_size,
+        compact_stages=compact_stages,
+        unroll=unroll,
+        robust=robust,
+        tally_scatter=tally_scatter,
+        gathers=gathers,
+        ledger=ledger,
+        stats=stats,
+        integrity=integrity,
+        n_groups=n_groups,
+        rel_err_target=rel_err_target,
+        batch_moves=batch_moves,
+    )
+    nseg_dtype = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+    zero_f = jnp.sum(weight) * 0  # device-varying scalar zero
+
+    def body(k, carry):
+        (origin, dest, elem, mat, weight, group, alive, flux, prev_even,
+         conv, sacc, iacc, cvec, pacc, nseg) = carry
+        m = move0 + k
+        region = mesh.class_id[jnp.clip(elem, 0, mesh.ntet - 1)]
+        sig = sigma_t[jnp.clip(region, 0, nclass - 1)]
+        direction, ell, coll_u, roul_u = sample_move(
+            base_key, m, pid, n, dtype
+        )
+        flight = direction * (ell / jnp.maximum(sig, tiny))[:, None]
+        dest = jnp.where(alive[:, None], origin + flight, origin)
+        r = trace_impl(
+            mesh, origin, dest, elem, alive, weight, group, mat, flux,
+            conv_state=conv, **walk_kw,
+        )
+        ab = absorb_t[
+            jnp.clip(
+                mesh.class_id[jnp.clip(r.elem, 0, mesh.ntet - 1)],
+                0, nclass - 1,
+            )
+        ]
+        weight, group, alive2, phys4 = apply_physics(
+            r.position, dest, r.done, r.material_id, weight, group,
+            alive, ab, coll_u, roul_u,
+            eps_near=eps_near,
+            survival_weight=survival_weight,
+            downscatter=downscatter,
+            n_groups=n_groups,
+        )
+        flux = r.flux
+        if prev_even is not None:
+            from ..core.tally import accumulate_batch_squares
+
+            flux, prev_even = accumulate_batch_squares(flux, prev_even)
+        if sacc is not None:
+            sacc = merge_megastep_stats(sacc, r.stats)
+        if iacc is not None:
+            iacc = merge_megastep_integrity(iacc, r.integrity)
+        if cvec is not None:
+            cvec = r.convergence
+        n_trunc = jnp.sum(alive & ~r.done).astype(dtype)
+        pacc = jnp.concatenate(
+            [
+                pacc[:4] + phys4,
+                jnp.sum(alive2).astype(dtype)[None],
+                pacc[5:6] + n_trunc[None],
+            ]
+        )
+        return (r.position, dest, r.elem, r.material_id, weight, group,
+                alive2, flux, prev_even, r.conv_state, sacc, iacc, cvec,
+                pacc, nseg + r.n_segments)
+
+    from ..integrity.invariants import INTEGRITY_LEN
+    from ..obs import WALK_STATS_LEN
+    from .source import MEGA_PHYS_LEN
+
+    sacc0 = jnp.zeros(WALK_STATS_LEN, nseg_dtype) if stats else None
+    iacc0 = (
+        jnp.zeros(INTEGRITY_LEN, dtype) + zero_f if integrity else None
+    )
+    cvec0 = None
+    if conv_state is not None:
+        from ..obs.convergence import CONV_LEN
+
+        cvec0 = jnp.zeros(CONV_LEN, dtype) + zero_f
+    pacc0 = jnp.zeros(MEGA_PHYS_LEN, dtype) + zero_f
+    carry = (origin, origin, elem, material_id, weight, group,
+             alive.astype(bool), flux, prev_even, conv_state, sacc0,
+             iacc0, cvec0, pacc0, jnp.zeros((), nseg_dtype))
+    (origin, dest, elem, mat, weight, group, alive, flux, prev_even,
+     conv, sacc, iacc, cvec, pacc, nseg) = jax.lax.fori_loop(
+        0, n_moves, body, carry
+    )
+    readback = pack_megastep_tail(sacc, nseg, iacc, cvec, pacc, dtype)
+    return MegastepResult(
+        position=origin,
+        dest=dest,
+        elem=elem,
+        material_id=mat,
+        weight=weight,
+        group=group,
+        alive=alive,
+        flux=flux,
+        readback=readback,
+        prev_even=prev_even,
+        conv_state=conv,
+    )
+
+
+_megastep_jit = jax.jit(
+    megastep_impl,
+    static_argnames=(
+        "n_moves",
+        "n_groups",
+        "survival_weight",
+        "downscatter",
+        "eps_near",
+        "max_crossings",
+        "score_squares",
+        "tolerance",
+        "compact_after",
+        "compact_size",
+        "compact_stages",
+        "unroll",
+        "robust",
+        "tally_scatter",
+        "gathers",
+        "ledger",
+        "stats",
+        "integrity",
+        "rel_err_target",
+        "batch_moves",
+    ),
+    # Donation matches the per-move trace exactly: the flux /
+    # convergence / batch-sd accumulators are donated (always
+    # device-produced chains), the per-lane STATE is not — after a
+    # checkpoint/rollback restore those arrays can zero-copy-alias the
+    # snapshot's host buffers on the CPU backend, and a donated alias
+    # would let XLA scribble over the retry anchor.
+    donate_argnames=("flux", "prev_even", "conv_state"),
+)
+
+
+def megastep(*args, **kwargs):
+    if kwargs.get("tally_scatter", "auto") == "auto":
+        kwargs = dict(
+            kwargs,
+            tally_scatter=resolve_tally_scatter(
+                "auto", kwargs.get("flux", args[8] if len(args) > 8 else None)
+            ),
+        )
+    return _megastep_jit(*args, **kwargs)
+
+
+megastep.__doc__ = megastep_impl.__doc__
+
+
+# --------------------------------------------------------------------- #
 # Truncated-lane escalation (resilience)
 # --------------------------------------------------------------------- #
 def merge_recorded_xpoints(xa, ka, xb, kb, rows_a, rows_b) -> None:

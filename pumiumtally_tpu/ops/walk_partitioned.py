@@ -580,6 +580,7 @@ def make_partitioned_step(
     convergence: bool = False,
     rel_err_target: float = 0.05,
     batch_moves: int = 1,
+    _jit: bool = True,
 ):
     """Build the jitted distributed trace step for one mesh partition.
 
@@ -1193,13 +1194,19 @@ def make_partitioned_step(
         )
 
     flux_ix = 6 + len(halo_tables) + 9
-    jitted = jax.jit(
-        mapped,
-        # The flux slab, plus (with convergence) the snapshot/Σbatch²
-        # slabs that immediately follow it.
-        donate_argnums=(flux_ix,)
-        + ((flux_ix + 1, flux_ix + 2) if convergence else ()),
-    )
+    if _jit:
+        jitted = jax.jit(
+            mapped,
+            # The flux slab, plus (with convergence) the snapshot/Σbatch²
+            # slabs that immediately follow it.
+            donate_argnums=(flux_ix,)
+            + ((flux_ix + 1, flux_ix + 2) if convergence else ()),
+        )
+    else:
+        # Raw (unjitted) mode for callers that INLINE the step into a
+        # larger compiled program (the megastep's scanned body): the
+        # outer jit owns compilation and donation.
+        jitted = mapped
 
     def step(cur, dest, elem, done, material_id, weight, group, pid, valid,
              flux, conv=None):
@@ -1217,6 +1224,297 @@ def make_partitioned_step(
         )
 
     return step
+
+
+# --------------------------------------------------------------------------- #
+# Megastep: K device-sourced moves (walk + migration + re-source) fused
+# into one compiled program.
+# --------------------------------------------------------------------------- #
+class PartitionedMegastepResult(NamedTuple):
+    """Outputs of one partitioned megastep dispatch. Per-slot state
+    ([n_parts*cap], sharded) stays device-resident between megasteps —
+    the facade re-binds it; only ``readback``
+    (staging.pack_partitioned_megastep_tail: per-chip stats/round/
+    segment counters, integrity partials, convergence partials, and the
+    replicated physics tail) is fetched, so a whole megastep is one H2D
+    (the move counter) and one D2H (this tail)."""
+
+    position: jax.Array
+    dest: jax.Array
+    elem: jax.Array
+    material_id: jax.Array
+    weight: jax.Array
+    group: jax.Array
+    particle_id: jax.Array
+    valid: jax.Array
+    alive: jax.Array
+    flux: jax.Array
+    readback: jax.Array
+    prev_even: jax.Array | None = None
+    conv_snap: jax.Array | None = None
+    conv_sumsq: jax.Array | None = None
+    conv_nb: jax.Array | None = None
+    conv_mv: jax.Array | None = None
+
+
+def make_partitioned_megastep(
+    device_mesh: Mesh,
+    partition: MeshPartition,
+    *,
+    n_moves: int,
+    n_total: int,
+    n_groups: int,
+    sigma_local: np.ndarray,
+    absorb_local: np.ndarray,
+    eps_near: float,
+    survival_weight: float,
+    downscatter: float,
+    dtype,
+    max_crossings: int = 4096,
+    max_rounds: int | None = None,
+    exchange_size: int | None = None,
+    tolerance: float = 1e-8,
+    score_squares: bool = True,
+    unroll: int = 1,
+    compact_after: int | None = None,
+    compact_size: int | None = None,
+    compact_stages: tuple | None = None,
+    followup_compact_size: int | None = None,
+    robust: bool = True,
+    tally_scatter: str = "auto",
+    integrity: bool = False,
+    convergence: bool = False,
+    rel_err_target: float = 0.05,
+    batch_moves: int = 1,
+):
+    """Build the jitted partitioned megastep: ``n_moves`` complete
+    moves — device re-source (ops/source.py, RNG keyed by (rng_key,
+    move, particle id) so sampling never depends on slot layout), the full
+    walk+migration+halo-fold pipeline of ``make_partitioned_step``
+    (inlined unjitted into the scanned body), and the collision/
+    termination physics — as ONE compiled program.
+
+    ``sigma_local``/``absorb_local`` are host [n_parts, max_local]
+    per-LOCAL-ELEMENT Σt / absorption rows (the facade derives them
+    from the region tables: sigma of a row = sigma of its class), so
+    the in-loop region lookup is one sharded gather. ``n_total`` is
+    the global particle count (the RNG stream width).
+
+    The alive flag needs no migration payload: dead lanes never walk
+    (their move starts done), so they never change slots, and every
+    immigrant was by definition walking — post-move,
+    ``alive[slot] = True where the slot's pid changed, else its prior
+    value``, then the physics update applies.
+
+    Returns ``mega(cur, elem, material_id, weight, group, pid, valid,
+    alive, flux, move0, rng_key[, conv_snap, conv_sumsq, conv_nb,
+    conv_mv][, prev_even]) -> PartitionedMegastepResult`` with every
+    per-particle array [n_parts*cap] sharded over the device axis,
+    ``move0`` a device int32 scalar (the facade's ONE H2D per
+    megastep), and ``rng_key`` a device PRNG key staged once per seed
+    (a runtime input — re-seeding never recompiles). Convergence folds once per fused move — the batch
+    cadence counts device moves. ``prev_even`` (a runtime input —
+    pass None to disable) threads the sd_mode="batch" per-chip
+    snapshot.
+    """
+    from ..core.tally import accumulate_batch_squares
+    from ..obs import IDX
+    from .source import apply_physics, sample_move
+    from .staging import pack_partitioned_megastep_tail
+
+    n_parts = partition.n_parts
+    max_local = partition.max_local
+    step = make_partitioned_step(
+        device_mesh,
+        partition,
+        n_groups=n_groups,
+        initial=False,
+        max_crossings=max_crossings,
+        max_rounds=max_rounds,
+        exchange_size=exchange_size,
+        tolerance=tolerance,
+        score_squares=score_squares,
+        unroll=unroll,
+        compact_after=compact_after,
+        compact_size=compact_size,
+        compact_stages=compact_stages,
+        followup_compact_size=followup_compact_size,
+        robust=robust,
+        tally_scatter=tally_scatter,
+        record_xpoints=None,
+        packed_io=False,
+        integrity=integrity,
+        convergence=convergence,
+        rel_err_target=rel_err_target,
+        batch_moves=batch_moves,
+        _jit=False,
+    )
+    sharding = NamedSharding(device_mesh, P(AXIS))
+    sigma_dev = jax.device_put(
+        jnp.asarray(np.asarray(sigma_local, np.float64).reshape(-1),
+                    dtype),
+        sharding,
+    )
+    absorb_dev = jax.device_put(
+        jnp.asarray(np.asarray(absorb_local, np.float64).reshape(-1),
+                    dtype),
+        sharding,
+    )
+    conv_on = (
+        jax.device_put(jnp.ones(n_parts, jnp.int32), sharding)
+        if convergence
+        else None
+    )
+    tiny = float(np.finfo(np.dtype(dtype)).tiny)
+    nseg_dtype = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+
+    def mega_impl(cur, elem, material_id, weight, group, pid, valid,
+                  alive, flux, move0, rng_key, conv_snap=None,
+                  conv_sumsq=None, conv_nb=None, conv_mv=None,
+                  prev_even=None):
+        N = cur.shape[0]
+        cap = N // n_parts
+        chip_base = (jnp.arange(N, dtype=jnp.int32) // cap) * max_local
+        base_key = rng_key
+
+        def body(k, carry):
+            (cur, dest, elem, mat, weight, group, pid, valid, alive,
+             flux, conv, prev_even, sacc, iacc, cvec, pacc, rounds,
+             dropped, nseg) = carry
+            m = move0 + k
+            sig = sigma_dev[
+                chip_base + jnp.clip(elem, 0, max_local - 1)
+            ]
+            direction, ell, coll_u, roul_u = sample_move(
+                base_key, m, pid, n_total, cur.dtype
+            )
+            flight = direction * (ell / jnp.maximum(sig, tiny))[:, None]
+            go = valid & alive
+            dest = jnp.where(go[:, None], cur + flight, cur)
+            res = step(
+                cur, dest, elem, ~go, mat, weight, group, pid, valid,
+                flux,
+                (conv + (conv_on,)) if conv is not None else None,
+            )
+            # Dead lanes never walk, so they never change slots; every
+            # immigrant was walking — a changed pid means alive.
+            alive_w = res.valid & jnp.where(
+                res.particle_id != pid, True, alive
+            )
+            ab = absorb_dev[
+                chip_base + jnp.clip(res.elem, 0, max_local - 1)
+            ]
+            weight2, group2, alive2, phys4 = apply_physics(
+                res.position, res.dest, res.done, res.material_id,
+                res.weight, res.group, alive_w, ab, coll_u, roul_u,
+                eps_near=eps_near,
+                survival_weight=survival_weight,
+                downscatter=downscatter,
+                n_groups=n_groups,
+            )
+            flux = res.flux
+            if prev_even is not None:
+                flux, prev_even = accumulate_batch_squares(
+                    flux, prev_even
+                )
+            # Per-megastep reductions of the per-chip tails: sums
+            # everywhere, max of max_crossings, truncated summed over
+            # the fused moves (walk.py merge_megastep_stats semantics).
+            s2 = sacc + res.stats
+            sacc = s2.at[:, IDX["max_crossings"]].set(
+                jnp.maximum(
+                    sacc[:, IDX["max_crossings"]],
+                    res.stats[:, IDX["max_crossings"]],
+                )
+            )
+            if iacc is not None:
+                # PART_INTEGRITY_FIELDS: bad_flux reflects the final
+                # accumulator; the slot counts add across moves.
+                iacc = jnp.concatenate(
+                    [
+                        res.integrity[:, :1],
+                        iacc[:, 1:] + res.integrity[:, 1:],
+                    ],
+                    axis=1,
+                )
+            if cvec is not None:
+                cvec = res.convergence
+                conv = (res.conv_snap, res.conv_sumsq, res.conv_nb,
+                        res.conv_mv)
+            n_trunc = jnp.sum(alive_w & ~res.done).astype(cur.dtype)
+            pacc = jnp.concatenate(
+                [
+                    pacc[:4] + phys4,
+                    jnp.sum(alive2).astype(cur.dtype)[None],
+                    pacc[5:6] + n_trunc[None],
+                ]
+            )
+            return (res.position, res.dest, res.elem, res.material_id,
+                    weight2, group2, res.particle_id, res.valid, alive2,
+                    flux, conv, prev_even, sacc, iacc, cvec, pacc,
+                    rounds + res.n_rounds, dropped + res.n_dropped,
+                    nseg + res.n_segments)
+
+        conv0 = (
+            (conv_snap, conv_sumsq, conv_nb, conv_mv)
+            if convergence
+            else None
+        )
+        from ..integrity.invariants import PART_INTEGRITY_LEN
+        from ..obs import WALK_STATS_LEN
+        from .source import MEGA_PHYS_LEN
+
+        sacc0 = jnp.zeros((n_parts, WALK_STATS_LEN), nseg_dtype)
+        iacc0 = (
+            jnp.zeros((n_parts, PART_INTEGRITY_LEN), nseg_dtype)
+            if integrity else None
+        )
+        cvec0 = None
+        if convergence:
+            from ..obs.convergence import CONV_LEN
+
+            cvec0 = jnp.zeros((n_parts, CONV_LEN), cur.dtype)
+        pacc0 = jnp.zeros(MEGA_PHYS_LEN, cur.dtype)
+        zero_pc = jnp.zeros(n_parts, nseg_dtype)
+        carry = (cur, cur, elem, material_id, weight, group, pid, valid,
+                 alive.astype(bool), flux, conv0, prev_even, sacc0,
+                 iacc0, cvec0, pacc0, zero_pc, zero_pc, zero_pc)
+        (cur, dest, elem, mat, weight, group, pid, valid, alive, flux,
+         conv, prev_even, sacc, iacc, cvec, pacc, rounds, dropped,
+         nseg) = jax.lax.fori_loop(0, n_moves, body, carry)
+        readback = pack_partitioned_megastep_tail(
+            sacc, rounds, dropped, nseg, iacc, cvec, pacc, dtype
+        )
+        cs, css, cnb, cmv = conv if conv is not None else (None,) * 4
+        return PartitionedMegastepResult(
+            position=cur,
+            dest=dest,
+            elem=elem,
+            material_id=mat,
+            weight=weight,
+            group=group,
+            particle_id=pid,
+            valid=valid,
+            alive=alive,
+            flux=flux,
+            readback=readback,
+            prev_even=prev_even,
+            conv_snap=cs,
+            conv_sumsq=css,
+            conv_nb=cnb,
+            conv_mv=cmv,
+        )
+
+    return jax.jit(
+        mega_impl,
+        # Donation matches the per-move partitioned step exactly: the
+        # flux / convergence / batch-sd slabs are donated, the per-slot
+        # state is NOT — after a checkpoint restore those arrays can
+        # zero-copy-alias the snapshot's host buffers on the CPU
+        # backend, and a donated alias would let XLA scribble over the
+        # retry anchor (ops/walk.py megastep has the same contract).
+        donate_argnames=("flux", "conv_snap", "conv_sumsq", "prev_even"),
+    )
 
 
 # --------------------------------------------------------------------------- #

@@ -229,6 +229,19 @@ class PartitionedTally:
         self.total_rounds = 0
         self._initialized = False
         self._last_xpoints: tuple | None = None
+        # Device-sourced move loop (run_source_moves): persistent host
+        # physics lanes (weights/groups/alive, pid order — the per-move
+        # facade takes these per call, the megastep carries them), the
+        # device-resident slot-state cache, and the compiled megastep
+        # program cache. The slot state lives on DEVICE between
+        # megasteps — the 1 H2D + 1 D2H per K moves contract — and is
+        # folded back to the host mirrors at every read surface
+        # (_sync_source_state).
+        self.weights = np.ones(self.num_particles)
+        self.groups = np.zeros(self.num_particles, np.int32)
+        self.alive = np.ones(self.num_particles, bool)
+        self._src: dict | None = None
+        self._mega_progs: dict = {}
         # Bad-particle quarantine (resilience/quarantine.py): same
         # contract as PumiTally — parked, counted, reported per-lane.
         self._quarantined: np.ndarray | None = None
@@ -373,7 +386,7 @@ class PartitionedTally:
         for fold in pending:
             fold()
 
-    def _dispatch(self, fn, move: int):
+    def _dispatch(self, fn, move: int, kind: str | None = None):
         """Partitioned-step dispatch + blocking readback under the
         watchdog deadline — the PumiTally._dispatch contract (the
         closure is mutation-free; a timed-out dispatch is abandoned and
@@ -382,7 +395,7 @@ class PartitionedTally:
         includes XLA compilation)."""
         if self.config.move_deadline_s is None:
             return fn()
-        key = "init" if move == 0 else "move"
+        key = kind or ("init" if move == 0 else "move")
         warm = getattr(self, "_watchdog_warm", None)
         if warm is None:
             warm = self._watchdog_warm = set()
@@ -545,6 +558,10 @@ class PartitionedTally:
         ).inc(kind="bitflip_flux")
 
     def _run(self, dest, in_flight, weight, group, initial):
+        # The per-move path owns the host-resident state contract: any
+        # device-resident megastep slot state must fold back first.
+        if self._src is not None:
+            self._drop_source_state()
         field = (
             "initialization_time" if initial else "total_time_to_tally"
         )
@@ -918,6 +935,354 @@ class PartitionedTally:
         self.total_segments += agg["segments"]
         self.total_rounds += n_rounds
         return got, stats
+
+    # ------------------------------------------------------------------ #
+    # Megastep: device-sourced fused move loop
+    # (ops/walk_partitioned.py make_partitioned_megastep)
+    # ------------------------------------------------------------------ #
+    def _ensure_source_state(self, weights, groups, alive) -> None:
+        """Install caller-provided physics lanes (dropping any stale
+        device cache) and build the device-resident slot state from the
+        host mirrors when absent — ONE distribute, cold path; the
+        steady-state megastep stages only the move counter."""
+        n = self.num_particles
+        if self._src is not None and any(
+            a is not None for a in (weights, groups, alive)
+        ):
+            # Re-staging SOME lanes must not rewind the others: fold the
+            # live device slot state back into the host mirrors first so
+            # the rebuild below continues from the current positions /
+            # elements and any omitted physics lane (the distributed
+            # equivalent of PumiTally._stage_source_lanes, which
+            # replaces only the given lanes in live device state).
+            self._sync_source_state()
+        if weights is not None:
+            self.weights = np.asarray(
+                weights, np.float64
+            ).reshape(-1)[:n].copy()
+            self._src = None
+        if groups is not None:
+            g = np.asarray(groups, np.int32).reshape(-1)[:n]
+            _check_group_range(g, self.config.n_groups)
+            self.groups = g.copy()
+            self._src = None
+        if alive is not None:
+            self.alive = np.asarray(
+                alive
+            ).astype(bool).reshape(-1)[:n].copy()
+            self._src = None
+        if self._src is not None:
+            return
+        placed = distribute_particles(
+            self.partition,
+            self.device_mesh,
+            self.elem_global,
+            dict(
+                origin=self.positions,
+                dest=self.positions,
+                weight=self.weights,
+                group=self.groups,
+                material_id=self.material_id,
+            ),
+            cap=self.cap,
+        )
+        pid_h = np.asarray(placed["particle_id"])
+        alive_slot = np.zeros(pid_h.shape[0], bool)
+        sel = pid_h >= 0
+        alive_slot[sel] = self.alive[pid_h[sel]]
+        sh = NamedSharding(self.device_mesh, P(AXIS))
+        self._src = {
+            "pos": placed["origin"].astype(self.config.dtype),
+            "elem": placed["elem"],
+            "material_id": placed["material_id"],
+            "weight": placed["weight"].astype(self.config.dtype),
+            "group": placed["group"],
+            "pid": placed["particle_id"],
+            "valid": placed["valid"],
+            "alive": jax.device_put(jnp.asarray(alive_slot), sh),
+        }
+
+    def _sync_source_state(self) -> None:
+        """Fold the device-resident slot state back into the host
+        mirrors (positions/elem_global/material_id/weights/groups/
+        alive) — the read-surface/checkpoint contract; the device cache
+        stays live for the next megastep."""
+        if self._src is None:
+            return
+        src = self._src
+        pid = np.asarray(src["pid"])
+        valid = np.asarray(src["valid"])
+        sel = valid & (pid >= 0)
+        idx = pid[sel]
+        self.positions[idx] = np.asarray(src["pos"], np.float64)[sel]
+        self.material_id[idx] = np.asarray(src["material_id"])[sel]
+        self.weights[idx] = np.asarray(src["weight"], np.float64)[sel]
+        self.groups[idx] = np.asarray(src["group"])[sel]
+        alive = np.zeros(self.num_particles, bool)
+        alive[idx] = np.asarray(src["alive"])[sel]
+        self.alive = alive
+        cap = pid.shape[0] // self.n_parts
+        chip = (np.arange(pid.shape[0]) // cap)[sel]
+        self.elem_global[idx] = self.partition.local2global[
+            chip, np.asarray(src["elem"])[sel]
+        ]
+
+    def _drop_source_state(self) -> None:
+        """Sync + invalidate the device slot cache (the per-move path
+        and cross-layout restores own the host-resident contract)."""
+        self._sync_source_state()
+        self._src = None
+
+    def _rng_key(self, seed: int):
+        """Device PRNG key for one source seed, staged once (cold) and
+        reused by every megastep dispatch of that stream. Placed
+        REPLICATED across the device mesh explicitly — an uncommitted
+        single-device key would be re-replicated on every dispatch,
+        which jax.transfer_guard rightly flags."""
+        from ..ops.source import staged_rng_key
+
+        self._rng_key_cache = staged_rng_key(
+            seed, getattr(self, "_rng_key_cache", None),
+            put=lambda k: jax.device_put(
+                k, NamedSharding(self.device_mesh, P())
+            ),
+        )
+        return self._rng_key_cache[1]
+
+    def _mega_prog(self, src, k: int):
+        """Compiled megastep program for (source physics, chunk size) —
+        built once per distinct pair (at most two chunk sizes per run:
+        K and the remainder; the RNG seed is a runtime input and never
+        forces a rebuild)."""
+        key = (src.physics_key(), int(k))
+        if key not in self._mega_progs:
+            from ..ops.source import near_epsilon
+            from ..ops.walk_partitioned import make_partitioned_megastep
+
+            cfg = self.config
+            sig, ab = src.tables(np.asarray(self.mesh.class_id))
+            l2g = np.clip(
+                np.asarray(self.partition.local2global), 0,
+                self.mesh.ntet - 1,
+            )
+            cls_local = np.asarray(self.mesh.class_id)[l2g]
+            cls_local = np.clip(cls_local, 0, sig.shape[0] - 1)
+            kw = dict(self._step_kwargs)
+            for dup in ("record_xpoints", "integrity", "convergence",
+                        "n_groups"):
+                kw.pop(dup, None)
+            self._mega_progs[key] = make_partitioned_megastep(
+                self.device_mesh,
+                self.partition,
+                n_moves=int(k),
+                n_total=self.num_particles,
+                n_groups=cfg.n_groups,
+                sigma_local=sig[cls_local],
+                absorb_local=ab[cls_local],
+                eps_near=near_epsilon(np.asarray(self.mesh.coords)),
+                survival_weight=float(src.survival_weight),
+                downscatter=float(src.downscatter),
+                dtype=cfg.dtype,
+                integrity=self._integrity != "off",
+                convergence=self._conv is not None,
+                **kw,
+            )
+        return self._mega_progs[key]
+
+    def run_source_moves(
+        self,
+        n_moves: int,
+        source=None,
+        weights: np.ndarray | None = None,
+        groups: np.ndarray | None = None,
+        alive: np.ndarray | None = None,
+    ) -> dict:
+        """Run ``n_moves`` DEVICE-SOURCED moves over the partitioned
+        walk — the PumiTally.run_source_moves contract with migration
+        rolled into the scanned body: each dispatch fuses
+        ``TallyConfig(megastep=K)`` complete moves (re-source → walk →
+        migrate/halo-fold → physics), so the host performs ONE H2D (the
+        move counter) and ONE D2H (the per-chip tails) per K moves.
+        Slot state stays device-resident between megasteps and is
+        folded back to the host mirrors at every read surface; RNG
+        streams are keyed by (seed, move, particle id), so results are
+        bitwise identical for any K and across checkpoint restores of
+        the same partition layout. Shadow audits, truncation re-walks
+        and the host-side per-lane conservation check are per-move-
+        facade features and do not ride the megastep (the on-device
+        flux invariant still does)."""
+        assert self._initialized, (
+            "initialize_particle_location must run before source moves"
+        )
+        cfg = self.config
+        if cfg.record_xpoints is not None or cfg.checkify_invariants:
+            raise NotImplementedError(
+                "run_source_moves needs the packed megastep program; "
+                "record_xpoints / checkify_invariants require the "
+                "per-move facade path"
+            )
+        from ..ops import staging
+        from ..ops.source import SourceParams, phys_to_dict
+
+        src = source if source is not None else SourceParams()
+        K = cfg.resolve_megastep()
+        rng_key = self._rng_key(src.seed)
+        stage_io = dict(h2d_bytes=0, h2d_transfers=0)
+        if self._src is None or any(
+            a is not None for a in (weights, groups, alive)
+        ):
+            self._ensure_source_state(weights, groups, alive)
+            stage_io = dict(
+                h2d_bytes=sum(
+                    # jax.Array.nbytes is metadata — np.asarray here
+                    # would force a full D2H of every slot array just
+                    # to read sizes.
+                    int(v.nbytes) for v in self._src.values()
+                ),
+                h2d_transfers=len(self._src),
+            )
+        totals = {
+            "moves": 0, "segments": 0, "collisions": 0, "escaped": 0,
+            "rouletted": 0, "absorbed_weight": 0.0, "alive": 0,
+            "truncated": 0,
+        }
+        done_moves = 0
+        while done_moves < n_moves:
+            k = min(K, n_moves - done_moves)
+            mega = self._mega_prog(src, k)
+            t_before = self.tally_times.total_time_to_tally
+            with annotate("PartitionedTally.run_source_moves"), \
+                    phase_timer(
+                        self.tally_times, "total_time_to_tally", True
+                    ) as timer:
+                s = self._src
+                # Replicated placement up front: the megastep's ONE H2D
+                # per dispatch (an uncommitted scalar would trigger a
+                # per-call device-to-device re-replication instead).
+                move0 = jax.device_put(
+                    np.int32(self.iter_count),
+                    NamedSharding(self.device_mesh, P()),
+                )
+                io = dict(
+                    h2d_bytes=4 + stage_io.pop("h2d_bytes", 0),
+                    h2d_transfers=1 + stage_io.pop("h2d_transfers", 0),
+                    d2h_bytes=0, d2h_transfers=0,
+                )
+                stage_io = {}
+                flux_in, conv_in = self.flux_slabs, self._conv
+                prev_in = self._prev_even
+                conv_args = (
+                    tuple(conv_in) if conv_in is not None else ()
+                )
+
+                def _go():
+                    res = mega(
+                        s["pos"], s["elem"], s["material_id"],
+                        s["weight"], s["group"], s["pid"], s["valid"],
+                        s["alive"], flux_in, move0, rng_key,
+                        *conv_args, prev_even=prev_in,
+                    )
+                    return res, jax.device_get(res.readback)
+
+                # Amnesty key includes k: _mega_prog caches one compiled
+                # program per chunk length, so the remainder chunk's
+                # compile must not run under an armed steady-state
+                # deadline.
+                res, host_rb = self._dispatch(
+                    _go, self.iter_count + 1, kind=f"megastep:{k}"
+                )
+                self.flux_slabs = res.flux
+                if self._conv is not None:
+                    self._conv = (
+                        res.conv_snap, res.conv_sumsq, res.conv_nb,
+                        res.conv_mv,
+                    )
+                if self._prev_even is not None:
+                    self._prev_even = res.prev_even
+                self._src = {
+                    "pos": res.position,
+                    "elem": res.elem,
+                    "material_id": res.material_id,
+                    "weight": res.weight,
+                    "group": res.group,
+                    "pid": res.particle_id,
+                    "valid": res.valid,
+                    "alive": res.alive,
+                }
+                self.iter_count += k
+                io["d2h_bytes"] += int(host_rb.nbytes)
+                io["d2h_transfers"] += 1
+                parsed = staging.split_partitioned_megastep_tail(
+                    host_rb, cfg.dtype,
+                    integrity=self._integrity != "off",
+                    convergence=self._conv is not None,
+                )
+                agg = reduce_chip_stats(parsed["stats"])
+                n_rounds = int(parsed["n_rounds"][0])
+                n_dropped = int(parsed["n_dropped"].sum())
+                if n_dropped:
+                    raise RuntimeError(
+                        "partitioned megastep dropped immigrants: "
+                        "raise cap"
+                    )
+                segs = agg["segments"]
+                self.total_segments += segs
+                self.total_rounds += n_rounds
+                p = phys_to_dict(parsed["phys"])
+                if p["truncated"]:
+                    warnings.warn(
+                        f"{p['truncated']} fused-move walk(s) truncated "
+                        "inside the megastep (max_crossings or the "
+                        "round bound); the lanes stay alive and "
+                        "continue next move, but their tallies for the "
+                        "truncated move are incomplete.",
+                        RuntimeWarning,
+                        stacklevel=3,
+                    )
+                if "integrity" in parsed:
+                    from ..integrity import policy
+
+                    ivec = np.asarray(parsed["integrity"], np.int64)
+                    fields = {
+                        "bad_flux": int(ivec[:, 0].sum()),
+                        "lanes_done": int(ivec[:, 2].sum()),
+                    }
+                    violations = (
+                        ["flux"] if fields["bad_flux"] > 0 else []
+                    )
+                    self._telemetry.record_integrity(
+                        self.iter_count, fields, violations
+                    )
+                    policy.escalate(
+                        self._integrity, violations, self.iter_count
+                    )
+                self._maybe_inject_bitflip(self.iter_count)
+                if cfg.measure_time:
+                    timer.sync(self.flux_slabs)
+            self.tally_times.n_moves += k
+            seconds = self.tally_times.total_time_to_tally - t_before
+            self._telemetry.record_walk(
+                "megastep", self.iter_count, agg,
+                seconds=seconds, synced=cfg.measure_time, moves=k,
+                rounds=n_rounds, collisions=p["collisions"],
+                escaped=p["escaped"], rouletted=p["rouletted"],
+                alive=p["alive"], **io,
+            )
+            if self._monitor is not None and "convergence" in parsed:
+                self._monitor.update(
+                    reduce_chip_conv(parsed["convergence"]),
+                    self.tally_times.total_time_to_tally,
+                )
+            totals["moves"] += k
+            totals["segments"] += segs
+            for f in ("collisions", "escaped", "rouletted", "truncated"):
+                totals[f] += p[f]
+            totals["absorbed_weight"] += p["absorbed_weight"]
+            totals["alive"] = p["alive"]
+            done_moves += k
+            if p["alive"] == 0:
+                break
+        return totals
 
     # ------------------------------------------------------------------ #
     def initialize_particle_location(

@@ -193,27 +193,10 @@ class ResilientRunner:
             self._c_fault.inc(n_nan, kind="nan_src")
         self._in_move = True
         try:
-            try:
-                self._move_with_retry(
-                    move, particle_destinations, flying, weights, groups,
-                    material_ids, size,
-                )
-            except FatalIntegrityViolation:
-                # integrity="halt": flush the last GOOD generation —
-                # never the suspect post-violation state — so the
-                # campaign can be resumed from verified data, then let
-                # the halt propagate.
-                if self._good is not None:
-                    restore_state(self.tally, self._good)
-                    try:
-                        path = self.checkpoint()
-                        log_warn(
-                            f"integrity halt at move {move}: flushed "
-                            f"last-good checkpoint {path} before raising"
-                        )
-                    except Exception as e:  # pragma: no cover
-                        log_warn(f"integrity-halt flush failed: {e}")
-                raise
+            self._move_with_retry(
+                move, particle_destinations, flying, weights, groups,
+                material_ids, size,
+            )
             if self._want_snapshot():
                 self._good = snapshot_state(self.tally)
             self._maybe_checkpoint()
@@ -229,31 +212,87 @@ class ResilientRunner:
                 sig, self._pending_signal = self._pending_signal, None
                 self._on_signal(sig, None)
 
-    def _move_with_retry(
-        self, move, particle_destinations, flying, weights, groups,
-        material_ids, size,
-    ) -> None:
+    def run_source_moves(self, n_moves, source=None, **kwargs) -> dict:
+        """Supervised device-sourced move loop: the tally's
+        ``run_source_moves`` under the same transient-retry /
+        last-good-rollback / cadence-checkpoint contract as
+        ``move_to_next_location``, at MEGASTEP granularity — the call
+        is chunked into megastep-K dispatches with the snapshot +
+        cadence-checkpoint step BETWEEN dispatches, so a long call
+        (n_moves ≫ K) still bounds the retry-replay window and the
+        preemption loss window to one megastep. A transient failure
+        rolls the in-flight megastep back to the last good snapshot
+        and replays it (bitwise identical: the RNG stream is keyed by
+        the persisted move counter). There are no out-params to re-arm
+        — the megastep's inputs are device-resident state the rollback
+        rebuilds. ``weights``/``groups``/``alive`` re-stage on the
+        FIRST chunk only; later chunks continue from device state,
+        exactly like the facade's own internal chunking."""
+        k = self.tally.config.resolve_megastep()
+        totals = {
+            "moves": 0, "segments": 0, "collisions": 0, "escaped": 0,
+            "rouletted": 0, "absorbed_weight": 0.0, "alive": 0,
+            "truncated": 0,
+        }
+        done = 0
+        first = True
+        self._in_move = True
+        try:
+            while done < int(n_moves):
+                chunk = min(k, int(n_moves) - done)
+                move = self.tally.iter_count + 1
+                self.faults.maybe_die(move)
+                out = self._source_chunk_with_retry(
+                    move, chunk, source, kwargs if first else {}
+                )
+                first = False
+                done += chunk
+                for f in ("moves", "segments", "collisions", "escaped",
+                          "rouletted", "truncated"):
+                    totals[f] += out[f]
+                totals["absorbed_weight"] += out["absorbed_weight"]
+                totals["alive"] = out["alive"]
+                if self._want_snapshot():
+                    self._good = snapshot_state(self.tally)
+                self._maybe_checkpoint()
+                if out["alive"] == 0 or self._pending_signal is not None:
+                    break
+            return totals
+        finally:
+            self._in_move = False
+            if self._pending_signal is not None:
+                sig, self._pending_signal = self._pending_signal, None
+                self._on_signal(sig, None)
+
+    def _retry_loop(self, what: str, body, rearm=None):
+        """Shared escalation skeleton for one supervised dispatch: a
+        fatal integrity halt flushes the last GOOD generation before
+        propagating, and RETRYABLE failures roll back to the last good
+        snapshot and replay with bounded exponential backoff. ``rearm``
+        re-seeds caller-owned inputs the dispatch may have mutated
+        before failing. The per-move and megastep paths share this so
+        the two resilience contracts cannot drift apart."""
         attempt = 0
-        # The facade mutates the caller's out-params (copy-back writes
-        # dest/material_ids, zeroes flying) BEFORE its last device
-        # fetches can fail — a retry must re-see the ORIGINAL inputs or
-        # it would walk zero particles and silently drop the move.
-        saved = (
-            tuple(
-                np.array(a, copy=True)
-                for a in (particle_destinations, flying, material_ids)
-            )
-            if self._good is not None
-            else None
-        )
         while True:
             try:
-                self.faults.maybe_transient(move)
-                self.tally.move_to_next_location(
-                    particle_destinations, flying, weights, groups,
-                    material_ids, size,
-                )
-                break
+                return body()
+            except FatalIntegrityViolation:
+                # integrity="halt": flush the last GOOD generation —
+                # never the suspect post-violation state — so the
+                # campaign can be resumed from verified data, then let
+                # the halt propagate.
+                if self._good is not None:
+                    restore_state(self.tally, self._good)
+                    try:
+                        path = self.checkpoint()
+                        log_warn(
+                            f"integrity halt in {what}: flushed "
+                            f"last-good checkpoint {path} before "
+                            f"raising"
+                        )
+                    except Exception as e:  # pragma: no cover
+                        log_warn(f"integrity-halt flush failed: {e}")
+                raise
             except RETRYABLE as e:
                 attempt += 1
                 if isinstance(e, InjectedTransientFault):
@@ -271,17 +310,58 @@ class ResilientRunner:
                     self.backoff_max,
                 )
                 log_warn(
-                    f"move {move} failed transiently ({e}); restoring "
+                    f"{what} failed transiently ({e}); restoring "
                     f"last good state and retrying in {delay:.2f}s "
                     f"(attempt {attempt}/{self.max_retries})"
                 )
                 restore_state(self.tally, self._good)
-                for dst, src in zip(
-                    (particle_destinations, flying, material_ids),
-                    saved, strict=True,
-                ):
-                    np.copyto(np.asarray(dst), src)
+                if rearm is not None:
+                    rearm()
                 self._sleep(delay)
+
+    def _source_chunk_with_retry(
+        self, move, chunk, source, kwargs
+    ) -> dict:
+        def body():
+            self.faults.maybe_transient(move)
+            return self.tally.run_source_moves(chunk, source, **kwargs)
+
+        # No out-params to re-arm: the megastep's inputs are
+        # device-resident state the rollback rebuilds.
+        return self._retry_loop(f"megastep at move {move}", body)
+
+    def _move_with_retry(
+        self, move, particle_destinations, flying, weights, groups,
+        material_ids, size,
+    ) -> None:
+        # The facade mutates the caller's out-params (copy-back writes
+        # dest/material_ids, zeroes flying) BEFORE its last device
+        # fetches can fail — a retry must re-see the ORIGINAL inputs or
+        # it would walk zero particles and silently drop the move.
+        saved = (
+            tuple(
+                np.array(a, copy=True)
+                for a in (particle_destinations, flying, material_ids)
+            )
+            if self._good is not None
+            else None
+        )
+
+        def body():
+            self.faults.maybe_transient(move)
+            self.tally.move_to_next_location(
+                particle_destinations, flying, weights, groups,
+                material_ids, size,
+            )
+
+        def rearm():
+            for dst, src in zip(
+                (particle_destinations, flying, material_ids),
+                saved, strict=True,
+            ):
+                np.copyto(np.asarray(dst), src)
+
+        self._retry_loop(f"move {move}", body, rearm)
 
     def _want_snapshot(self) -> bool:
         return (

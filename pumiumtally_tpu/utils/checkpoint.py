@@ -384,6 +384,14 @@ def _apply_quarantined(tally, arrays: dict) -> None:
 # Partitioned facade payload
 # --------------------------------------------------------------------- #
 def _partitioned_payload(tally) -> tuple[dict, dict]:
+    # Device-sourced megastep state folds back to the host mirrors
+    # first (run_source_moves keeps slot state device-resident between
+    # dispatches); the slot layout itself is ALSO persisted below so a
+    # same-layout restore resumes bitwise (re-distributing from the
+    # per-particle fields would re-bucket slots and change the flux
+    # summation order).
+    if getattr(tally, "_src", None) is not None:
+        tally._sync_source_state()
     meta = {
         "format_version": FORMAT_VERSION,
         "kind": "partitioned",
@@ -410,6 +418,17 @@ def _partitioned_payload(tally) -> tuple[dict, dict]:
             else np.empty(0, np.int64)
         ),
     }
+    if hasattr(tally, "weights"):
+        # Persistent physics lanes of the device-sourced move loop.
+        arrays["weights"] = np.asarray(tally.weights).copy()
+        arrays["groups"] = np.asarray(tally.groups).copy()
+        arrays["alive"] = np.asarray(tally.alive).copy()
+    if getattr(tally, "_src", None) is not None:
+        meta["src_layout"] = [int(tally.n_parts), int(tally.cap)]
+        for name, arr in tally._src.items():
+            arrays[f"src_{name}"] = np.array(
+                np.asarray(arr), copy=True
+            )
     return meta, arrays
 
 
@@ -433,6 +452,32 @@ def _apply_partitioned(tally, meta: dict, arrays: dict) -> None:
     tally.positions = np.asarray(arrays["positions"]).copy()
     tally.elem_global = np.asarray(arrays["elem_global"]).copy()
     tally.material_id = np.asarray(arrays["material_id"]).copy()
+    if hasattr(tally, "weights") and "weights" in arrays:
+        tally.weights = np.asarray(arrays["weights"], np.float64).copy()
+        tally.groups = np.asarray(arrays["groups"], np.int32).copy()
+        tally.alive = np.asarray(arrays["alive"]).astype(bool).copy()
+    if hasattr(tally, "_src"):
+        # Megastep slot state: rebuild the exact device layout when the
+        # checkpoint's partition shape matches (bitwise resume of the
+        # device-sourced loop); otherwise drop it — the next
+        # run_source_moves re-distributes from the per-particle fields
+        # (correct, but the flux summation order may differ).
+        layout = meta.get("src_layout")
+        if layout is not None and layout == [
+            int(tally.n_parts), int(tally.cap)
+        ]:
+            sh = NamedSharding(tally.device_mesh, P(PARTICLE_AXIS))
+            dtype = tally.config.dtype
+            src = {}
+            for name in ("pos", "elem", "material_id", "weight",
+                         "group", "pid", "valid", "alive"):
+                arr = jnp.asarray(arrays[f"src_{name}"])
+                if name in ("pos", "weight"):
+                    arr = arr.astype(dtype)
+                src[name] = jax.device_put(arr, sh)
+            tally._src = src
+        else:
+            tally._src = None
     tally.iter_count = int(meta["iter_count"])
     tally.total_segments = int(meta["total_segments"])
     tally.total_rounds = int(meta["total_rounds"])

@@ -234,6 +234,26 @@ class TallyConfig:
         True (default 0.95; at least 2 completed batches are always
         required — before that every scored bin reports rel-err 1).
 
+    megastep: moves fused per dispatch on the DEVICE-SOURCED move loop
+        (``run_source_moves`` on both facades; ops/walk.py ``megastep``
+        / ops/walk_partitioned.py ``make_partitioned_megastep``).  Each
+        dispatch runs K complete moves — re-source (counter-based RNG
+        keyed by (seed, move): isotropic direction, exponential flight
+        distance from the per-region Σt table), walk (with migration
+        rolled into the scanned body on the partitioned facade), and
+        collision/roulette physics — as ONE compiled program, so the
+        host performs 1 H2D + 1 D2H per K moves instead of per move.
+        RNG streams are keyed by (seed, move, particle id), so
+        megastep=K is bitwise identical to K megastep=1 dispatches
+        (pinned by tests/test_megastep.py).  None (the default) means
+        K=1 — per-dispatch moves, still device-sourced.  The OpenMC-
+        facade ``move_to_next_location`` path is never affected: its
+        destinations come from the caller, per the reference's
+        per-advance-event contract.  Env override ``PUMI_TPU_MEGASTEP``
+        beats the field (the CI faults step drives it).  Self-driven
+        runs (models/transport.py, models/depletion.py, bench.py)
+        default to megastep mode.
+
     Scope: ``ledger`` and ``gathers`` are honored by the single-chip and
     streaming-pipeline walks only. The partitioned walk
     (ops/walk_partitioned.py) always accumulates and migrates the ledger
@@ -279,6 +299,23 @@ class TallyConfig:
     rel_err_target: float = 0.05
     batch_moves: int | None = None
     converged_fraction: float = 0.95
+    megastep: int | None = None
+
+    def resolve_megastep(self) -> int:
+        """Effective moves-per-dispatch K for the device-sourced move
+        loop (``run_source_moves``): the ``PUMI_TPU_MEGASTEP`` env
+        override beats the field; unset means 1 (one dispatch per
+        move)."""
+        env = os.environ.get("PUMI_TPU_MEGASTEP")
+        if env:
+            k = int(env)
+        elif self.megastep is not None:
+            k = int(self.megastep)
+        else:
+            k = 1
+        if k < 1:
+            raise ValueError(f"megastep must be >= 1: {k}")
+        return k
 
     def resolve_integrity(self) -> str:
         """Validate and return the self-verification mode

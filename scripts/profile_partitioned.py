@@ -170,6 +170,46 @@ def main():
     sq1_s, _, _ = time_step(
         None, span="profile:full_nosq", score_squares=False
     )
+
+    # Megastep phase: the SAME batch driven through the partitioned
+    # facade's device-sourced fused loop (run_source_moves, M moves in
+    # ONE dispatch, mean flight length matched to the per-move rows via
+    # Σt = 1/0.08). The per-move-normalized ratio against the single
+    # walk is the tentpole's ≤2x acceptance metric: megastep removes
+    # the per-move Python dispatch + distribute/collect host folds that
+    # dominate full_over_single.
+    from pumiumtally_tpu.ops.source import SourceParams
+    from pumiumtally_tpu.parallel.partitioned_api import PartitionedTally
+    from pumiumtally_tpu.utils.config import TallyConfig
+
+    mm = int(os.environ.get("PROFILE_MEGASTEP", "4"))
+    mt = PartitionedTally(
+        mesh, n,
+        TallyConfig(
+            n_groups=n_groups, dtype=dtype, tolerance=1e-6, unroll=8,
+            megastep=mm,
+        ),
+        n_parts=n_dev, halo_layers=halo,
+    )
+    mt.initialize_particle_location(
+        np.ascontiguousarray(origin, np.float64).ravel()
+    )
+    msrc = SourceParams(default_sigma_t=1.0 / 0.08, seed=0)
+    ones = np.ones(n)
+    mt.run_source_moves(mm, msrc, weights=ones)  # warm/compile
+    mseg0 = mt.total_segments
+    # The warm call absorbs/roulettes lanes; the timed call must walk
+    # the SAME full-n population as the per-move rows it is divided by,
+    # so re-stage unit weights + all-alive (the batch-start cost,
+    # amortized over the mm fused moves — bench.py's megastep row uses
+    # the same accounting).
+    t0 = time.perf_counter()
+    with annotate("profile:full_megastep"):
+        mt.run_source_moves(
+            mm, msrc, weights=ones, alive=np.ones(n, bool)
+        )
+    mega_s = time.perf_counter() - t0
+    mega_seg = mt.total_segments - mseg0
     _ts.close()
 
     rec = {
@@ -184,6 +224,13 @@ def main():
         "full_u8_ladder_s": round(u8l_s, 2),
         "full_notally_s": round(init_s, 2),
         "full_nosq_s": round(sq1_s, 2),
+        # Megastep phase (device-sourced fused loop, ONE dispatch for
+        # megastep_moves moves): total seconds, and the per-move ratio
+        # against the single-chip walk — the ≤2x acceptance row.
+        "full_megastep_s": round(mega_s, 2),
+        "megastep_moves": mm,
+        "megastep_over_single": round(mega_s / mm / single_s, 2),
+        "n_segments_megastep": mega_seg,
         "rounds": rounds,
         "rounds_s": round(full_s - p1_s, 2),
         "phase1_over_single": round(p1_s / single_s, 2),

@@ -1,0 +1,585 @@
+"""Megastep: K device-sourced moves fused into one compiled program.
+
+The structural contracts, pinned so the fusion cannot silently rot:
+
+  * BITWISE IDENTITY — ``run_source_moves`` with megastep=K produces
+    bit-identical flux, particle state and counters to K per-dispatch
+    (megastep=1) moves, on both facades, across dtypes and io_pipeline
+    modes (the RNG streams are keyed by (seed, move, particle id), so
+    fusion is pure control flow).
+  * TRANSFER COUNT — a steady-state megastep issues exactly ONE H2D
+    (the move counter) and ONE D2H (the packed stats/integrity/
+    convergence/physics tail) per K moves, under
+    ``jax.transfer_guard("disallow")`` + the pumi_h2d/d2h counters.
+  * FUSED TAILS — convergence (batch cadence counting device moves),
+    integrity and telemetry reductions agree between the fused and
+    per-dispatch loops.
+  * RESUMABILITY — checkpoint restore mid-batch continues the RNG
+    stream and slot layout bitwise; the ResilientRunner replays a
+    transiently-failed megastep bitwise from its last-good snapshot.
+  * NO-MUTATION — the per-move facade reads, never mutates, its
+    weights/groups inputs (the models/transport.py copy-removal
+    satellite).
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pumiumtally_tpu import PumiTally, TallyConfig
+from pumiumtally_tpu.mesh.box import build_box_arrays
+from pumiumtally_tpu.mesh.core import TetMesh
+from pumiumtally_tpu.models.transport import Material, SyntheticTransport
+from pumiumtally_tpu.ops.source import SourceParams
+from pumiumtally_tpu.parallel.partitioned_api import PartitionedTally
+
+N = 64
+MOVES = 4
+
+SRC = SourceParams(
+    sigma_t={1: 4.0, 2: 9.0},
+    absorption={1: 0.3, 2: 0.5},
+    survival_weight=0.2,
+    seed=13,
+)
+
+
+def _jittered_two_region(nx=4, jitter=0.2, seed=11, dtype=jnp.float64):
+    coords, t2v = build_box_arrays(1.0, 1.0, 1.0, nx, nx, nx)
+    rng = np.random.default_rng(seed)
+    h = 1.0 / nx
+    interior = np.all((coords > 1e-9) & (coords < 1 - 1e-9), axis=1)
+    coords = coords.copy()
+    coords[interior] += rng.uniform(
+        -jitter * h, jitter * h, (int(interior.sum()), 3)
+    )
+    cen = coords[t2v].mean(axis=1)
+    cls = np.where(cen[:, 0] < 0.5, 1, 2).astype(np.int32)
+    return TetMesh.from_numpy(coords, t2v, class_id=cls, dtype=dtype)
+
+
+@pytest.fixture(scope="module")
+def mesh64():
+    return _jittered_two_region(dtype=jnp.float64)
+
+
+@pytest.fixture(scope="module")
+def mesh32():
+    return _jittered_two_region(dtype=jnp.float32)
+
+
+def _init(t, seed=3):
+    pos = np.random.default_rng(seed).uniform(0.1, 0.9, (N, 3))
+    t.initialize_particle_location(pos.ravel().copy())
+
+
+def _single_state(t):
+    s = t.state
+    return {
+        "flux": t.raw_flux,
+        "origin": np.asarray(s.origin),
+        "elem": np.asarray(s.elem),
+        "material_id": np.asarray(s.material_id),
+        "weight": np.asarray(s.weight),
+        "group": np.asarray(s.group),
+        "alive": np.asarray(s.in_flight),
+    }
+
+
+def _assert_out_equal(oa, ob):
+    for f in ("moves", "segments", "collisions", "escaped", "rouletted",
+              "alive", "truncated"):
+        assert oa[f] == ob[f], f
+    # absorbed_weight is an fp accumulation whose grouping legitimately
+    # differs across chunkings (device partial sums vs host refolds).
+    assert np.isclose(
+        oa["absorbed_weight"], ob["absorbed_weight"], rtol=1e-5
+    )
+
+
+# --------------------------------------------------------------------- #
+# Bitwise identity: megastep-K vs K per-dispatch moves
+# --------------------------------------------------------------------- #
+# The legacy-mode variants compile a fresh per-move reference program
+# per dtype and dominate this suite's wall time; they stay in the full
+# suite (the tier1.yml megastep step runs this file unfiltered) but are
+# excluded from the fast core run to protect its time budget.
+@pytest.mark.parametrize("io", [
+    pytest.param("legacy", marks=pytest.mark.slow),
+    "packed",
+    "overlap",
+])
+@pytest.mark.parametrize("dtype", ["float32", "float64"])
+def test_single_chip_megastep_bitwise(mesh32, mesh64, dtype, io):
+    mesh = mesh64 if dtype == "float64" else mesh32
+    w0 = np.random.default_rng(5).uniform(0.5, 2.0, N)
+    g0 = np.random.default_rng(6).integers(0, 2, N).astype(np.int32)
+
+    def run(K):
+        t = PumiTally(
+            mesh, N,
+            TallyConfig(
+                n_groups=2, dtype=jnp.dtype(dtype), tolerance=1e-6,
+                io_pipeline=io, megastep=K,
+            ),
+        )
+        _init(t)
+        out = t.run_source_moves(MOVES, SRC, weights=w0, groups=g0)
+        return t, out
+
+    a, oa = run(1)
+    b, ob = run(2)  # 4 moves = two fused chunks of 2
+    _assert_out_equal(oa, ob)
+    sa, sb = _single_state(a), _single_state(b)
+    for name in sa:
+        np.testing.assert_array_equal(sb[name], sa[name], err_msg=name)
+    assert a.total_segments == b.total_segments
+    assert a.iter_count == b.iter_count == MOVES
+
+
+def test_consecutive_calls_chain_bitwise(mesh64):
+    """run_source_moves(2) twice == run_source_moves(4) once: the alive
+    flag and RNG stream persist in device state between calls."""
+    def mk():
+        t = PumiTally(
+            mesh64, N,
+            TallyConfig(
+                n_groups=2, dtype=jnp.float64, tolerance=1e-8,
+                megastep=2,
+            ),
+        )
+        _init(t)
+        return t
+
+    a = mk()
+    a.run_source_moves(4, SRC, weights=np.ones(N))
+    b = mk()
+    b.run_source_moves(2, SRC, weights=np.ones(N))
+    b.run_source_moves(2, SRC)
+    sa, sb = _single_state(a), _single_state(b)
+    for name in sa:
+        np.testing.assert_array_equal(sb[name], sa[name], err_msg=name)
+
+
+def test_partitioned_megastep_bitwise_and_transfers(mesh64):
+    w0 = np.ones(N)
+    g0 = np.zeros(N, np.int32)
+
+    def run(K):
+        t = PartitionedTally(
+            mesh64, N,
+            TallyConfig(
+                n_groups=2, dtype=jnp.float64, tolerance=1e-8,
+                megastep=K,
+            ),
+            n_parts=4, halo_layers=1,
+        )
+        _init(t)
+        out = t.run_source_moves(3, SRC, weights=w0, groups=g0)
+        return t, out
+
+    a, oa = run(1)
+    b, ob = run(3)
+    _assert_out_equal(oa, ob)
+    np.testing.assert_array_equal(b.raw_flux, a.raw_flux)
+    a._sync_source_state()
+    b._sync_source_state()
+    for name in ("positions", "elem_global", "material_id", "weights",
+                 "groups", "alive"):
+        np.testing.assert_array_equal(
+            getattr(b, name), getattr(a, name), err_msg=name
+        )
+    assert a.total_segments == b.total_segments
+
+    # Steady-state transfer invariant on the fused loop: continuing b
+    # (state device-resident, program compiled) costs exactly one H2D —
+    # the move counter — and one D2H — the packed tail — for 3 moves.
+    tot0 = b.telemetry()["totals"]
+    with jax.transfer_guard("disallow"):
+        b.run_source_moves(3, SRC)
+    tot1 = b.telemetry()["totals"]
+    assert tot1["h2d_transfers"] - tot0["h2d_transfers"] == 1
+    assert tot1["d2h_transfers"] - tot0["d2h_transfers"] == 1
+    assert tot1["moves"] - tot0["moves"] == 3
+
+
+# --------------------------------------------------------------------- #
+# Transfer invariant with every fused tail on (single chip)
+# --------------------------------------------------------------------- #
+def test_single_chip_megastep_transfer_invariant(mesh64):
+    t = PumiTally(
+        mesh64, N,
+        TallyConfig(
+            n_groups=2, dtype=jnp.float64, tolerance=1e-8, megastep=2,
+            convergence=True, batch_moves=2, integrity="warn",
+        ),
+    )
+    _init(t)
+    t.run_source_moves(2, SRC, weights=np.ones(N))  # warm/compile
+    tot0 = t.telemetry()["totals"]
+    with jax.transfer_guard("disallow"):
+        t.run_source_moves(2, SRC)
+    tot1 = t.telemetry()["totals"]
+    assert tot1["h2d_transfers"] - tot0["h2d_transfers"] == 1
+    assert tot1["d2h_transfers"] - tot0["d2h_transfers"] == 1
+    assert tot1["moves"] - tot0["moves"] == 2
+    assert tot1["segments"] > tot0["segments"]
+    # Clean physics must not trip the integrity escalation.
+    viol = t.telemetry()["integrity"]["violations"]
+    assert all(v == 0 for v in viol.values()), viol
+
+
+# --------------------------------------------------------------------- #
+# Fused-tail parity: convergence / integrity / telemetry
+# --------------------------------------------------------------------- #
+def test_megastep_convergence_parity(mesh64):
+    def run(K):
+        t = PumiTally(
+            mesh64, N,
+            TallyConfig(
+                n_groups=2, dtype=jnp.float64, tolerance=1e-8,
+                megastep=K, convergence=True, batch_moves=2,
+            ),
+        )
+        _init(t)
+        t.run_source_moves(MOVES, SRC, weights=np.ones(N))
+        return t
+
+    a, b = run(1), run(4)
+    ca = a.telemetry()["convergence"]
+    cb = b.telemetry()["convergence"]
+    # The batch cadence counts DEVICE moves: 4 moves / batch_moves=2
+    # gives 2 closed batches either way, and the final statistics agree
+    # (the accumulators fold inside the program, move by move).
+    assert ca["n_batches"] == cb["n_batches"] == 2
+    for f in ("scored", "rel_err_mean", "rel_err_max",
+              "converged_fraction"):
+        assert ca[f] == cb[f], f
+    np.testing.assert_array_equal(
+        a.relative_error(), b.relative_error()
+    )
+
+
+def test_megastep_telemetry_records(mesh64):
+    t = PumiTally(
+        mesh64, N,
+        TallyConfig(
+            n_groups=2, dtype=jnp.float64, tolerance=1e-8, megastep=2,
+        ),
+    )
+    _init(t)
+    out = t.run_source_moves(MOVES, SRC, weights=np.ones(N))
+    tm = t.telemetry()
+    recs = [r for r in tm["per_move"] if r["kind"] == "megastep"]
+    assert len(recs) == 2  # 4 moves in two fused chunks
+    assert all(r["moves"] == 2 for r in recs)
+    assert tm["totals"]["moves"] == MOVES
+    assert tm["totals"]["segments"] == out["segments"]
+    assert sum(r["collisions"] for r in recs) == out["collisions"]
+
+
+# --------------------------------------------------------------------- #
+# Checkpoint restore mid-batch
+# --------------------------------------------------------------------- #
+def test_single_chip_megastep_checkpoint_restore(mesh64, tmp_path):
+    cfg = TallyConfig(
+        n_groups=2, dtype=jnp.float64, tolerance=1e-8, megastep=3,
+    )
+    a = PumiTally(mesh64, N, cfg)
+    _init(a)
+    a.run_source_moves(3, SRC, weights=np.ones(N))
+    ck = str(tmp_path / "mega.npz")
+    a.save_checkpoint(ck)
+    a.run_source_moves(3, SRC)
+
+    b = PumiTally(mesh64, N, cfg)
+    b.restore_checkpoint(ck)
+    b.run_source_moves(3, SRC)
+    sa, sb = _single_state(a), _single_state(b)
+    for name in sa:
+        np.testing.assert_array_equal(sb[name], sa[name], err_msg=name)
+    assert a.iter_count == b.iter_count == 6
+
+
+def test_partitioned_megastep_checkpoint_restore(mesh64, tmp_path):
+    cfg = dict(n_groups=2, dtype=jnp.float64, tolerance=1e-8, megastep=2)
+    a = PartitionedTally(
+        mesh64, N, TallyConfig(**cfg), n_parts=4, halo_layers=1
+    )
+    _init(a)
+    a.run_source_moves(2, SRC, weights=np.ones(N))
+    ck = str(tmp_path / "mega_part.npz")
+    a.save_checkpoint(ck)
+    a.run_source_moves(2, SRC)
+
+    b = PartitionedTally(
+        mesh64, N, TallyConfig(**cfg), n_parts=4, halo_layers=1
+    )
+    b.restore_checkpoint(ck)
+    b.run_source_moves(2, SRC)
+    # Same partition layout ⇒ the persisted slot state resumes the run
+    # bitwise, flux summation order included.
+    np.testing.assert_array_equal(b.raw_flux, a.raw_flux)
+    a._sync_source_state()
+    b._sync_source_state()
+    for name in ("positions", "elem_global", "material_id", "weights",
+                 "groups", "alive"):
+        np.testing.assert_array_equal(
+            getattr(b, name), getattr(a, name), err_msg=name
+        )
+
+
+# --------------------------------------------------------------------- #
+# ResilientRunner retry replay at megastep granularity
+# --------------------------------------------------------------------- #
+def test_runner_megastep_transient_retry(mesh64, tmp_path):
+    from pumiumtally_tpu.resilience.faultinject import (
+        FaultInjector,
+        FaultPlan,
+    )
+    from pumiumtally_tpu.resilience.runner import ResilientRunner
+
+    pos = np.random.default_rng(3).uniform(0.1, 0.9, (N, 3)).ravel()
+
+    def run(tag, faults=None):
+        t = PumiTally(
+            mesh64, N,
+            TallyConfig(
+                n_groups=2, dtype=jnp.float64, tolerance=1e-8,
+                megastep=2,
+            ),
+        )
+        with ResilientRunner(
+            t, str(tmp_path / tag), every_moves=2,
+            handle_signals=False, sleep=lambda s: None, faults=faults,
+        ) as run_:
+            run_.initialize_particle_location(pos.copy())
+            run_.run_source_moves(2, SRC, weights=np.ones(N))
+            run_.run_source_moves(2, SRC)
+            run_.run_source_moves(2, SRC)
+        return t
+
+    a = run("clean")
+    # The transient fires at move 3 (the second megastep); the runner
+    # must roll back to the last-good snapshot and replay bitwise.
+    b = run("faulty", FaultInjector(FaultPlan(transient_at_move=3)))
+    sa, sb = _single_state(a), _single_state(b)
+    for name in sa:
+        np.testing.assert_array_equal(sb[name], sa[name], err_msg=name)
+    assert b.metrics.counter(
+        "pumi_move_retries_total",
+        "transient move failures retried by the supervisor",
+    ).value() == 1
+
+
+def test_runner_megastep_midcall_checkpoint_cadence(mesh64, tmp_path):
+    """ONE long run_source_moves call is supervised in megastep-K
+    chunks: the every-N-moves checkpoint cadence fires BETWEEN the
+    fused dispatches, bounding the preemption loss window to one
+    megastep (not the whole call), and the chunked call stays bitwise
+    identical to the unchunked facade loop."""
+    from pumiumtally_tpu.resilience.runner import ResilientRunner
+
+    pos = np.random.default_rng(3).uniform(0.1, 0.9, (N, 3)).ravel()
+    t = PumiTally(
+        mesh64, N,
+        TallyConfig(
+            n_groups=2, dtype=jnp.float64, tolerance=1e-8, megastep=2,
+        ),
+    )
+    with ResilientRunner(
+        t, str(tmp_path / "cadence"), every_moves=2,
+        handle_signals=False, sleep=lambda s: None,
+    ) as run_:
+        run_.initialize_particle_location(pos.copy())
+        run_.run_source_moves(6, SRC, weights=np.ones(N))
+        # 6 moves = 3 chunks of K=2; cadence every 2 moves → one
+        # checkpoint per chunk boundary, written DURING the call.
+        assert run_.store.find_latest() is not None
+        assert t.iter_count == 6
+        assert (
+            t.metrics.counter(
+                "pumi_checkpoints_total",
+                "checkpoint generations written by the supervisor",
+            ).value() >= 3
+        )
+
+    ref = PumiTally(
+        mesh64, N,
+        TallyConfig(
+            n_groups=2, dtype=jnp.float64, tolerance=1e-8, megastep=2,
+        ),
+    )
+    ref.initialize_particle_location(pos.copy())
+    ref.run_source_moves(6, SRC, weights=np.ones(N))
+    sa, sb = _single_state(t), _single_state(ref)
+    for name in sa:
+        np.testing.assert_array_equal(sa[name], sb[name], err_msg=name)
+
+
+# --------------------------------------------------------------------- #
+# Knob semantics + facade-input no-mutation + driver modes
+# --------------------------------------------------------------------- #
+def test_resolve_megastep_knob(monkeypatch):
+    assert TallyConfig().resolve_megastep() == 1
+    assert TallyConfig(megastep=4).resolve_megastep() == 4
+    monkeypatch.setenv("PUMI_TPU_MEGASTEP", "8")
+    assert TallyConfig(megastep=4).resolve_megastep() == 8
+    monkeypatch.delenv("PUMI_TPU_MEGASTEP")
+    with pytest.raises(ValueError, match="megastep"):
+        TallyConfig(megastep=0).resolve_megastep()
+
+
+@pytest.mark.parametrize("io", ["packed", "legacy"])
+def test_move_inputs_never_mutated(mesh64, io):
+    """The per-move facade READS weights/groups, never writes them —
+    the contract that lets models/transport.py drop its per-event
+    defensive copies."""
+    t = PumiTally(
+        mesh64, 32,
+        TallyConfig(
+            n_groups=2, dtype=jnp.float64, tolerance=1e-8,
+            io_pipeline=io,
+        ),
+    )
+    rng = np.random.default_rng(0)
+    t.initialize_particle_location(
+        rng.uniform(0.1, 0.9, (32, 3)).ravel()
+    )
+    w = rng.uniform(0.5, 2.0, 32)
+    g = rng.integers(0, 2, 32).astype(np.int32)
+    w0, g0 = w.copy(), g.copy()
+    t.move_to_next_location(
+        rng.uniform(0.1, 0.9, (32, 3)), np.ones(32, np.int8), w, g,
+        np.full(32, -1, np.int32),
+    )
+    np.testing.assert_array_equal(w, w0)
+    np.testing.assert_array_equal(g, g0)
+
+
+def test_transport_megastep_default(mesh64):
+    """SyntheticTransport defaults to the device-sourced fused loop and
+    still produces a physically coherent batch (every outcome class on
+    a two-region mesh)."""
+    t = PumiTally(
+        mesh64, 48,
+        TallyConfig(n_groups=2, dtype=jnp.float64, tolerance=1e-8),
+    )
+    d = SyntheticTransport(
+        t,
+        materials={1: Material(4.0, 0.4), 2: Material(8.0, 0.6)},
+        seed=3,
+        max_events=100,
+    )
+    assert d.mode == "megastep"
+    stats = d.run(batches=1)
+    assert stats.batches == 1
+    assert stats.events > 0
+    assert stats.collisions > 0
+    assert stats.absorbed_weight > 0
+    assert stats.boundary_escapes + stats.roulette_kills > 0
+    flux = t.raw_flux
+    cid = np.asarray(mesh64.class_id)
+    assert flux[cid == 1, :, 0].sum() > 0
+    assert flux[cid == 2, :, 0].sum() > 0
+    assert flux[:, 1, 0].sum() > 0  # downscatter populated group 1
+
+
+def test_partitioned_restage_continues_from_device_state(mesh64):
+    """Re-staging SOME physics lanes mid-run must not rewind the rest:
+    positions/elements (and every omitted lane) continue from live
+    device state, exactly like PumiTally._stage_source_lanes — NOT from
+    the host mirrors, which are stale between read surfaces."""
+    def mk():
+        t = PartitionedTally(
+            mesh64, N,
+            TallyConfig(
+                n_groups=2, dtype=jnp.float64, tolerance=1e-8,
+                megastep=2,
+            ),
+            n_parts=4, halo_layers=1,
+        )
+        _init(t)
+        return t
+
+    w1 = np.random.default_rng(9).uniform(0.5, 2.0, N)
+    a = mk()
+    pos0 = a.positions.copy()
+    a.run_source_moves(2, SRC)
+    a.run_source_moves(2, SRC, weights=w1)  # implicit mid-run re-stage
+
+    b = mk()
+    b.run_source_moves(2, SRC)
+    b._sync_source_state()  # oracle: explicit fold-back before re-stage
+    b.run_source_moves(2, SRC, weights=w1)
+
+    a._sync_source_state()
+    b._sync_source_state()
+    # The first call really moved particles, so a rewind would diverge.
+    assert not np.array_equal(a.positions, pos0)
+    np.testing.assert_array_equal(a.raw_flux, b.raw_flux)
+    for name in ("positions", "elem_global", "material_id", "weights",
+                 "groups", "alive"):
+        np.testing.assert_array_equal(
+            getattr(a, name), getattr(b, name), err_msg=name
+        )
+
+
+def test_pipeline_drain_all_done_requires_dead(mesh64):
+    """BatchResult.all_done on a submit_source() batch means the whole
+    event loop FINISHED: particles still alive when n_moves ran out are
+    unfinished work, not a clean batch."""
+    from pumiumtally_tpu.models.pipeline import StreamingTallyPipeline
+
+    def run(n_moves):
+        pipe = StreamingTallyPipeline(
+            mesh64,
+            TallyConfig(n_groups=2, dtype=jnp.float64, tolerance=1e-8),
+            depth=1,
+        )
+        cents = np.asarray(mesh64.centroids())
+        e = np.random.default_rng(0).integers(
+            0, mesh64.ntet, N
+        ).astype(np.int32)
+        pipe.submit_source(
+            cents[e], e, n_moves,
+            SourceParams(sigma_t={1: 5.0, 2: 5.0}, seed=1),
+        )
+        pipe.finish()
+        return list(pipe.results())[0]
+
+    short = run(1)  # one move cannot terminate every particle
+    assert short.physics["alive"] > 0
+    assert not short.all_done
+    full = run(200)
+    assert full.physics["alive"] == 0
+    assert full.all_done == (full.physics["truncated"] == 0)
+
+
+def test_pipeline_submit_source(mesh64):
+    from pumiumtally_tpu.models.pipeline import StreamingTallyPipeline
+
+    pipe = StreamingTallyPipeline(
+        mesh64,
+        TallyConfig(n_groups=2, dtype=jnp.float64, tolerance=1e-8),
+        depth=2,
+    )
+    cents = np.asarray(mesh64.centroids())
+    rng = np.random.default_rng(0)
+    for i in range(2):
+        e = rng.integers(0, mesh64.ntet, N).astype(np.int32)
+        pipe.submit_source(
+            cents[e], e, 3,
+            SourceParams(sigma_t={1: 5.0, 2: 5.0}, seed=i),
+        )
+    flux = pipe.finish()
+    assert flux[..., 0].sum() > 0
+    rs = list(pipe.results())
+    assert len(rs) == 2
+    for r in rs:
+        assert r.physics is not None
+        assert r.physics["collisions"] >= 0
+        assert r.n_segments > 0
