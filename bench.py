@@ -51,6 +51,7 @@ def run(
     repeats: int = 2,
     flat_flux: bool = True,
     sd_mode: str = "segment",
+    kernel: str = "xla",
 ) -> dict:
     import contextlib
 
@@ -91,6 +92,23 @@ def run(
     t0 = time.perf_counter()
     mesh = build_box(1.0, 1.0, 1.0, cells, cells, cells, dtype=dtype)
     build_s = time.perf_counter() - t0
+
+    # Walk-kernel axis (round 6): "pallas" routes every trace through
+    # the Mosaic kernel (ops/walk_pallas.py); "auto" resolves against
+    # THIS workload so the record names the backend that actually ran.
+    # An explicit "pallas" outside its regime (no packed table, over
+    # the VMEM budget) fails here, before any measurement.
+    if kernel != "xla":
+        from pumiumtally_tpu.ops.walk_pallas import select_backend
+
+        kernel = select_backend(
+            kernel,
+            ntet=mesh.ntet,
+            n_particles=n_particles,
+            n_groups=n_groups,
+            dtype=dtype,
+            packed=getattr(mesh, "geo20", None) is not None,
+        )
 
     rng = np.random.default_rng(seed)
     elem = jnp.asarray(
@@ -164,6 +182,7 @@ def run(
             gathers=gathers,
             ledger=ledger,
             n_groups=n_groups,
+            kernel=kernel,
         )
         return (
             r.position, r.elem, r.flux, r.n_segments, r.n_crossings,
@@ -341,6 +360,7 @@ def run(
             dtype=dtype,
             mean_path=mean_path,
             seed=seed,
+            kernel=kernel,
         )
 
     per_chip_baseline = 1e9 / 64.0
@@ -351,6 +371,10 @@ def run(
         # Which backend actually produced the number — "cpu" rows are
         # rehearsal/fallback measurements, never comparable to TPU rows.
         "backend": jax.default_backend(),
+        # Which WALK KERNEL produced it (round 6 A/B axis): "xla" is
+        # the scattered body, "pallas" the Mosaic matrixized-tally
+        # kernel — the RESOLVED value when the caller asked for "auto".
+        "kernel": kernel,
         "vs_baseline": round(segments_per_sec / per_chip_baseline, 4),
         # Dispatch-amortization axes (the megastep tentpole's tracked
         # win): moves retired per wall-second, and how many host→device
@@ -381,6 +405,7 @@ def run(
             "robust": robust,
             "tally_scatter": tally_scatter,
             "gathers": gathers,
+            "kernel": kernel,
             "ledger": ledger,
             "fused_steps": fused,
             "flat_flux": flat_flux,
@@ -405,7 +430,8 @@ def run(
 
 
 def run_event_loop(
-    mesh, n_particles, moves, n_groups, dtype, mean_path, seed
+    mesh, n_particles, moves, n_groups, dtype, mean_path, seed,
+    kernel="xla",
 ) -> dict:
     """Measure the full per-event host loop and the streaming pipeline.
 
@@ -435,6 +461,12 @@ def run_event_loop(
         # so the event-loop vs kernel gap is dispatch overhead, not a
         # scheduling difference
         convergence=convergence,
+        # The resolved walk-kernel axis rides the facade loop too, so
+        # the event-loop / pipeline rows A/B the same backend as the
+        # headline (the megastep rows below stay XLA — the fused
+        # megastep program never rides the Mosaic kernel,
+        # TallyConfig.resolve_kernel).
+        kernel=kernel,
     )
     tally = PumiTally(mesh, n_particles, cfg)
     cents = np.asarray(mesh.centroids())
@@ -497,7 +529,7 @@ def run_event_loop(
         ),
     )
     ca, cs = cfg.resolve_compaction(n_particles)
-    kw.update(compact_after=ca, compact_size=cs)
+    kw.update(compact_after=ca, compact_size=cs, kernel=kernel)
     dev_origin = jnp.asarray(prev, cfg.dtype)
     dev_dests = [jnp.asarray(d, cfg.dtype) for d in dests]
     dev_elem = jnp.asarray(np.asarray(tally.state.elem))
@@ -547,6 +579,7 @@ def run_event_loop(
         "event_call_overhead_ms": round(overhead_ms, 2),
         "event_particles": n_particles,
         "event_moves": moves,
+        "event_kernel": kernel,
         # Per-move dispatch accounting for the facade loop (each
         # move_to_next_location is one program dispatch).
         "event_moves_per_sec": round(moves / dt, 2),
@@ -695,6 +728,7 @@ def main() -> None:
                     dtype_name=os.environ.get("BENCH_DTYPE", "float32"),
                     unroll=int(os.environ.get("BENCH_UNROLL", "8")),
                     repeats=1,
+                    kernel=os.environ.get("BENCH_KERNEL", "xla"),
                 )
                 result["backend"] = "cpu"
                 result["detail"]["backend"] = "cpu"
@@ -820,6 +854,9 @@ def main() -> None:
         # segment (reference parity) | batch (cheap sd: −20% step-time
         # squares share folded into one pass per step) | none (nosq A/B)
         sd_mode=os.environ.get("BENCH_SD", "segment"),
+        # xla (scattered body) | pallas (Mosaic matrixized tally) |
+        # auto (pallas inside its VMEM regime) — the round-6 A/B axis.
+        kernel=os.environ.get("BENCH_KERNEL", "xla"),
     )
     print(
         f"[bench] {result['detail']}", file=sys.stderr

@@ -169,6 +169,28 @@ class PumiTally:
             # double-buffered staging + deferred telemetry folds;
             # "legacy" is the pre-pipeline multi-transfer path.
             self._io = cfg.resolve_io_pipeline()
+            # Walk-kernel backend (ops/walk_pallas.py): the config half
+            # of the decision (resolve_kernel — combo validation, env
+            # override) and the workload half (select_backend — packed
+            # table, VMEM budget, platform) BOTH resolve here at
+            # construction, never mid-dispatch. The resolved backend
+            # rides every _trace call as a static jit key; "auto"
+            # outside the Pallas regime (or over a debug surface the
+            # kernel cannot carry) lands on "xla" silently.
+            self._kernel_policy = cfg.resolve_kernel()
+            if self._kernel_policy == "xla":
+                self._kernel = "xla"
+            else:
+                from .ops.walk_pallas import resolve_config_kernel
+
+                self._kernel = resolve_config_kernel(
+                    cfg,
+                    ntet=mesh.ntet,
+                    n_particles=self.num_particles,
+                    n_groups=cfg.n_groups,
+                    dtype=cfg.dtype,
+                    packed=getattr(mesh, "geo20", None) is not None,
+                )
             self._stager = staging.HostStager(
                 depth=2 if self._io == "overlap" else 1
             )
@@ -259,6 +281,7 @@ class PumiTally:
         checkify-wrapped variant so the reference's device asserts
         (OMEGA_H_CHECK_PRINTF, cpp:605-608, 618-629) fire as Python
         exceptions."""
+        kwargs.setdefault("kernel", self._kernel)
         if kwargs.pop("_packed", False):
             return trace_packed(*args, **kwargs)
         if self.config.checkify_invariants:
@@ -1233,17 +1256,27 @@ class PumiTally:
             "initialize_particle_location must run before source moves"
         )
         cfg = self.config
-        if cfg.record_xpoints is not None or cfg.checkify_invariants:
+        # Feature combos the fused program cannot carry fail at RESOLVE
+        # time (utils/config.resolve_megastep: record_xpoints /
+        # checkify_invariants), before any staging or dispatch. The
+        # Mosaic walk kernel likewise never rides the scanned megastep
+        # body: a config-explicit kernel='pallas' is rejected here at
+        # the same resolve point, while kernel='auto' — and an
+        # env-forced 'pallas' (the PUMI_TPU_KERNEL sweep) — lands on
+        # the XLA megastep silently (the auto fallback policy).
+        K = cfg.resolve_megastep()
+        if self._kernel_policy == "pallas" and cfg.kernel == "pallas":
             raise NotImplementedError(
-                "run_source_moves needs the packed megastep program; "
-                "record_xpoints / checkify_invariants require the "
-                "per-move facade path"
+                "run_source_moves fuses source sampling + walk + "
+                "physics into one scanned XLA program; kernel='pallas' "
+                "does not ride it (TallyConfig.resolve_kernel) — use "
+                "kernel='auto' (XLA fallback) or 'xla' for "
+                "device-sourced runs"
             )
         from .ops.source import SourceParams, phys_to_dict
         from .ops.walk import megastep as megastep_fn
 
         src = source if source is not None else SourceParams()
-        K = cfg.resolve_megastep()
         sig_dev, ab_dev = self._source_tables(src)
         rng_key = self._rng_key(src.seed)
         statics = self._megastep_statics(src)

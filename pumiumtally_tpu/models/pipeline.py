@@ -93,6 +93,12 @@ class StreamingTallyPipeline:
             )
         self.depth = max(1, int(depth))
         self.want_outputs = want_outputs
+        # Walk-kernel backend: the config half (combo validation, env
+        # override) resolves here at construction; the workload half
+        # (walk_pallas.select_backend — VMEM budget against the BATCH
+        # size) re-resolves per submit() because batch sizes vary, and
+        # still runs before the trace call ever dispatches.
+        self._kernel_policy = self.config.resolve_kernel()
         self.flux = make_flux(
             mesh.ntet, self.config.n_groups, dtype=self.config.dtype,
             flat=True,
@@ -108,6 +114,19 @@ class StreamingTallyPipeline:
         cfg = self.config
         n = np.asarray(origin).shape[0]
         dt = cfg.dtype
+        if self._kernel_policy == "xla":
+            kern = "xla"
+        else:
+            from ..ops.walk_pallas import resolve_config_kernel
+
+            kern = resolve_config_kernel(
+                cfg,
+                ntet=self.mesh.ntet,
+                n_particles=n,
+                n_groups=cfg.n_groups,
+                dtype=dt,
+                packed=getattr(self.mesh, "geo20", None) is not None,
+            )
         result = trace(
             self.mesh,
             jnp.asarray(origin, dt),
@@ -155,6 +174,7 @@ class StreamingTallyPipeline:
             stats=cfg.walk_stats,
             record_xpoints=cfg.record_xpoints,
             n_groups=cfg.n_groups,
+            kernel=kern,
         )
         # The flux chain threads through every batch (donated each step);
         # per-batch outputs wait in the in-flight queue.
@@ -177,10 +197,17 @@ class StreamingTallyPipeline:
         like ``submit()`` batches with the physics counters attached
         (BatchResult.physics)."""
         cfg = self.config
-        if cfg.record_xpoints is not None or cfg.checkify_invariants:
+        # Combos the fused program cannot carry fail at RESOLVE time
+        # (utils/config.resolve_megastep); a config-explicit
+        # kernel='pallas' never rides the scanned megastep body either
+        # (TallyConfig.resolve_kernel documents the decision), while an
+        # env-forced 'pallas' lands on the XLA megastep silently.
+        cfg.resolve_megastep()
+        if self._kernel_policy == "pallas" and cfg.kernel == "pallas":
             raise NotImplementedError(
-                "submit_source needs the packed megastep program; "
-                "record_xpoints / checkify_invariants require submit()"
+                "submit_source fuses source sampling + walk + physics "
+                "into one scanned XLA program; kernel='pallas' does not "
+                "ride it — use kernel='auto' (XLA fallback) or 'xla'"
             )
         from ..ops.source import SourceParams, near_epsilon, staged_tables
         from ..ops.walk import megastep

@@ -30,15 +30,24 @@ Atomics disappear: the per-crossing tally writes become one XLA scatter-add
 over the particle axis per iteration (duplicate indices accumulate), and
 race-freedom is by construction.
 
-Why XLA and not a Pallas kernel: the walk is random-gather/-scatter bound
-(mesh tables indexed by data-dependent element ids), and Mosaic on TPU has
-no vectorized random-gather lowering — jnp.take / advanced indexing /
-one-hot-matmul forms all fail to lower inside a kernel
-(scripts/probe_pallas_gather.py records the probes on hardware), so a
-Pallas version could only scalar-loop over lanes, far slower than XLA's
-native gather/scatter ops. Pallas wins on dense tiled compute; this op is
-neither. (A VMEM-resident table kernel is also out: this generation has
-~16 MB VMEM/core, far below the ~80 MB of walk tables at 1M tets.)
+Kernel backends: this module is the XLA walk — the default and the only
+backend that covers every mesh size and feature surface. The walk is
+random-gather/-scatter bound (mesh tables indexed by data-dependent
+element ids), and Mosaic on TPU has no vectorized random-gather lowering
+(jnp.take / advanced indexing fail to lower inside a kernel —
+scripts/probe_pallas_gather.py records the probes), so a Pallas port of
+THIS body is off the table. What does lower is the one-hot-matmul form:
+for meshes whose decoded walk table fits VMEM, ops/walk_pallas.py
+recasts the gather as a blocked ``onehot(elem) @ table`` MXU contraction
+and the per-crossing tally scatter-add as a ``onehot(elem)^T @ values``
+outer-product into a tile-local accumulator flushed to HBM once per
+launch — the Matrix-PIC / POLAR-PIC move (PAPERS.md), selected by
+``TallyConfig(kernel="pallas"|"auto")`` and bit-identical to this body
+(tests/test_kernel_pallas.py). Its regime is the small/medium mesh where
+per-crossing HBM gather latency dominates; above the VMEM tile budget
+(``PUMI_TPU_PALLAS_VMEM_MB``, ~16 MB/core physical) ``kernel="auto"``
+falls back HERE, which is why the scattered XLA body below remains the
+production path for 1M-tet meshes (~80 MB of walk tables).
 
 Gather budget (round 3). In-loop TPU gather/scatter cost is linear in
 rows (~9-11 ns/row) with width nearly free up to ~24 f32 columns
@@ -243,6 +252,58 @@ def normalize_compact_stages(
     return compact_stages
 
 
+def walk_stats_vector(ncross_l, nchase_l, done, occ0, occ1, nseg, it):
+    """Reduce the per-lane telemetry counters to the [8] per-move stats
+    vector (obs/walk_stats.py WALK_STATS_FIELDS order — drift breaks
+    tests/test_obs.py). ONE definition shared by the XLA walk body and
+    the Pallas kernel path (ops/walk_pallas.py), so the schema cannot
+    fork between backends."""
+    sd_t = nseg.dtype
+    return jnp.stack([
+        jnp.sum(ncross_l).astype(sd_t),
+        jnp.max(ncross_l).astype(sd_t),
+        jnp.sum(nchase_l).astype(sd_t),
+        jnp.sum(jnp.logical_not(done)).astype(sd_t),
+        occ0.astype(sd_t),
+        occ1.astype(sd_t),
+        nseg,
+        it.astype(sd_t),
+    ])
+
+
+def integrity_vector(
+    in_flight, done, weight, pseg, cur, origin, flux, dtype, initial
+):
+    """End-of-walk conservation-invariant reductions → the
+    [INTEGRITY_LEN] vector (integrity/invariants.py field order).
+    Completed, walked lanes only: a truncated lane legitimately holds a
+    partial ledger (the escalation re-walk's merge keeps the sums
+    consistent across attempts — see _merge_rewalk). Shared by the XLA
+    and Pallas walk paths; ``flux`` is the FLAT accumulator."""
+    comp = in_flight & done
+    zero = jnp.sum(weight) * 0  # device-varying scalar zero
+    if initial:
+        # The location search scores nothing; the conservation
+        # triple is identically zero by construction.
+        scored = path = resid = zero
+    else:
+        dist = jnp.linalg.norm(cur - origin, axis=-1)
+        scored = jnp.sum(jnp.where(comp, weight * pseg, 0.0))
+        path = jnp.sum(jnp.where(comp, weight * dist, 0.0))
+        resid = jnp.max(jnp.where(comp, jnp.abs(pseg - dist), 0.0))
+    bad_flux = jnp.sum(
+        jnp.logical_not(jnp.isfinite(flux)) | (flux < 0.0)
+    )
+    return jnp.stack([
+        scored.astype(dtype),
+        path.astype(dtype),
+        resid.astype(dtype),
+        bad_flux.astype(dtype),
+        jnp.sum(in_flight).astype(dtype),
+        jnp.sum(comp).astype(dtype),
+    ])
+
+
 def _exp2i(k, dtype):
     """2**k as ``dtype`` for small non-negative integer k (the bump's
     stuck counter, clamped <= 48): assemble the float's exponent bits
@@ -424,6 +485,7 @@ def trace_impl(
     conv_state: tuple | None = None,
     rel_err_target: float = 0.05,
     batch_moves: int = 1,
+    kernel: str = "xla",
 ) -> TraceResult:
     """Advance all particles from origin to dest through the mesh.
 
@@ -556,7 +618,52 @@ def trace_impl(
         `jax.experimental.checkify.checkify` (see `checked_trace`) to
         surface the first violation; costs extra per-crossing reductions,
         debug builds only.
+      kernel: walk backend. "xla" (default) is this function's scattered
+        body; "pallas" routes the IDENTICAL trace contract through the
+        Mosaic kernel (ops/walk_pallas.py — VMEM-resident tables,
+        one-hot MXU gather, matrixized tally scatter), bit-compared
+        against this path by tests/test_kernel_pallas.py. The facades
+        resolve TallyConfig(kernel=...)/PUMI_TPU_KERNEL to a concrete
+        backend at construction (walk_pallas.select_backend) — "auto"
+        never reaches here.
     """
+    if kernel == "pallas":
+        # The Mosaic path takes trace_impl's exact contract, so the
+        # packed-staging program (trace_packed_impl) composes unchanged:
+        # record unpack → Pallas kernel → coalesced readback is still
+        # ONE compiled program with one H2D and one D2H per move.
+        from .walk_pallas import trace_pallas_impl
+
+        return trace_pallas_impl(
+            mesh, origin, dest, elem, in_flight, weight, group,
+            material_id, flux,
+            initial=initial,
+            max_crossings=max_crossings,
+            score_squares=score_squares,
+            tolerance=tolerance,
+            compact_after=compact_after,
+            compact_size=compact_size,
+            compact_stages=compact_stages,
+            unroll=unroll,
+            robust=robust,
+            tally_scatter=tally_scatter,
+            gathers=gathers,
+            ledger=ledger,
+            stats=stats,
+            integrity=integrity,
+            debug_checks=debug_checks,
+            record_xpoints=record_xpoints,
+            n_groups=n_groups,
+            conv_state=conv_state,
+            rel_err_target=rel_err_target,
+            batch_moves=batch_moves,
+        )
+    if kernel != "xla":
+        raise ValueError(
+            f"kernel must be 'xla' or 'pallas' at trace time: {kernel!r}"
+            " ('auto' is resolved by the facades via "
+            "walk_pallas.select_backend before dispatch)"
+        )
     dtype = origin.dtype
     ntet = mesh.tet2tet.shape[0]
     n = origin.shape[0]
@@ -1144,48 +1251,15 @@ def trace_impl(
     )
     integ_vec = None
     if integrity:
-        # Conservation invariants (integrity/invariants.py field order).
-        # Completed, walked lanes only: a truncated lane legitimately
-        # holds a partial ledger (the escalation re-walk's merge keeps
-        # the sums consistent across attempts — see _merge_rewalk).
-        comp = in_flight & done
-        zero = jnp.sum(weight) * 0  # device-varying scalar zero
-        if initial:
-            # The location search scores nothing; the conservation
-            # triple is identically zero by construction.
-            scored = path = resid = zero
-        else:
-            dist = jnp.linalg.norm(cur - origin, axis=-1)
-            scored = jnp.sum(jnp.where(comp, weight * pseg, 0.0))
-            path = jnp.sum(jnp.where(comp, weight * dist, 0.0))
-            resid = jnp.max(jnp.where(comp, jnp.abs(pseg - dist), 0.0))
-        bad_flux = jnp.sum(
-            jnp.logical_not(jnp.isfinite(flux)) | (flux < 0.0)
+        integ_vec = integrity_vector(
+            in_flight, done, weight, pseg, cur, origin, flux, dtype,
+            initial,
         )
-        integ_vec = jnp.stack([
-            scored.astype(dtype),
-            path.astype(dtype),
-            resid.astype(dtype),
-            bad_flux.astype(dtype),
-            jnp.sum(in_flight).astype(dtype),
-            jnp.sum(comp).astype(dtype),
-        ])
     stats_vec = None
     if stats:
-        ncross_l, nchase_l = lanes[0], lanes[1]
-        sd_t = nseg.dtype
-        # Field order pinned to obs/walk_stats.py WALK_STATS_FIELDS
-        # (drift breaks tests/test_obs.py).
-        stats_vec = jnp.stack([
-            jnp.sum(ncross_l).astype(sd_t),
-            jnp.max(ncross_l).astype(sd_t),
-            jnp.sum(nchase_l).astype(sd_t),
-            jnp.sum(jnp.logical_not(done)).astype(sd_t),
-            occ[0].astype(sd_t),
-            occ[1].astype(sd_t),
-            nseg,
-            it.astype(sd_t),
-        ])
+        stats_vec = walk_stats_vector(
+            lanes[0], lanes[1], done, occ[0], occ[1], nseg, it
+        )
     conv_vec = conv_out = None
     if conv_state is not None:
         # Statistical-convergence fold + summary (obs/convergence.py):
@@ -1290,6 +1364,7 @@ _trace_jit = jax.jit(
         "n_groups",
         "rel_err_target",
         "batch_moves",
+        "kernel",
     ),
     # conv_state's batch accumulators are carried exactly like the flux:
     # donated in, fresh buffers out (None → no leaves, no donation).
@@ -1378,6 +1453,7 @@ _trace_packed_jit = jax.jit(
         "n_groups",
         "rel_err_target",
         "batch_moves",
+        "kernel",
     ),
     # The flux carry is donated exactly like the unpacked trace — a
     # supervisor retry re-sees its original inputs because the facade

@@ -1,0 +1,816 @@
+"""Mosaic (Pallas) walk kernel: VMEM-resident tables, matrixized tally.
+
+The XLA walk (ops/walk.py) pays one HBM gather per crossing for the
+packed ``geo20`` row and one HBM scatter-add per crossing for the tally
+pair — both latency-bound on TPU because the indices are data-dependent.
+This module is the Matrix-PIC / POLAR-PIC move (PAPERS.md): recast both
+data-dependent accesses as dense MXU-shaped contractions against tables
+that live in VMEM for the whole walk, so the entire move is ONE kernel
+launch with no per-crossing HBM traffic:
+
+  * GATHER → blocked one-hot matmul.  Each lane block's parent elements
+    become a ``[B, ntet]`` one-hot matrix; one ``[B, ntet] @ [ntet, 28]``
+    matmul fetches the whole decoded walk row (12 normals + 4 plane
+    offsets + 4 neighbor ids + 4 material-stop bits + 4 neighbor class
+    indices, every topology column stored as an exactly-representable
+    small float — no bitcast NaN patterns to poison the MXU).  A one-hot
+    row has exactly one nonzero, so the contraction is bitwise equal to
+    ``jnp.take`` (scripts/probe_pallas_gather.py records the lowering
+    probes; the one-hot form is the one Mosaic accepts).
+  * SCATTER → one-hot outer product into a tile-local accumulator.  Per
+    crossing the scored pair rides ``onehot(elem)^T @ V`` where ``V`` is
+    the ``[B, 2·n_groups]`` per-lane value matrix holding ``w·len`` at
+    column ``2g`` and ``(w·len)²`` at ``2g+1`` — a ``[ntet, B] @
+    [B, 2·n_groups]`` contraction accumulated into a VMEM-resident
+    ``[ntet, 2·n_groups]`` tile that is flushed to HBM ONCE per launch
+    (it aliases the flux operand), replacing the per-crossing XLA
+    scatter-add entirely.
+
+Bitwise parity with the XLA walk
+--------------------------------
+The parity suites compare this kernel BIT-for-BIT against the XLA path
+(tests/test_kernel_pallas.py), which constrains the design:
+
+  * the per-lane walk arithmetic reuses the exact helpers of the XLA
+    body (geometry.exit_face, chase_face_choice, escalated_bump), so
+    per-crossing trajectories are identical;
+  * the one-hot gather is exact (single nonzero per row — any reduction
+    order yields the table row bitwise);
+  * the outer-product scatter resolves same-(elem, group) collisions by
+    EXACT PEELING: per crossing, repeated passes each select the
+    lowest-indexed still-pending lane per tally bin, so every bin
+    receives its contributions as a sequence of exact single adds in
+    ascending lane order — precisely the order the XLA scatter-add
+    applies duplicate updates.  Collision-free crossings (the common
+    case) complete in one pass; a crossing with k-fold collisions costs
+    k passes.  The accumulator is seeded FROM the flux operand, so the
+    add association matches the per-crossing scatter chain exactly;
+  * the run reductions (stats vector, integrity vector, convergence
+    fold) run OUTSIDE the kernel on its per-lane outputs, through the
+    same code the XLA path uses — parity by construction, and the
+    packed-staging readback / fused feature tails compose unchanged.
+
+Regime and fallback
+-------------------
+The kernel holds the walk table ([ntet, 28]), the flux tile
+([ntet, 2·n_groups]) and all per-lane state in VMEM, so it targets the
+small/medium-mesh regime where the XLA walk's per-crossing HBM gather
+latency dominates.  ``select_backend`` enforces the budget: with
+``kernel="auto"`` a mesh that exceeds it silently falls back to the XLA
+walk; an explicit ``kernel="pallas"`` over budget is an error at
+resolve time.  Straggler compaction and the ``tally_scatter`` /
+``gathers`` strategy knobs are XLA-path scheduling concepts and are
+ignored here (the kernel is a flat loop with a matrixized scatter);
+bitwise facade parity therefore holds when the XLA path runs its flat
+loop too (compaction auto-disables below 1024 lanes — the parity-suite
+regime).
+
+Off TPU the kernel runs in Pallas interpret mode (the parity suites run
+it on CPU); ``kernel="auto"`` only selects it on a real TPU backend
+unless ``PUMI_TPU_PALLAS_INTERPRET=1`` opts interpret mode in.
+"""
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from .geometry import exit_face
+from .walk import (
+    TraceResult,
+    chase_face_choice,
+    escalated_bump,
+    integrity_vector,
+    walk_stats_vector,
+)
+
+# Decoded walk-table layout: 12 normal components + 4 plane offsets +
+# 4 neighbor ids + 4 material-stop bits + 4 neighbor class indices.
+TABLE_COLS = 28
+DEFAULT_LANE_BLOCK = 128
+# Conservative default VMEM budget for the whole-walk-resident working
+# set (16 MB/core physical; leave headroom for Mosaic's own spills).
+DEFAULT_VMEM_MB = 8.0
+
+
+def kernel_vmem_bytes(
+    ntet: int,
+    n_particles: int,
+    n_groups: int,
+    itemsize: int,
+    lane_block: int = DEFAULT_LANE_BLOCK,
+) -> int:
+    """Estimated VMEM working set of one kernel launch: the decoded walk
+    table, the flux tile (operand + accumulator), the per-lane walk
+    state, and the per-block one-hot / peel temporaries.  An estimate
+    with margin, not an exact Mosaic allocation — the budget knob
+    (``PUMI_TPU_PALLAS_VMEM_MB``) absorbs the slack."""
+    b = min(lane_block, max(n_particles, 1))
+    table = ntet * TABLE_COLS * itemsize
+    flux = 3 * ntet * n_groups * 2 * itemsize  # operand + acc + out
+    lanes = n_particles * (10 * itemsize + 9 * 4)
+    blocks = b * ntet * itemsize + b * b + b * 2 * n_groups * itemsize
+    return table + flux + lanes + blocks
+
+
+def _budget_bytes() -> int:
+    return int(
+        float(os.environ.get("PUMI_TPU_PALLAS_VMEM_MB", DEFAULT_VMEM_MB))
+        * 2**20
+    )
+
+
+def select_backend(
+    kernel: str,
+    *,
+    ntet: int,
+    n_particles: int,
+    n_groups: int,
+    dtype,
+    packed: bool,
+    platform: str | None = None,
+    strict: bool = True,
+) -> str:
+    """Resolve the (already env-resolved, combo-validated) kernel knob
+    against a concrete workload → ``"xla"`` or ``"pallas"``.
+
+    ``"auto"`` is the fallback policy: Pallas only when the working set
+    fits the VMEM budget, the mesh carries the packed ``geo20`` table,
+    and the backend is a real TPU (or interpret mode was opted in via
+    ``PUMI_TPU_PALLAS_INTERPRET=1``) — anything else silently resolves
+    to the XLA walk.  An explicit ``"pallas"`` outside its regime is an
+    error HERE, at resolve time, never mid-dispatch — unless
+    ``strict=False``, the facades' spelling of "this 'pallas' came from
+    the ``PUMI_TPU_KERNEL`` env sweep, not the config": then the kernel
+    runs wherever it CAN (packed table, inside the budget, interpret
+    mode off TPU is fine — the CI sweep's whole point) and silently
+    falls back to the XLA walk where it structurally can't, so one env
+    var can blanket a whole suite the way ``PUMI_TPU_IO_PIPELINE``
+    does."""
+    if kernel == "xla":
+        return "xla"
+    if kernel not in ("pallas", "auto"):
+        raise ValueError(
+            f"kernel must be 'xla', 'pallas' or 'auto': {kernel!r}"
+        )
+    itemsize = jnp.dtype(dtype).itemsize
+    need = kernel_vmem_bytes(ntet, n_particles, n_groups, itemsize)
+    budget = _budget_bytes()
+    if kernel == "pallas":
+        if not packed:
+            if not strict:
+                return "xla"
+            raise ValueError(
+                "kernel='pallas' needs the packed geo20 walk table "
+                "(mesh built with packed=True and < 2^24 elements); "
+                "this mesh has none — use kernel='xla' or 'auto'"
+            )
+        if need > budget:
+            if not strict:
+                return "xla"
+            raise ValueError(
+                f"kernel='pallas': estimated VMEM working set "
+                f"{need / 2**20:.1f} MiB exceeds the "
+                f"{budget / 2**20:.1f} MiB tile budget "
+                f"(ntet={ntet}, n_particles={n_particles}, "
+                f"n_groups={n_groups}); use kernel='auto' for the "
+                "automatic XLA fallback, shrink the workload, or raise "
+                "PUMI_TPU_PALLAS_VMEM_MB"
+            )
+        return "pallas"
+    # "auto"
+    if platform is None:
+        platform = jax.default_backend()
+    interpret_ok = os.environ.get("PUMI_TPU_PALLAS_INTERPRET") == "1"
+    if not packed or need > budget:
+        return "xla"
+    if platform != "tpu" and not interpret_ok:
+        return "xla"
+    return "pallas"
+
+
+def resolve_config_kernel(
+    cfg,
+    *,
+    ntet: int,
+    n_particles: int,
+    n_groups: int,
+    dtype,
+    packed: bool,
+    platform: str | None = None,
+) -> str:
+    """The ONE facade-side kernel resolve: config half
+    (``TallyConfig.resolve_kernel`` — combo validation, env override),
+    the debug-surface pin for "auto" (record_xpoints / checkify ride
+    only the XLA walk), and the workload half (``select_backend``) with
+    strictness derived from whether "pallas" is written INTO the config
+    (an env-forced "pallas" degrades gracefully).  PumiTally and
+    StreamingTallyPipeline both call this, so the downgrade list cannot
+    drift between facades."""
+    kern = cfg.resolve_kernel()
+    if kern == "xla":
+        return "xla"
+    if cfg.record_xpoints is not None or cfg.checkify_invariants:
+        # "auto" over a debug surface: the surface pins the XLA walk.
+        # (resolve_kernel already rejected/downgraded "pallas" here.)
+        return "xla"
+    return select_backend(
+        kern,
+        ntet=ntet,
+        n_particles=n_particles,
+        n_groups=n_groups,
+        dtype=dtype,
+        packed=packed,
+        platform=platform,
+        strict=cfg.kernel == "pallas",
+    )
+
+
+def decode_walk_table(mesh):
+    """[ntet, 28] decoded walk table in the mesh float dtype: the geo20
+    geometry columns verbatim, and the per-face topology codes unpacked
+    into exactly-representable small floats (neighbor id < 2^24 by the
+    geo20 packing precondition, stop bit 0/1, class index < 64) so the
+    one-hot matmul gather can never multiply a zero against a bitcast
+    NaN/inf pattern."""
+    geo = mesh.geo20
+    dtype = geo.dtype
+    code_int = jnp.int32 if geo.dtype.itemsize == 4 else jnp.int64
+    codes = jax.lax.bitcast_convert_type(
+        geo[:, 16:20], code_int
+    ).astype(jnp.int32)
+    nbr = (codes & 0xFFFFFF) - 1
+    stop = (codes >> 30) & 1
+    cls = (codes >> 24) & 0x3F
+    return jnp.concatenate(
+        [
+            geo[:, :16],
+            nbr.astype(dtype),
+            stop.astype(dtype),
+            cls.astype(dtype),
+        ],
+        axis=1,
+    )
+
+
+def _pick4(vals, face):
+    """Exact per-lane selection of one of 4 integer columns (the
+    Mosaic-friendly spelling of ``take_along_axis`` on a [B, 4] int
+    array): a where-reduce with a single hot column."""
+    iota4 = jax.lax.broadcasted_iota(jnp.int32, vals.shape, 1)
+    # dtype pinned: under x64 jnp.sum would promote int32 → int64 and
+    # poison the loop-carry dtypes.
+    return jnp.sum(
+        jnp.where(face[:, None] == iota4, vals, 0), axis=1,
+        dtype=vals.dtype,
+    )
+
+
+def _make_kernel(
+    *,
+    n_pad: int,
+    lane_block: int,
+    ntet: int,
+    n_groups: int,
+    dtype,
+    initial: bool,
+    robust: bool,
+    score_squares: bool,
+    ledger: bool,
+    unroll: int,
+    max_crossings: int,
+    tolerance: float,
+    tol_floor: float,
+):
+    """Build the kernel body for one static walk configuration.  All
+    per-lane state lives as loop-carried VMEM values; the crossing loop
+    mirrors ops/walk.py's flat body op-for-op (same helpers, same
+    masking) so trajectories are bitwise identical to the XLA walk."""
+    n_blocks = n_pad // lane_block
+    B = lane_block
+    G = n_groups
+
+    def kernel(
+        tbl_ref, origin_ref, dest_ref, elem_ref, fly_ref, w_ref, g_ref,
+        mat_ref, flux_ref,
+        pos_out, elem_out, mat_out, done_out, pseg_out, ncross_out,
+        nchase_out, nseg_out, iters_out, flux_out,
+    ):
+        tbl = tbl_ref[:]
+        dest = dest_ref[:]
+        fly = fly_ref[:] != 0
+        weight = w_ref[:]
+        group = g_ref[:]
+        good_group = (group >= 0) & (group < G)
+        i_lt = jax.lax.broadcasted_iota(
+            jnp.int32, (B, B), 1
+        ) < jax.lax.broadcasted_iota(jnp.int32, (B, B), 0)  # j < i
+        iota_bt = jax.lax.broadcasted_iota(jnp.int32, (B, ntet), 1)
+        iota_bc = jax.lax.broadcasted_iota(jnp.int32, (B, 2 * G), 1)
+
+        def tally_peel(acc, elemb, groupb, contrib, pending0):
+            """Matrixized tally scatter with EXACT collision peeling:
+            each pass selects the lowest still-pending lane per
+            (elem, group) bin and lands the whole pass as ONE
+            ``onehot(elem)^T @ V`` outer product — per-bin accumulation
+            order is ascending lane, the XLA scatter-add order."""
+            key = elemb * G + groupb
+
+            def body(c):
+                acc, pending = c
+                blocked = (
+                    (key[:, None] == key[None, :])
+                    & pending[None, :]
+                    & i_lt
+                )
+                first = pending & ~jnp.any(blocked, axis=1)
+                csel = jnp.where(first, contrib, 0.0)
+                csq = csel * csel if score_squares else csel * 0.0
+                col = 2 * groupb
+                v = jnp.where(
+                    iota_bc == col[:, None],
+                    csel[:, None],
+                    jnp.where(
+                        iota_bc == col[:, None] + 1,
+                        csq[:, None],
+                        0.0,
+                    ),
+                )
+                ohe = (
+                    (elemb[:, None] == iota_bt) & first[:, None]
+                ).astype(dtype)
+                acc = acc + jax.lax.dot_general(
+                    ohe, v, (((0,), (0,)), ((), ())),
+                    preferred_element_type=dtype,
+                )
+                return acc, pending & ~first
+
+            acc, _ = jax.lax.while_loop(
+                lambda c: jnp.any(c[1]), body, (acc, pending0)
+            )
+            return acc
+
+        def block_step(b, carry):
+            """One boundary crossing for one lane block: blocked one-hot
+            gather, the shared walk arithmetic, the matrixized tally."""
+            (cur, elem, done, mat, prev, stuck, pseg, ncross, nchase,
+             nsegl, acc, it) = carry
+            s = b * B
+            curb = jax.lax.dynamic_slice(cur, (s, 0), (B, 3))
+            destb = jax.lax.dynamic_slice(dest, (s, 0), (B, 3))
+            elemb = jax.lax.dynamic_slice(elem, (s,), (B,))
+            doneb = jax.lax.dynamic_slice(done, (s,), (B,))
+            matb = jax.lax.dynamic_slice(mat, (s,), (B,))
+            prevb = jax.lax.dynamic_slice(prev, (s,), (B,))
+            stuckb = jax.lax.dynamic_slice(stuck, (s,), (B,))
+            psegb = jax.lax.dynamic_slice(pseg, (s,), (B,))
+            ncrossb = jax.lax.dynamic_slice(ncross, (s,), (B,))
+            nchaseb = jax.lax.dynamic_slice(nchase, (s,), (B,))
+            nseglb = jax.lax.dynamic_slice(nsegl, (s,), (B,))
+            flyb = jax.lax.dynamic_slice(fly, (s,), (B,))
+            weightb = jax.lax.dynamic_slice(weight, (s,), (B,))
+            groupb = jax.lax.dynamic_slice(group, (s,), (B,))
+            goodb = jax.lax.dynamic_slice(good_group, (s,), (B,))
+
+            active = jnp.logical_not(doneb)
+
+            # ONE blocked one-hot matmul fetches the whole decoded row.
+            oh = (elemb[:, None] == iota_bt).astype(dtype)
+            row = jnp.dot(oh, tbl, preferred_element_type=dtype)
+            normals = row[:, :12].reshape(B, 4, 3)
+            dplane = row[:, 12:16]
+            nbrs_all = row[:, 16:20].astype(jnp.int32)
+            stop_all = row[:, 20:24].astype(jnp.int32)
+            cls_all = row[:, 24:28].astype(jnp.int32)
+
+            dirv = destb - curb
+            if robust:
+                backward = (prevb[:, None] >= 0) & (
+                    nbrs_all == prevb[:, None]
+                )
+                t_exit, face, has_exit, plane_num = exit_face(
+                    normals, dplane, curb, dirv, exclude=backward,
+                    return_num=True,
+                )
+                sd = -plane_num
+                contained = jnp.max(sd, axis=-1) <= 0.0
+                chase = active & (stuckb >= 4) & ~contained
+                chase_face = chase_face_choice(
+                    sd, elemb, it, dtype, nbrs_all >= 0
+                )
+                face = jnp.where(chase, chase_face, face)
+                t_exit = jnp.where(chase, 0.0, t_exit)
+                has_exit = has_exit | chase
+            else:
+                t_exit, face, has_exit = exit_face(
+                    normals, dplane, curb, dirv
+                )
+
+            dnorm = jnp.linalg.norm(dirv, axis=-1)
+            tol_eff = jnp.maximum(
+                tolerance / jnp.where(dnorm > 0, dnorm, 1.0), tol_floor
+            ).astype(dtype)
+            reached = jnp.logical_or(
+                t_exit >= 1.0 - tol_eff, jnp.logical_not(has_exit)
+            )
+            t_step = jnp.minimum(t_exit, 1.0)
+            xpoint = curb + t_step[:, None] * dirv
+
+            crossed = active & ~reached & has_exit
+            real_cross = crossed & ~chase if robust else crossed
+            ncrossb = ncrossb + real_cross.astype(ncrossb.dtype)
+            if robust:
+                nchaseb = nchaseb + chase.astype(nchaseb.dtype)
+            nbr = _pick4(nbrs_all, face)
+            next_elem = jnp.where(crossed, nbr, jnp.int32(-1))
+
+            if not initial:
+                seg = t_step * dnorm
+                score = active & flyb
+                if robust:
+                    score = score & ~chase
+                contrib = jnp.where(score, seg * weightb, 0.0).astype(
+                    dtype
+                )
+                acc = tally_peel(
+                    acc, elemb, groupb, contrib, score & goodb
+                )
+                nseglb = nseglb + score.astype(nseglb.dtype)
+                if ledger:
+                    psegb = psegb + jnp.where(score, seg, 0.0).astype(
+                        dtype
+                    )
+
+            domain_exit = crossed & (next_elem == -1)
+            if initial:
+                material_stop = jnp.zeros_like(domain_exit)
+            else:
+                stopf = _pick4(stop_all, face)
+                nbr_class = _pick4(cls_all, face)
+                material_stop = crossed & (stopf == 1)
+                if robust:
+                    material_stop = material_stop & ~chase
+            newly_done = (active & reached) | domain_exit | material_stop
+
+            if not initial:
+                matb = jnp.where(
+                    material_stop,
+                    nbr_class,
+                    jnp.where(
+                        (active & reached) | domain_exit,
+                        jnp.int32(-1),
+                        matb,
+                    ),
+                )
+
+            hopped = crossed & (next_elem != -1)
+            if robust:
+                prevb = jnp.where(
+                    hopped,
+                    jnp.where(chase, jnp.int32(-1), elemb),
+                    prevb,
+                )
+            elemb = jnp.where(hopped, next_elem, elemb)
+            curb = jnp.where(active[:, None], xpoint, curb)
+            if robust:
+                continuing = crossed & ~newly_done
+                extra, stuckb = escalated_bump(
+                    stuckb, contained, continuing, t_step, tol_floor,
+                    tol_eff, curb, dnorm, dtype,
+                )
+                curb = jnp.where(
+                    continuing[:, None],
+                    curb + extra[:, None] * dirv,
+                    curb,
+                )
+            doneb = doneb | newly_done
+
+            cur = jax.lax.dynamic_update_slice(cur, curb, (s, 0))
+            elem = jax.lax.dynamic_update_slice(elem, elemb, (s,))
+            done = jax.lax.dynamic_update_slice(done, doneb, (s,))
+            mat = jax.lax.dynamic_update_slice(mat, matb, (s,))
+            prev = jax.lax.dynamic_update_slice(prev, prevb, (s,))
+            stuck = jax.lax.dynamic_update_slice(stuck, stuckb, (s,))
+            pseg = jax.lax.dynamic_update_slice(pseg, psegb, (s,))
+            ncross = jax.lax.dynamic_update_slice(ncross, ncrossb, (s,))
+            nchase = jax.lax.dynamic_update_slice(nchase, nchaseb, (s,))
+            nsegl = jax.lax.dynamic_update_slice(nsegl, nseglb, (s,))
+            return (cur, elem, done, mat, prev, stuck, pseg, ncross,
+                    nchase, nsegl, acc, it)
+
+        def crossing(carry):
+            carry = jax.lax.fori_loop(0, n_blocks, block_step, carry)
+            return carry[:-1] + (carry[-1] + 1,)
+
+        if unroll > 1:
+            inner = crossing
+
+            def crossing(c):  # noqa: F811 — unrolled wrapper
+                for _ in range(unroll):
+                    c = inner(c)
+                return c
+
+        def cond(c):
+            return jnp.logical_and(
+                c[-1] < max_crossings, jnp.logical_not(jnp.all(c[2]))
+            )
+
+        origin = origin_ref[:]
+        elem0 = elem_ref[:]
+        zeros_i = elem0 * 0
+        carry = (
+            origin,
+            elem0,
+            jnp.logical_not(fly),
+            mat_ref[:],
+            zeros_i - 1,          # prev: no entry face yet
+            zeros_i,              # stuck
+            weight * 0,           # pseg
+            zeros_i,              # ncross
+            zeros_i,              # nchase
+            zeros_i,              # nsegl
+            flux_ref[:].reshape(ntet, 2 * G),  # tile accumulator,
+            # seeded from the flux operand so the add chain matches the
+            # XLA per-crossing scatter association exactly
+            jnp.int32(0),
+        )
+        (cur, elem, done, mat, prev, stuck, pseg, ncross, nchase,
+         nsegl, acc, it) = jax.lax.while_loop(cond, crossing, carry)
+
+        pos_out[:] = cur
+        elem_out[:] = elem
+        mat_out[:] = mat
+        done_out[:] = done
+        pseg_out[:] = pseg
+        ncross_out[:] = ncross
+        nchase_out[:] = nchase
+        nseg_out[:] = nsegl
+        iters_out[0] = it
+        flux_out[:] = acc.reshape(-1)
+
+    return kernel
+
+
+def _pad_lanes(a, n_pad, fill=0):
+    n = a.shape[0]
+    if n == n_pad:
+        return a
+    pad = jnp.full((n_pad - n,) + a.shape[1:], fill, a.dtype)
+    return jnp.concatenate([a, pad], axis=0)
+
+
+def trace_pallas_impl(
+    mesh,
+    origin,
+    dest,
+    elem,
+    in_flight,
+    weight,
+    group,
+    material_id,
+    flux,
+    *,
+    initial: bool,
+    max_crossings: int,
+    score_squares: bool = True,
+    tolerance: float = 1e-8,
+    compact_after: int | None = None,
+    compact_size: int | None = None,
+    compact_stages: tuple | None = None,
+    unroll: int = 1,
+    robust: bool = True,
+    tally_scatter: str = "auto",
+    gathers: str = "merged",
+    ledger: bool = True,
+    stats: bool = True,
+    integrity: bool = False,
+    debug_checks: bool = False,
+    record_xpoints: int | None = None,
+    n_groups: int | None = None,
+    conv_state: tuple | None = None,
+    rel_err_target: float = 0.05,
+    batch_moves: int = 1,
+    lane_block: int | None = None,
+    interpret: bool | None = None,
+) -> TraceResult:
+    """The Pallas walk with trace_impl's exact signature, so the facades
+    and the packed-staging program swap it in without plumbing changes.
+
+    ``compact_*``, ``tally_scatter`` and ``gathers`` are accepted and
+    IGNORED — they are XLA-path scheduling strategies (the kernel is a
+    flat loop with the matrixized scatter); ``record_xpoints`` and
+    ``debug_checks`` are XLA-only debug surfaces and raise (TallyConfig
+    already rejects the combinations at resolve time).  ``lane_block``
+    sets the one-hot block width B (default 128, clamped to the batch);
+    ``interpret`` defaults to "interpret off TPU" — the parity suites
+    run the kernel interpreted on CPU."""
+    del compact_after, compact_size, compact_stages  # XLA lane scheduling
+    del tally_scatter, gathers  # XLA scatter/gather strategy knobs
+    if record_xpoints is not None:
+        raise NotImplementedError(
+            "kernel='pallas' cannot record intersection points; use "
+            "kernel='xla' (TallyConfig.resolve_kernel rejects the combo)"
+        )
+    if debug_checks:
+        raise NotImplementedError(
+            "kernel='pallas' does not thread checkify device asserts; "
+            "use kernel='xla'"
+        )
+    if getattr(mesh, "geo20", None) is None:
+        raise ValueError(
+            "kernel='pallas' needs the packed geo20 walk table; this "
+            "mesh has none (packed=False, >= 2^24 elements, or > 64 "
+            "classes) — use kernel='xla'"
+        )
+    dtype = origin.dtype
+    ntet = mesh.tet2tet.shape[0]
+    n = origin.shape[0]
+    if flux.ndim == 1:
+        if n_groups is None:
+            raise ValueError(
+                "flat flux ([ntet*n_groups*2]) requires the explicit "
+                "n_groups kwarg"
+            )
+    elif n_groups is None:
+        n_groups = flux.shape[1]
+    elif flux.ndim == 3 and n_groups != flux.shape[1]:
+        raise ValueError(
+            f"n_groups={n_groups} disagrees with flux.shape[1]="
+            f"{flux.shape[1]}"
+        )
+    flux_shape = flux.shape
+    if flux_shape not in ((ntet, n_groups, 2), (ntet * n_groups * 2,)):
+        raise ValueError(
+            f"flux must be [ntet, n_groups, 2] = ({ntet}, {n_groups}, 2)"
+            f" or flat ({ntet * n_groups * 2},); got {flux_shape}"
+        )
+    if integrity and not ledger:
+        raise ValueError(
+            "integrity=True needs the per-particle track-length ledger "
+            "(ledger=True) for the conservation invariant"
+        )
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    in_flight = in_flight.astype(bool)
+    weight = weight.astype(dtype)
+    group = group.astype(jnp.int32)
+    flux_flat = flux.reshape(-1)
+    mat0 = material_id * 0 - 2  # packed-body material-code carry
+    tol_floor = 8 * float(jnp.finfo(dtype).eps)
+
+    B = min(int(lane_block or DEFAULT_LANE_BLOCK), n)
+    n_pad = -(-n // B) * B
+    tbl = decode_walk_table(mesh)
+
+    kernel = _make_kernel(
+        n_pad=n_pad,
+        lane_block=B,
+        ntet=ntet,
+        n_groups=n_groups,
+        dtype=dtype,
+        initial=initial,
+        robust=robust,
+        score_squares=score_squares,
+        ledger=ledger,
+        unroll=unroll,
+        max_crossings=max_crossings,
+        tolerance=tolerance,
+        tol_floor=tol_floor,
+    )
+    out_shape = (
+        jax.ShapeDtypeStruct((n_pad, 3), dtype),       # position
+        jax.ShapeDtypeStruct((n_pad,), jnp.int32),     # elem
+        jax.ShapeDtypeStruct((n_pad,), jnp.int32),     # material code
+        jax.ShapeDtypeStruct((n_pad,), jnp.bool_),     # done
+        jax.ShapeDtypeStruct((n_pad,), dtype),         # pseg ledger
+        jax.ShapeDtypeStruct((n_pad,), jnp.int32),     # real crossings
+        jax.ShapeDtypeStruct((n_pad,), jnp.int32),     # chase hops
+        jax.ShapeDtypeStruct((n_pad,), jnp.int32),     # scored segments
+        jax.ShapeDtypeStruct((1,), jnp.int32),         # loop iterations
+        jax.ShapeDtypeStruct(flux_flat.shape, dtype),  # flux (aliased)
+    )
+    (pos, elem_o, mat, done, pseg, ncross_l, nchase_l, nseg_l, iters,
+     flux_out) = pl.pallas_call(
+        kernel,
+        out_shape=out_shape,
+        input_output_aliases={8: 9},  # flux operand → flux output
+        interpret=interpret,
+    )(
+        tbl,
+        _pad_lanes(origin, n_pad),
+        _pad_lanes(dest, n_pad),
+        _pad_lanes(elem, n_pad),
+        _pad_lanes(in_flight.astype(jnp.int32), n_pad),
+        _pad_lanes(weight, n_pad),
+        _pad_lanes(group, n_pad),
+        _pad_lanes(mat0, n_pad, fill=-2),
+        flux_flat,
+    )
+    pos, elem_o, mat = pos[:n], elem_o[:n], mat[:n]
+    done, pseg = done[:n], pseg[:n]
+    ncross_l, nchase_l, nseg_l = ncross_l[:n], nchase_l[:n], nseg_l[:n]
+    it = iters[0]
+
+    nseg_dtype = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+    nseg = jnp.sum(nseg_l.astype(nseg_dtype))
+
+    # Material codes → class values: the identical post-loop resolve of
+    # the XLA packed body.
+    material_id = jnp.where(
+        mat == -2,
+        material_id,
+        jnp.where(
+            mat == -1,
+            jnp.int32(-1),
+            mesh.class_values[jnp.maximum(mat, 0)],
+        ),
+    )
+
+    # Run reductions OUTSIDE the kernel, through the same code the XLA
+    # path uses — the stats / integrity / convergence tails compose with
+    # packed staging unchanged and stay bitwise identical.
+    stats_vec = None
+    if stats:
+        zero = nseg * 0
+        stats_vec = walk_stats_vector(
+            ncross_l, nchase_l, done, zero, zero, nseg, it
+        )
+    integ_vec = None
+    if integrity:
+        integ_vec = integrity_vector(
+            in_flight, done, weight, pseg, pos, origin, flux_out,
+            dtype, initial,
+        )
+    conv_vec = conv_out = None
+    if conv_state is not None:
+        if initial:
+            raise ValueError(
+                "conv_state is a move-loop feature: the initial "
+                "location search scores nothing and must not advance "
+                "the batch cadence"
+            )
+        from ..obs.convergence import fold_and_reduce
+
+        conv_out, conv_vec = fold_and_reduce(
+            flux_out, *conv_state,
+            batch_moves=batch_moves, rel_err_target=rel_err_target,
+        )
+    return TraceResult(
+        position=pos,
+        elem=elem_o,
+        material_id=material_id,
+        flux=flux_out.reshape(flux_shape),
+        n_segments=nseg,
+        n_crossings=it,
+        done=done,
+        track_length=pseg if ledger else None,
+        stats=stats_vec,
+        integrity=integ_vec,
+        convergence=conv_vec,
+        conv_state=conv_out,
+    )
+
+
+_STATIC_ARGNAMES = (
+    "initial",
+    "max_crossings",
+    "score_squares",
+    "tolerance",
+    "compact_after",
+    "compact_size",
+    "compact_stages",
+    "unroll",
+    "robust",
+    "tally_scatter",
+    "gathers",
+    "ledger",
+    "stats",
+    "integrity",
+    "debug_checks",
+    "record_xpoints",
+    "n_groups",
+    "rel_err_target",
+    "batch_moves",
+    "lane_block",
+    "interpret",
+)
+
+_trace_pallas_jit = jax.jit(
+    trace_pallas_impl,
+    static_argnames=_STATIC_ARGNAMES,
+    # Same donation contract as the XLA trace: the flux / convergence
+    # accumulators are donated, the per-lane state is not.
+    donate_argnames=("flux", "conv_state"),
+)
+
+
+def trace_pallas(*args, **kwargs):
+    return _trace_pallas_jit(*args, **kwargs)
+
+
+trace_pallas.__doc__ = trace_pallas_impl.__doc__

@@ -192,6 +192,29 @@ class PartitionedTally:
             batch_moves=self.config.resolve_convergence() or 1,
         )
         self._steps: dict = {}
+        # Walk-kernel backend: the partitioned walk is its own fused
+        # per-chip program over halo-extended four-table layouts
+        # (ops/walk_partitioned.py) — there is no geo20 packing to hold
+        # in VMEM, so the Mosaic kernel's regime (ops/walk_pallas.py)
+        # does not exist here. kernel="auto" therefore resolves to the
+        # XLA step silently (the documented fallback policy), and so
+        # does an env-forced "pallas" (the PUMI_TPU_KERNEL sweep must
+        # degrade gracefully, not break partitioned suites); a
+        # config-explicit kernel="pallas" is rejected NOW, at
+        # construction, with the single-chip alternative named — never
+        # mid-dispatch.
+        self._kernel_policy = self.config.resolve_kernel()
+        if self._kernel_policy == "pallas" and self.config.kernel == "pallas":
+            raise ValueError(
+                "kernel='pallas' is a single-chip walk backend "
+                "(ops/walk_pallas.py: VMEM-resident geo20 table, "
+                "small/medium-mesh regime); the mesh-partitioned walk "
+                "runs its own fused per-chip program over halo tables "
+                "with no packed layout to tile into VMEM. Use "
+                "PumiTally(kernel='pallas') for meshes inside the VMEM "
+                "budget, or kernel='auto'/'xla' here"
+            )
+        self._kernel = "xla"
         # Move-loop I/O pipelining (ops/staging.py; PumiTally mirror):
         # "packed"/"overlap" stage ONE record per walk each way through
         # the packed step; "overlap" double-buffers the host record and
@@ -1115,17 +1138,14 @@ class PartitionedTally:
             "initialize_particle_location must run before source moves"
         )
         cfg = self.config
-        if cfg.record_xpoints is not None or cfg.checkify_invariants:
-            raise NotImplementedError(
-                "run_source_moves needs the packed megastep program; "
-                "record_xpoints / checkify_invariants require the "
-                "per-move facade path"
-            )
+        # Feature combos the fused program cannot carry fail at RESOLVE
+        # time (utils/config.resolve_megastep: record_xpoints /
+        # checkify_invariants), before any staging or dispatch.
+        K = cfg.resolve_megastep()
         from ..ops import staging
         from ..ops.source import SourceParams, phys_to_dict
 
         src = source if source is not None else SourceParams()
-        K = cfg.resolve_megastep()
         rng_key = self._rng_key(src.seed)
         stage_io = dict(h2d_bytes=0, h2d_transfers=0)
         if self._src is None or any(

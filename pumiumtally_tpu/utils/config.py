@@ -234,6 +234,45 @@ class TallyConfig:
         True (default 0.95; at least 2 completed batches are always
         required — before that every scored bin reports rel-err 1).
 
+    kernel: walk-kernel backend (ops/walk.py vs ops/walk_pallas.py).
+        "xla" (default): the scattered XLA walk — every mesh size,
+        every feature surface, the production default until a hardware
+        window validates the Mosaic path.
+        "pallas": the Mosaic kernel — VMEM-resident decoded walk table,
+        blocked one-hot MXU gather, matrixized tally scatter flushed to
+        HBM once per launch (ops/walk_pallas.py module docstring).
+        Bitwise identical to the "xla" walk's FLAT loop
+        (tests/test_kernel_pallas.py; straggler compaction is an
+        XLA-path scheduling strategy the kernel ignores — with a
+        compaction ladder active the backends agree numerically, not
+        bit-for-bit, exactly like two different XLA schedules);
+        targets the small/medium-mesh regime where the XLA walk's
+        per-crossing HBM gather latency dominates. Outside its regime
+        (no packed geo20 table, working set over the VMEM budget
+        ``PUMI_TPU_PALLAS_VMEM_MB``) construction fails at resolve
+        time; debug surfaces the kernel cannot carry (record_xpoints,
+        checkify_invariants) and the fused megastep program are
+        rejected at resolve time too (resolve_kernel).
+        "auto": "pallas" whenever the workload fits the regime — packed
+        table, VMEM budget, a real TPU backend (or
+        ``PUMI_TPU_PALLAS_INTERPRET=1`` opting interpret mode in) and
+        no conflicting feature — silently "xla" otherwise
+        (walk_pallas.select_backend).
+        The env var ``PUMI_TPU_KERNEL`` overrides the field (the CI
+        kernel steps and the bench A/B drive it); an env-forced
+        "pallas" degrades gracefully like PUMI_TPU_IO_PIPELINE does —
+        over a config carrying a debug surface it downgrades to "xla"
+        (resolve_kernel), and outside the kernel's regime (unpacked or
+        over-budget mesh, the partitioned facade, the fused megastep
+        program) the facades fall back to the XLA walk silently
+        (select_backend(strict=False)) so one env var can blanket a
+        whole suite — while the same conflict written INTO the config
+        is an error.
+        The partitioned facade accepts "auto" (resolving to its own
+        fused per-chip program — the halo-table layout has no geo20
+        packing to put in VMEM) and rejects an explicit "pallas" at
+        construction.
+
     megastep: moves fused per dispatch on the DEVICE-SOURCED move loop
         (``run_source_moves`` on both facades; ops/walk.py ``megastep``
         / ops/walk_partitioned.py ``make_partitioned_megastep``).  Each
@@ -300,12 +339,73 @@ class TallyConfig:
     batch_moves: int | None = None
     converged_fraction: float = 0.95
     megastep: int | None = None
+    kernel: str = "xla"
+
+    def resolve_kernel(self) -> str:
+        """Validate and return the walk-kernel knob ("xla" | "pallas" |
+        "auto"; env ``PUMI_TPU_KERNEL`` beats the field).
+
+        Invalid feature combos fail HERE, at resolve time, never deep
+        inside dispatch: the Mosaic kernel keeps no per-crossing
+        recording buffers (``record_xpoints``), cannot thread checkify
+        device asserts (``checkify_invariants``), and does not ride the
+        fused megastep program (``megastep``).  An env-forced "pallas"
+        over a config carrying one of those debug surfaces downgrades
+        to "xla" instead (the surface wins, exactly like
+        ``PUMI_TPU_IO_PIPELINE`` vs record_xpoints in
+        resolve_io_pipeline) so operational env sweeps never break
+        debug runs; writing the conflict INTO the config is an error.
+        The workload-dependent half of the decision (packed table, VMEM
+        budget, backend) happens against a concrete mesh in
+        ops/walk_pallas.py ``select_backend`` — also at facade
+        construction, also before any dispatch."""
+        env = os.environ.get("PUMI_TPU_KERNEL")
+        kernel = env or self.kernel
+        if kernel not in ("xla", "pallas", "auto"):
+            raise ValueError(
+                f"kernel must be 'xla', 'pallas' or 'auto': {kernel!r}"
+            )
+        if kernel == "pallas":
+            from_env_sweep = bool(env) and self.kernel != "pallas"
+            conflict = None
+            if self.record_xpoints is not None:
+                conflict = (
+                    "kernel='pallas' cannot record intersection points "
+                    "(the Mosaic kernel keeps no per-crossing recording "
+                    "buffers); use kernel='xla' or drop record_xpoints"
+                )
+            elif self.checkify_invariants:
+                conflict = (
+                    "kernel='pallas' cannot thread checkify device "
+                    "asserts through the Mosaic kernel; use "
+                    "kernel='xla' or drop checkify_invariants"
+                )
+            elif self.megastep is not None:
+                conflict = (
+                    "kernel='pallas' does not compose with the fused "
+                    "megastep program (megastep=K fuses source sampling "
+                    "+ walk + physics into one scanned XLA body); use "
+                    "kernel='xla' for device-sourced megastep runs, or "
+                    "drop megastep and drive per-move dispatches"
+                )
+            if conflict is not None:
+                if from_env_sweep:
+                    return "xla"
+                raise ValueError(conflict)
+        return kernel
 
     def resolve_megastep(self) -> int:
         """Effective moves-per-dispatch K for the device-sourced move
         loop (``run_source_moves``): the ``PUMI_TPU_MEGASTEP`` env
         override beats the field; unset means 1 (one dispatch per
-        move)."""
+        move).
+
+        Every ``run_source_moves`` entry point resolves the knob FIRST,
+        so feature combos the fused megastep program cannot carry fail
+        fast here — at resolve time, with an actionable message — for
+        any K (even K=1 runs the megastep program): recorded
+        intersection points and checkify device asserts are per-move
+        facade surfaces."""
         env = os.environ.get("PUMI_TPU_MEGASTEP")
         if env:
             k = int(env)
@@ -315,6 +415,20 @@ class TallyConfig:
             k = 1
         if k < 1:
             raise ValueError(f"megastep must be >= 1: {k}")
+        if self.record_xpoints is not None:
+            raise ValueError(
+                "the device-sourced megastep program cannot record "
+                "intersection points (record_xpoints); use the per-move "
+                "facade path (move_to_next_location) or drop "
+                "record_xpoints"
+            )
+        if self.checkify_invariants:
+            raise ValueError(
+                "the device-sourced megastep program cannot thread "
+                "checkify device asserts (checkify_invariants); use the "
+                "per-move facade path (move_to_next_location) or drop "
+                "checkify_invariants"
+            )
         return k
 
     def resolve_integrity(self) -> str:

@@ -1,0 +1,98 @@
+#!/bin/bash
+# Round-6 TPU capture: the megastep headline (still unmeasured on
+# hardware since round 4 — rounds 5/6 had no device window) plus the
+# Pallas/Mosaic walk-kernel A/B (ops/walk_pallas.py, the round-6
+# tentpole). Cheapest and highest-information first; every row reuses
+# the shared compile cache. Hardware target on the board: beat 8.53
+# Mseg/s/chip (round-2 best-ever; current defaults have never produced
+# a TPU number — BENCH_r05.json).
+#
+#   1. Headline, current defaults (flat flux, auto scatter, robust,
+#      dense ladder, fused windows) — the baseline every A/B reads
+#      against, in-window.
+#   2. Megastep facade rows: moves_per_sec / dispatches_per_move with
+#      K=8 fused moves per dispatch vs the per-move event loop
+#      (BENCH_EVENT=1 carries both in one record).
+#   3. Mosaic lowering probes at the kernel's real tile shapes
+#      (gather forms + the outer-product/peeled tally scatter) →
+#      PALLAS_PROBE_r06.json. GATES row 4: if the peeled scatter fails
+#      to lower, the kernel rows below will fail fast at compile and
+#      the JSON says exactly which form broke.
+#   4. Pallas-vs-XLA walk A/B in the kernel's regime (small/medium
+#      mesh, VMEM-resident tables): same workload, BENCH_KERNEL
+#      flipped — the only delta between the paired rows. The WHOLE
+#      working set must fit the tile budget (kernel_vmem_bytes): the
+#      per-lane walk state and the [B, ntet] one-hot block live in
+#      VMEM alongside the table, so the particle count is bounded too
+#      — 12-cell box (10.4k tets) x 8192 lanes ≈ 7.3 MiB against the
+#      default 8 MiB budget.
+#   5. Scaling rung: the A/B at 14 cells (16.5k tets x 8192 lanes
+#      ≈ 11.2 MiB — past the default budget, run with
+#      PUMI_TPU_PALLAS_VMEM_MB=12; the [B=128, ntet] one-hot block
+#      alone caps how far this ladder can climb before ~16 MB/core
+#      physical VMEM, ~24k tets).
+#
+# Runs end-to-end on CPU too (rehearsal: rows come back tagged
+# backend="cpu", the kernel rows run the Mosaic program in interpret
+# mode via PUMI_TPU_PALLAS_INTERPRET=1) — the capture is armed and
+# verified before a device window ever opens.
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p bench_out
+export JAX_COMPILATION_CACHE_DIR="$PWD/.jax_cache"
+
+run() {
+  name="$1"; shift
+  for attempt in 1 2; do
+    echo "=== $name (attempt $attempt): $* ==="
+    timeout "${CAPTURE_TIMEOUT:-2400}" "$@" \
+      >"bench_out/$name.out" 2>"bench_out/$name.err"
+    rc=$?
+    echo "rc=$rc ($name)"
+    tail -3 "bench_out/$name.out" 2>/dev/null
+    [ "$rc" -eq 0 ] && break
+  done
+}
+
+# CPU rehearsal sizes (devices absent): small enough that the
+# interpret-mode Mosaic rows finish in minutes. On hardware the
+# defaults below are used untouched.
+if [ "${CAPTURE_CPU_REHEARSAL:-0}" = "1" ]; then
+  export PUMI_FORCE_CPU=1 BENCH_PROBE=0
+  export PUMI_TPU_PALLAS_INTERPRET=1
+  HEAD_ARGS="BENCH_CELLS=12 BENCH_PARTICLES=16384 BENCH_STEPS=3"
+  AB_SMALL="BENCH_CELLS=6 BENCH_PARTICLES=512 BENCH_STEPS=2"
+  AB_SCALE="BENCH_CELLS=8 BENCH_PARTICLES=512 BENCH_STEPS=2"
+  EVENT="BENCH_EVENT=1 BENCH_EVENT_PARTICLES=4096 BENCH_EVENT_MOVES=2 BENCH_MEGASTEP=2"
+else
+  HEAD_ARGS="BENCH_CELLS=55 BENCH_PARTICLES=1048576 BENCH_STEPS=10"
+  # A/B lane counts are VMEM-bounded (see §4 above): 8192 lanes keeps
+  # both rungs inside their budgets; the paired XLA rows use the
+  # identical workload so the comparison stays one-delta.
+  AB_SMALL="BENCH_CELLS=12 BENCH_PARTICLES=8192 BENCH_STEPS=10"
+  AB_SCALE="BENCH_CELLS=14 BENCH_PARTICLES=8192 BENCH_STEPS=10"
+  EVENT="BENCH_EVENT=1 BENCH_EVENT_MOVES=8 BENCH_MEGASTEP=8"
+fi
+
+# 1+2: headline + megastep/event rows in one record.
+run bench_r6_headline env $HEAD_ARGS $EVENT BENCH_REPEAT=2 python bench.py
+
+# 3: Mosaic lowering probes at the kernel tile shapes.
+CAPTURE_TIMEOUT=900 run probe_pallas_r6 \
+    env PALLAS_PROBE_OUT=PALLAS_PROBE_r06.json \
+    python scripts/probe_pallas_gather.py
+
+# 4: paired kernel A/B — identical workload, BENCH_KERNEL flipped.
+run bench_r6_ab_xla env $AB_SMALL BENCH_EVENT=0 BENCH_REPEAT=2 \
+    BENCH_GROUPS=2 BENCH_KERNEL=xla python bench.py
+run bench_r6_ab_pallas env $AB_SMALL BENCH_EVENT=0 BENCH_REPEAT=2 \
+    BENCH_GROUPS=2 BENCH_KERNEL=pallas python bench.py
+
+# 5: scaling rung near the VMEM budget edge.
+run bench_r6_scale_xla env $AB_SCALE BENCH_EVENT=0 BENCH_REPEAT=2 \
+    BENCH_GROUPS=2 BENCH_KERNEL=xla python bench.py
+run bench_r6_scale_pallas env $AB_SCALE BENCH_EVENT=0 BENCH_REPEAT=2 \
+    BENCH_GROUPS=2 BENCH_KERNEL=pallas PUMI_TPU_PALLAS_VMEM_MB=12 \
+    python bench.py
+
+echo "=== round-6 rows complete ==="

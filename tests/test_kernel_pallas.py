@@ -1,0 +1,570 @@
+"""Pallas/Mosaic walk-kernel parity (ops/walk_pallas.py, the round-6
+tentpole) — run in INTERPRET mode on CPU, so what is pinned here is the
+PROGRAM (one-hot MXU gather, matrixized tally scatter with exact
+collision peeling, VMEM-resident decoded table), not the Mosaic
+lowering (scripts/probe_pallas_gather.py owns that question on
+hardware).
+
+Contracts:
+
+  * BITWISE parity — kernel="pallas" reproduces the XLA walk
+    bit-for-bit: flux, positions, elements, material ids, done flags,
+    the track-length ledger, and the fused stats / integrity /
+    convergence tails, at trace level (jittered meshes x dtypes x
+    tally_scatter) and through the facade (io_pipeline x dtypes,
+    multi-move chains).
+  * TRANSFER invariant — the Mosaic kernel rides the packed staging
+    program unchanged: a steady-state move is still exactly ONE H2D and
+    ONE D2H.
+  * RESOLVE-time policy — invalid combos (record_xpoints / checkify /
+    megastep) fail at TallyConfig resolve, "auto" silently falls back
+    to XLA outside the kernel's regime (no packed table, over the VMEM
+    budget, non-TPU backend without the interpret opt-in), and the
+    partitioned facade rejects an explicit "pallas" at construction.
+
+Compile budget: tier-1 runs within a few seconds of its 870 s cap, so
+the fast core suite (-m 'not slow') keeps only the resolve-time policy
+tests (no compiles) plus ONE trace-level parity smoke; every test that
+compiles a program is marked `slow` and runs in the dedicated
+kernel-pallas CI step, which executes this file in full.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pumiumtally_tpu import PumiTally, TallyConfig, make_flux
+from pumiumtally_tpu.mesh.box import build_box_arrays
+from pumiumtally_tpu.mesh.core import TetMesh
+from pumiumtally_tpu.ops.walk import trace_impl
+from pumiumtally_tpu.ops.walk_pallas import (
+    kernel_vmem_bytes,
+    select_backend,
+    trace_pallas_impl,
+)
+
+
+def _jittered_mesh(nx, jitter, seed, dtype):
+    coords, tets = build_box_arrays(1.0, 1.0, 1.0, nx, nx, nx)
+    rng = np.random.default_rng(seed)
+    h = 1.0 / nx
+    interior = (
+        (coords > 1e-9).all(axis=1) & (coords < 1 - 1e-9).all(axis=1)
+    )
+    coords = coords.copy()
+    coords[interior] += rng.uniform(
+        -jitter * h, jitter * h, (interior.sum(), 3)
+    )
+    cid = (coords[tets].mean(axis=1)[:, 0] > 0.5).astype(np.int32)
+    return TetMesh.from_numpy(coords, tets, cid, dtype=dtype)
+
+
+def _particles(mesh, dtype, n=80, seed=3, park_some=True):
+    rng = np.random.default_rng(seed)
+    elem = jnp.asarray(rng.integers(0, mesh.ntet, n).astype(np.int32))
+    origin = jnp.asarray(
+        np.asarray(mesh.centroids())[np.asarray(elem)], dtype
+    )
+    dest = jnp.asarray(rng.uniform(-0.1, 1.1, (n, 3)), dtype)
+    fly = (
+        jnp.asarray(rng.uniform(size=n) > 0.1)
+        if park_some
+        else jnp.ones(n, bool)
+    )
+    w = jnp.asarray(rng.uniform(0.5, 2.0, n), dtype)
+    g = jnp.asarray(rng.integers(0, 2, n), jnp.int32)
+    mat = jnp.full(n, -1, jnp.int32)
+    return mesh, origin, dest, elem, fly, w, g, mat
+
+
+def _assert_trace_bitwise(base, pal):
+    for name in (
+        "flux", "elem", "material_id", "done", "position",
+        "track_length", "stats", "integrity", "convergence",
+    ):
+        a, b = getattr(base, name), getattr(pal, name)
+        assert (a is None) == (b is None), name
+        if a is not None:
+            np.testing.assert_array_equal(
+                np.asarray(b), np.asarray(a), err_msg=name
+            )
+    if base.conv_state is not None:
+        for i, (a, b) in enumerate(zip(base.conv_state, pal.conv_state)):
+            np.testing.assert_array_equal(
+                np.asarray(b), np.asarray(a), err_msg=f"conv_state[{i}]"
+            )
+    assert int(pal.n_segments) == int(base.n_segments)
+    assert int(pal.n_crossings) == int(base.n_crossings)
+
+
+# --------------------------------------------------------------------- #
+# Trace-level bitwise parity: jittered meshes x dtypes x tally_scatter
+# --------------------------------------------------------------------- #
+# Tier-1 budget: one (dtype, tally_scatter) combo stays in the fast
+# core suite as the parity smoke; the rest of the grid is `slow` and
+# runs in the dedicated kernel-pallas CI step (full file, no -m).
+@pytest.mark.parametrize(
+    "dtype",
+    [jnp.float32, pytest.param(jnp.float64, marks=pytest.mark.slow)],
+)
+@pytest.mark.parametrize(
+    "tally_scatter",
+    ["pair", pytest.param("interleaved", marks=pytest.mark.slow)],
+)
+def test_trace_parity_jittered(dtype, tally_scatter):
+    mesh = _jittered_mesh(4, 0.25, seed=11, dtype=dtype)
+    args = _particles(mesh, dtype)
+    kw = dict(
+        initial=False, max_crossings=mesh.ntet + 8, tolerance=1e-8,
+        n_groups=2, unroll=2, tally_scatter=tally_scatter,
+    )
+    base = trace_impl(*args, make_flux(mesh.ntet, 2, dtype, flat=True), **kw)
+    pal = trace_impl(
+        *args, make_flux(mesh.ntet, 2, dtype, flat=True),
+        kernel="pallas", **kw,
+    )
+    assert bool(np.asarray(base.done).all())
+    _assert_trace_bitwise(base, pal)
+
+
+@pytest.mark.parametrize(
+    "dtype",
+    [
+        pytest.param(jnp.float32, marks=pytest.mark.slow),
+        pytest.param(jnp.float64, marks=pytest.mark.slow),
+    ],
+)
+def test_trace_parity_feature_tails(dtype):
+    """Stats + integrity + convergence tails fused on: every tail
+    vector and the threaded batch accumulators are bitwise identical."""
+    mesh = _jittered_mesh(4, 0.2, seed=5, dtype=dtype)
+    args = _particles(mesh, dtype)
+    nbins = mesh.ntet * 2
+
+    def conv0():
+        return (
+            jnp.zeros(nbins, dtype), jnp.zeros(nbins, dtype),
+            jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32),
+        )
+
+    kw = dict(
+        initial=False, max_crossings=mesh.ntet + 8, tolerance=1e-8,
+        n_groups=2, integrity=True, tally_scatter="pair",
+    )
+    base = trace_impl(
+        *args, make_flux(mesh.ntet, 2, dtype, flat=True),
+        conv_state=conv0(), **kw,
+    )
+    pal = trace_impl(
+        *args, make_flux(mesh.ntet, 2, dtype, flat=True),
+        conv_state=conv0(), kernel="pallas", **kw,
+    )
+    assert base.integrity is not None and base.convergence is not None
+    _assert_trace_bitwise(base, pal)
+
+
+@pytest.mark.slow
+def test_trace_parity_initial_search(dtype=jnp.float64):
+    """The tally-free location search: nothing scored, domain clips
+    only — same contract through the kernel."""
+    mesh = _jittered_mesh(4, 0.2, seed=9, dtype=dtype)
+    args = _particles(mesh, dtype, park_some=False)
+    kw = dict(
+        initial=True, max_crossings=mesh.ntet + 8, tolerance=1e-8,
+        n_groups=2,
+    )
+    base = trace_impl(*args, make_flux(mesh.ntet, 2, dtype, flat=True), **kw)
+    pal = trace_impl(
+        *args, make_flux(mesh.ntet, 2, dtype, flat=True),
+        kernel="pallas", **kw,
+    )
+    _assert_trace_bitwise(base, pal)
+    np.testing.assert_array_equal(
+        np.asarray(pal.flux), 0.0
+    )  # the search never scores
+
+
+@pytest.mark.slow
+def test_trace_parity_odd_lane_count(dtype=jnp.float32):
+    """n not a multiple of the lane block: the pad lanes must be inert
+    (parity + no phantom scores)."""
+    mesh = _jittered_mesh(3, 0.2, seed=2, dtype=dtype)
+    args = _particles(mesh, dtype, n=37)
+    kw = dict(
+        initial=False, max_crossings=mesh.ntet + 8, tolerance=1e-8,
+        n_groups=2, tally_scatter="pair",
+    )
+    base = trace_impl(*args, make_flux(mesh.ntet, 2, dtype, flat=True), **kw)
+    pal = trace_pallas_impl(
+        *args, make_flux(mesh.ntet, 2, dtype, flat=True),
+        lane_block=16, **kw,
+    )
+    _assert_trace_bitwise(base, pal)
+
+
+# --------------------------------------------------------------------- #
+# Facade parity: io_pipeline x dtype, multi-move chains
+# --------------------------------------------------------------------- #
+N = 96
+
+
+@pytest.fixture(scope="module")
+def mesh64():
+    coords, t2v = build_box_arrays(1.0, 1.0, 1.0, 3, 3, 3)
+    cen = coords[t2v].mean(axis=1)
+    cls = np.where(cen[:, 0] < 0.5, 1, 2).astype(np.int32)
+    return TetMesh.from_numpy(coords, t2v, class_id=cls, dtype=jnp.float64)
+
+
+def _drive(t, moves=3, seed=17):
+    rng = np.random.default_rng(seed)
+    n = t.num_particles
+    pos = rng.uniform(0.05, 0.95, (n, 3))
+    t.initialize_particle_location(pos.ravel().copy(), n * 3)
+    outs, prev = [], pos
+    for _ in range(moves):
+        dest = np.clip(prev + rng.normal(0, 0.25, (n, 3)), -0.1, 1.1)
+        buf = dest.ravel().copy()
+        flying = np.ones(n, np.int8)
+        flying[::7] = 0
+        w = rng.uniform(0.5, 2.0, n)
+        g = rng.integers(0, 2, n).astype(np.int32)
+        mats = np.full(n, 9, np.int32)
+        t.move_to_next_location(buf, flying, w, g, mats, buf.size)
+        outs.append((buf.reshape(n, 3).copy(), mats.copy()))
+        prev = buf.reshape(n, 3).copy()
+    return outs
+
+
+def _cfg(io, dtype=jnp.float64, **kw):
+    return TallyConfig(
+        n_groups=2, dtype=dtype, tolerance=1e-8, io_pipeline=io, **kw
+    )
+
+
+@pytest.fixture(scope="module")
+def golden_xla(mesh64):
+    t = PumiTally(mesh64, N, _cfg("packed", kernel="xla"))
+    outs = _drive(t)
+    return outs, np.asarray(t.raw_flux), t.total_segments
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("io", ["legacy", "packed", "overlap"])
+def test_facade_parity_io_modes(mesh64, golden_xla, io):
+    outs_a, flux_a, segs_a = golden_xla
+    b = PumiTally(mesh64, N, _cfg(io, kernel="pallas"))
+    assert b._kernel == "pallas"
+    outs_b = _drive(b)
+    for (pa, ma), (pb, mb) in zip(outs_a, outs_b):
+        np.testing.assert_array_equal(pb, pa)
+        np.testing.assert_array_equal(mb, ma)
+    np.testing.assert_array_equal(np.asarray(b.raw_flux), flux_a)
+    assert b.total_segments == segs_a
+
+
+@pytest.mark.slow
+def test_facade_parity_f32(mesh64):
+    coords, t2v = build_box_arrays(1.0, 1.0, 1.0, 3, 3, 3)
+    cen = coords[t2v].mean(axis=1)
+    cls = np.where(cen[:, 0] < 0.5, 1, 2).astype(np.int32)
+    mesh = TetMesh.from_numpy(coords, t2v, class_id=cls, dtype=jnp.float32)
+    a = PumiTally(mesh, N, _cfg("packed", jnp.float32, kernel="xla"))
+    b = PumiTally(mesh, N, _cfg("packed", jnp.float32, kernel="pallas"))
+    outs_a, outs_b = _drive(a, moves=2), _drive(b, moves=2)
+    for (pa, ma), (pb, mb) in zip(outs_a, outs_b):
+        np.testing.assert_array_equal(pb, pa)
+        np.testing.assert_array_equal(mb, ma)
+    np.testing.assert_array_equal(
+        np.asarray(b.raw_flux), np.asarray(a.raw_flux)
+    )
+
+
+@pytest.mark.slow
+def test_facade_parity_feature_tails_telemetry(mesh64):
+    """Stats/integrity/convergence fused tails through the packed
+    facade path: identical flux AND identical telemetry read surfaces."""
+    kw = dict(
+        integrity="warn", convergence=True, batch_moves=2,
+        walk_stats=True,
+    )
+    a = PumiTally(mesh64, N, _cfg("packed", kernel="xla", **kw))
+    b = PumiTally(mesh64, N, _cfg("packed", kernel="pallas", **kw))
+    outs_a, outs_b = _drive(a), _drive(b)
+    for (pa, ma), (pb, mb) in zip(outs_a, outs_b):
+        np.testing.assert_array_equal(pb, pa)
+        np.testing.assert_array_equal(mb, ma)
+    np.testing.assert_array_equal(
+        np.asarray(b.raw_flux), np.asarray(a.raw_flux)
+    )
+    ta, tb = a.telemetry(), b.telemetry()
+    assert tb["totals"]["crossings"] == ta["totals"]["crossings"]
+    assert tb["totals"]["segments"] == ta["totals"]["segments"]
+    assert (
+        tb["integrity"]["violations"] == ta["integrity"]["violations"]
+    )
+    np.testing.assert_array_equal(
+        np.asarray(b.relative_error()), np.asarray(a.relative_error())
+    )
+    assert tb["convergence"]["n_batches"] == ta["convergence"]["n_batches"]
+    assert tb["convergence"]["scored"] == ta["convergence"]["scored"]
+
+
+@pytest.mark.slow
+def test_steady_state_one_transfer_each_way_pallas(mesh64):
+    """The Mosaic kernel rides the packed staging program unchanged:
+    ONE H2D (the move record) + ONE D2H (the coalesced readback)."""
+    t = PumiTally(mesh64, N, _cfg("packed", kernel="pallas"))
+    _drive(t, moves=2)  # warm/compile
+    totals = t.telemetry()["totals"]
+    before = (totals["h2d_transfers"], totals["d2h_transfers"])
+    rng = np.random.default_rng(5)
+    buf = rng.uniform(0.1, 0.9, (N, 3)).ravel().copy()
+    with jax.transfer_guard("disallow"):
+        t.move_to_next_location(
+            buf, np.ones(N, np.int8), np.ones(N),
+            np.zeros(N, np.int32), np.full(N, -1, np.int32),
+        )
+    totals = t.telemetry()["totals"]
+    assert totals["h2d_transfers"] - before[0] == 1
+    assert totals["d2h_transfers"] - before[1] == 1
+
+
+# --------------------------------------------------------------------- #
+# Resolve-time policy: combos, env override, auto fallback
+# --------------------------------------------------------------------- #
+def test_resolve_kernel_rejects_record_xpoints():
+    with pytest.raises(ValueError, match="intersection points"):
+        TallyConfig(kernel="pallas", record_xpoints=4).resolve_kernel()
+
+
+def test_resolve_kernel_rejects_checkify():
+    with pytest.raises(ValueError, match="checkify"):
+        TallyConfig(
+            kernel="pallas", checkify_invariants=True
+        ).resolve_kernel()
+
+
+def test_resolve_kernel_rejects_megastep():
+    with pytest.raises(ValueError, match="megastep"):
+        TallyConfig(kernel="pallas", megastep=4).resolve_kernel()
+
+
+def test_resolve_kernel_rejects_unknown():
+    with pytest.raises(ValueError, match="kernel must be"):
+        TallyConfig(kernel="mosaic").resolve_kernel()
+
+
+def test_resolve_megastep_rejects_record_xpoints():
+    with pytest.raises(ValueError, match="record_xpoints"):
+        TallyConfig(megastep=4, record_xpoints=4).resolve_megastep()
+
+
+def test_resolve_megastep_rejects_checkify():
+    with pytest.raises(ValueError, match="checkify_invariants"):
+        TallyConfig(
+            megastep=2, checkify_invariants=True
+        ).resolve_megastep()
+
+
+def test_env_override_beats_field(monkeypatch):
+    monkeypatch.setenv("PUMI_TPU_KERNEL", "pallas")
+    assert TallyConfig(kernel="xla").resolve_kernel() == "pallas"
+    monkeypatch.setenv("PUMI_TPU_KERNEL", "bogus")
+    with pytest.raises(ValueError, match="kernel must be"):
+        TallyConfig().resolve_kernel()
+
+
+def test_env_pallas_over_debug_config_downgrades(monkeypatch):
+    """An env-forced 'pallas' over a config carrying a debug surface
+    downgrades to 'xla' (operational sweeps never break debug runs);
+    the same conflict written INTO the config raises."""
+    monkeypatch.setenv("PUMI_TPU_KERNEL", "pallas")
+    assert (
+        TallyConfig(record_xpoints=4).resolve_kernel() == "xla"
+    )
+    assert (
+        TallyConfig(checkify_invariants=True).resolve_kernel() == "xla"
+    )
+    with pytest.raises(ValueError, match="intersection points"):
+        TallyConfig(
+            kernel="pallas", record_xpoints=4
+        ).resolve_kernel()
+
+
+def test_select_backend_auto_platform_gate(monkeypatch):
+    """auto → pallas only on a real TPU (or with the interpret opt-in);
+    the CPU test backend resolves to xla without the env."""
+    monkeypatch.delenv("PUMI_TPU_PALLAS_INTERPRET", raising=False)
+    kw = dict(
+        ntet=200, n_particles=64, n_groups=2, dtype=jnp.float32,
+        packed=True,
+    )
+    assert select_backend("auto", **kw) == "xla"
+    monkeypatch.setenv("PUMI_TPU_PALLAS_INTERPRET", "1")
+    assert select_backend("auto", **kw) == "pallas"
+    assert select_backend("auto", platform="tpu", **kw) == "pallas"
+
+
+def test_select_backend_auto_vmem_fallback(monkeypatch):
+    """The acceptance contract: auto above the VMEM tile budget falls
+    back to XLA without error; explicit pallas raises with the budget
+    arithmetic in the message."""
+    monkeypatch.setenv("PUMI_TPU_PALLAS_INTERPRET", "1")
+    big = dict(
+        ntet=4_000_000, n_particles=1024, n_groups=8,
+        dtype=jnp.float32, packed=True,
+    )
+    assert kernel_vmem_bytes(4_000_000, 1024, 8, 4) > 8 * 2**20
+    assert select_backend("auto", **big) == "xla"
+    with pytest.raises(ValueError, match="VMEM working set"):
+        select_backend("pallas", **big)
+
+
+def test_select_backend_unpacked_mesh(monkeypatch):
+    monkeypatch.setenv("PUMI_TPU_PALLAS_INTERPRET", "1")
+    kw = dict(
+        ntet=200, n_particles=64, n_groups=2, dtype=jnp.float32,
+        packed=False,
+    )
+    assert select_backend("auto", **kw) == "xla"
+    with pytest.raises(ValueError, match="geo20"):
+        select_backend("pallas", **kw)
+
+
+@pytest.mark.slow
+def test_facade_auto_fallback_over_budget(mesh64, monkeypatch):
+    """kernel='auto' on a facade whose workload exceeds the budget:
+    constructs and moves on the XLA walk without error."""
+    monkeypatch.setenv("PUMI_TPU_PALLAS_INTERPRET", "1")
+    monkeypatch.setenv("PUMI_TPU_PALLAS_VMEM_MB", "0.001")
+    t = PumiTally(mesh64, N, _cfg("packed", kernel="auto"))
+    assert t._kernel == "xla"
+    _drive(t, moves=1)
+    monkeypatch.setenv("PUMI_TPU_PALLAS_VMEM_MB", "8")
+    t2 = PumiTally(mesh64, N, _cfg("packed", kernel="auto"))
+    assert t2._kernel == "pallas"
+
+
+def test_facade_explicit_pallas_over_budget_raises(mesh64, monkeypatch):
+    monkeypatch.setenv("PUMI_TPU_PALLAS_VMEM_MB", "0.001")
+    with pytest.raises(ValueError, match="VMEM working set"):
+        PumiTally(mesh64, N, _cfg("packed", kernel="pallas"))
+
+
+def test_partitioned_rejects_explicit_pallas(mesh64):
+    from pumiumtally_tpu.parallel.partitioned_api import PartitionedTally
+
+    with pytest.raises(ValueError, match="single-chip"):
+        PartitionedTally(
+            mesh64, N, _cfg("packed", kernel="pallas"), n_parts=4
+        )
+
+
+def test_partitioned_auto_resolves_xla(mesh64):
+    from pumiumtally_tpu.parallel.partitioned_api import PartitionedTally
+
+    t = PartitionedTally(
+        mesh64, N, _cfg("packed", kernel="auto"), n_parts=4
+    )
+    assert t._kernel == "xla"
+
+
+@pytest.mark.slow
+def test_run_source_moves_rejects_explicit_pallas(mesh64):
+    t = PumiTally(mesh64, N, _cfg("packed", kernel="pallas"))
+    rng = np.random.default_rng(0)
+    t.initialize_particle_location(
+        rng.uniform(0.1, 0.9, (N, 3)).ravel().copy()
+    )
+    with pytest.raises(NotImplementedError, match="pallas"):
+        t.run_source_moves(1)
+
+
+# --------------------------------------------------------------------- #
+# Env-forced sweep (PUMI_TPU_KERNEL=pallas): graceful degradation
+# --------------------------------------------------------------------- #
+def test_select_backend_nonstrict_falls_back():
+    """strict=False — the facades' spelling of 'this pallas came from
+    the env sweep': outside the regime the resolve silently lands on
+    XLA instead of raising."""
+    kw = dict(n_particles=64, n_groups=2, dtype=jnp.float32)
+    assert (
+        select_backend("pallas", ntet=200, packed=False, strict=False, **kw)
+        == "xla"
+    )
+    assert (
+        select_backend(
+            "pallas", ntet=4_000_000, packed=True, strict=False, **kw
+        )
+        == "xla"
+    )
+    assert (
+        select_backend("pallas", ntet=200, packed=True, strict=False, **kw)
+        == "pallas"
+    )
+
+
+def test_env_forced_pallas_in_regime_uses_kernel(mesh64, monkeypatch):
+    monkeypatch.setenv("PUMI_TPU_KERNEL", "pallas")
+    t = PumiTally(mesh64, N, _cfg("packed"))
+    assert t._kernel == "pallas"
+
+
+def test_env_forced_pallas_degrades_over_budget(mesh64, monkeypatch):
+    """The same construction that raises for a config-explicit 'pallas'
+    (test_facade_explicit_pallas_over_budget_raises) quietly runs the
+    XLA walk when the 'pallas' came from the env sweep."""
+    monkeypatch.setenv("PUMI_TPU_KERNEL", "pallas")
+    monkeypatch.setenv("PUMI_TPU_PALLAS_VMEM_MB", "0.001")
+    t = PumiTally(mesh64, N, _cfg("packed"))
+    assert t._kernel == "xla"
+
+
+def test_env_forced_pallas_degrades_partitioned(mesh64, monkeypatch):
+    """PUMI_TPU_KERNEL=pallas over a partitioned suite (the CI faults
+    sweep runs test_truncation.py, which builds PartitionedTally) must
+    construct on the XLA step, not raise."""
+    from pumiumtally_tpu.parallel.partitioned_api import PartitionedTally
+
+    monkeypatch.setenv("PUMI_TPU_KERNEL", "pallas")
+    t = PartitionedTally(mesh64, N, _cfg("packed"), n_parts=4)
+    assert t._kernel == "xla"
+
+
+@pytest.mark.slow
+def test_env_forced_pallas_runs_megastep(mesh64, monkeypatch):
+    """Device-sourced runs under the env sweep land on the XLA megastep
+    silently; only a config-explicit kernel='pallas' rejects
+    run_source_moves."""
+    monkeypatch.setenv("PUMI_TPU_KERNEL", "pallas")
+    t = PumiTally(mesh64, N, _cfg("packed"))
+    rng = np.random.default_rng(0)
+    t.initialize_particle_location(
+        rng.uniform(0.1, 0.9, (N, 3)).ravel().copy()
+    )
+    out = t.run_source_moves(1)
+    assert isinstance(out, dict)
+
+
+@pytest.mark.slow
+def test_truncation_escalation_composes(mesh64):
+    """The resilience re-walk path drives the SAME kernel: a pallas
+    facade with truncation_retries configured walks and re-walks
+    bit-identically to the XLA one."""
+    a = PumiTally(
+        mesh64, N, _cfg("packed", kernel="xla", truncation_retries=2)
+    )
+    b = PumiTally(
+        mesh64, N, _cfg("packed", kernel="pallas", truncation_retries=2)
+    )
+    outs_a, outs_b = _drive(a, moves=2), _drive(b, moves=2)
+    for (pa, ma), (pb, mb) in zip(outs_a, outs_b):
+        np.testing.assert_array_equal(pb, pa)
+        np.testing.assert_array_equal(mb, ma)
+    np.testing.assert_array_equal(
+        np.asarray(b.raw_flux), np.asarray(a.raw_flux)
+    )
