@@ -1,0 +1,108 @@
+"""Static analysis of the codebase and its compiled programs.
+
+Seven PRs of invariants — the 1 H2D + 1 D2H per move/megastep contract,
+donated-buffer discipline, bitwise XLA↔Pallas parity, f32 dtype hygiene,
+and the lock protocols of the threaded observers — were until now pinned
+only by runtime tests that must *execute* a failure to see it.  This
+package makes them machine-checked properties of the code and of the
+lowered programs themselves, in two layers:
+
+  * :mod:`analysis.astlint` — an AST lint engine with codebase-specific
+    rules (PUMI001..PUMI007): host syncs inside traced bodies, transfers
+    outside the approved staging modules, use-after-donate, trace-time
+    nondeterminism, stray float64 on device paths, jit static-argnum
+    hygiene, and a ``# guarded by: <lock>`` concurrency lint over the
+    threaded surface (FlightRecorder / watchdog / HostStager / exporter).
+  * :mod:`analysis.contracts` — abstract-traces the public program
+    families (trace, trace_packed, megastep, the partitioned packed
+    step, the Pallas kernel in interpret mode) to jaxpr + lowered
+    StableHLO and asserts structural invariants: zero host callbacks and
+    zero in-program transfers (the 1+1 contract's compiled half),
+    donation aliases actually present, f32 dtype purity, scan-not-while
+    control flow, expected scatter counts — then diffs the extracted
+    signatures against the committed ``CONTRACTS.json`` baseline so any
+    structural drift fails CI with a named invariant.
+
+``scripts/lint.py`` runs both layers with the ``LINT_BASELINE.json``
+suppression file (every suppression carries a justification string); the
+``static-analysis`` CI step fails on any non-baselined finding.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One static-analysis finding.
+
+    ``symbol`` is the enclosing ``Class.method`` / function qualname (or
+    ``"<module>"``) — baseline suppressions match on (rule, path, symbol)
+    so they survive unrelated line-number drift.
+    """
+
+    rule: str
+    path: str
+    line: int
+    symbol: str
+    message: str
+
+    def render(self) -> str:
+        return (
+            f"{self.path}:{self.line}: {self.rule} [{self.symbol}] "
+            f"{self.message}"
+        )
+
+
+def load_baseline(path) -> list[dict]:
+    """Read a LINT_BASELINE.json suppression file.
+
+    Schema: ``{"suppressions": [{"rule", "path", "symbol",
+    "justification"}, ...]}``.  Every entry MUST carry a non-empty
+    justification — an unexplained suppression is itself a finding.
+    """
+    with open(path) as fh:
+        data = json.load(fh)
+    entries = data.get("suppressions", [])
+    for e in entries:
+        for key in ("rule", "path", "symbol", "justification"):
+            if not str(e.get(key, "")).strip():
+                raise ValueError(
+                    f"baseline entry {e!r} is missing a non-empty "
+                    f"{key!r} — every suppression must name what it "
+                    "hides and why"
+                )
+    return entries
+
+
+def apply_baseline(findings: list[Finding], entries: list[dict]):
+    """Split findings into (kept, suppressed) and report unused entries.
+
+    Returns ``(kept, suppressed, unused_entries)``.  Unused entries are
+    reported so a fixed finding retires its suppression instead of
+    leaving a stale hole the next regression could slip through.
+    """
+    used = [False] * len(entries)
+
+    def matches(e, f):
+        return (
+            e["rule"] == f.rule
+            and e["path"] == f.path
+            and e["symbol"] == f.symbol
+        )
+
+    kept, suppressed = [], []
+    for f in findings:
+        hit = None
+        for i, e in enumerate(entries):
+            if matches(e, f):
+                hit = i
+                break
+        if hit is None:
+            kept.append(f)
+        else:
+            used[hit] = True
+            suppressed.append(f)
+    unused = [e for i, e in enumerate(entries) if not used[i]]
+    return kept, suppressed, unused

@@ -1,0 +1,1230 @@
+"""AST lint engine with codebase-specific rules (layer 1).
+
+The rules encode invariants that runtime tests can only witness by
+executing a failure; here they are properties of the source tree:
+
+  PUMI001 host-sync-in-traced     ``float()`` / ``.item()`` /
+      ``np.asarray`` / ``jax.device_get`` applied to traced values
+      inside a traced body — a silent device sync (or a tracer error)
+      on the hot path.
+  PUMI002 transfer-outside-staging  ``jax.device_put`` /
+      ``jax.device_get`` outside the approved staging modules: the
+      1 H2D + 1 D2H move contract means transfers are a structural
+      property of a handful of files, and a transfer anywhere else is a
+      contract hole.
+  PUMI003 use-after-donate        a buffer name is passed at a donated
+      argnum/argname of a jitted program and then read again — XLA may
+      already have scribbled over it.
+  PUMI004 nondeterminism-in-traced  ``time.*`` / ``random.*`` /
+      ``np.random.*`` / ``datetime.now`` inside a traced body: frozen at
+      trace time into the compiled program, different per retrace —
+      breaks bitwise replay (checkpoint resume, retry re-arm).
+  PUMI005 f64-on-device-path      ``jnp.float64`` (or a "float64"
+      dtype literal / ``np.float64`` in a traced body) outside
+      ``integrity/audit.py`` — the f32 production configs must stay
+      f64-free on device (the shadow audit is the one sanctioned f64
+      surface).
+  PUMI006 jit-static-hygiene      ``jax.jit(...)`` constructed inside a
+      loop (a fresh wrapper and cache entry per iteration), or a
+      jitted callable fed a loop induction variable at a STATIC
+      argnum/argname (one recompile per iteration).
+  PUMI007 guarded-by              attributes annotated
+      ``# guarded by: <lock>`` must only be touched under ``with
+      <lock>:`` outside ``__init__``; locals annotated
+      ``# guarded by: <event> (event)`` must be written only by worker
+      closures that ``<event>.set()`` and read only after
+      ``<event>.wait(...)``.
+
+The traced-body notion is a package-wide fixpoint: functions handed to
+``jax.jit`` / ``lax.scan`` / ``while_loop`` / ``fori_loop`` / ``cond`` /
+``switch`` / ``vmap`` / ``shard_map`` / ``pallas_call`` /
+``checkify.checkify`` (as decorator or argument) are traced, every
+function a traced function calls (resolved through module-level defs and
+intra-package imports, including function-local imports) is traced, and
+nested defs inherit the enclosing function's tracedness.
+
+Findings are suppressed per (rule, path, symbol) through
+``LINT_BASELINE.json`` (analysis.apply_baseline) — justification
+required.
+"""
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from . import Finding
+
+PACKAGE = "pumiumtally_tpu"
+
+# Modules allowed to issue jax.device_put / jax.device_get: the staging
+# layer itself, the facades that own the 1+1 move contract, the sharding
+# / checkpoint plumbing, and device-table construction.  A transfer
+# anywhere else is a new, unaccounted host<->device edge.
+APPROVED_TRANSFER_MODULES = frozenset(
+    {
+        f"{PACKAGE}/ops/staging.py",
+        f"{PACKAGE}/ops/source.py",
+        f"{PACKAGE}/ops/walk_partitioned.py",
+        f"{PACKAGE}/api.py",
+        f"{PACKAGE}/parallel/partitioned_api.py",
+        f"{PACKAGE}/parallel/particle_sharding.py",
+        f"{PACKAGE}/utils/checkpoint.py",
+        f"{PACKAGE}/models/pipeline.py",
+    }
+)
+
+# The one module allowed to hold float64 on purpose: the shadow-audit
+# reference walker is DEFINED as an f64 NumPy oracle.
+F64_EXEMPT_MODULES = frozenset({f"{PACKAGE}/integrity/audit.py"})
+
+# Call heads whose function-valued arguments become traced.
+_TRACING_HEADS_LAST = frozenset(
+    {"jit", "pallas_call", "shard_map", "vmap", "pmap", "checkify"}
+)
+_TRACING_HEADS_LAX = frozenset(
+    {
+        "scan",
+        "while_loop",
+        "fori_loop",
+        "cond",
+        "switch",
+        "map",
+        "associative_scan",
+        "custom_root",
+    }
+)
+
+_HOST_SYNC_FUNCS = frozenset({"float", "int", "bool"})
+_HOST_SYNC_ATTRS = frozenset({"item", "tolist", "to_py", "__array__"})
+_HOST_SYNC_NP = frozenset(
+    {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
+)
+_DEVICE_GET = frozenset({"jax.device_get", "device_get"})
+_DEVICE_PUT = frozenset({"jax.device_put", "device_put"})
+
+_NONDET_PREFIXES = (
+    "time.",
+    "random.",
+    "np.random.",
+    "numpy.random.",
+    "datetime.datetime.now",
+    "datetime.datetime.utcnow",
+    "datetime.now",
+    "os.urandom",
+    "uuid.",
+    "secrets.",
+)
+
+_GUARD_RE = re.compile(r"#\s*guarded by:\s*(?P<lock>[^#]+?)\s*$")
+_EVENT_SUFFIX_RE = re.compile(r"\(event\)\s*$")
+
+
+def _walk_shallow(fn):
+    """Walk a function body WITHOUT descending into nested defs: each
+    def is analyzed as its own scope (it has its own entry in
+    ``PackageIndex.defs``), so a deep walk would double-report and
+    cross-taint sibling scopes.  Lambdas stay in scope — they share the
+    enclosing function's locals."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def _dotted(node) -> str | None:
+    """'a.b.c' for Name/Attribute chains, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _const_str(node) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+@dataclass
+class Module:
+    path: str
+    tree: ast.Module
+    lines: list[str]
+    comments: dict[int, str] = field(default_factory=dict)
+
+
+def _parse(path: str, source: str) -> Module:
+    tree = ast.parse(source, filename=path)
+    comments: dict[int, str] = {}
+    try:
+        for tok in tokenize.generate_tokens(
+            io.StringIO(source).readline
+        ):
+            if tok.type == tokenize.COMMENT:
+                comments[tok.start[0]] = tok.string
+    except tokenize.TokenError:
+        pass
+    return Module(path, tree, source.splitlines(), comments)
+
+
+# --------------------------------------------------------------------- #
+# Package index: defs, imports, traced-function fixpoint
+# --------------------------------------------------------------------- #
+def _module_of_import(cur_path: str, level: int, module: str | None,
+                      known: set[str]) -> str | None:
+    """Resolve a (possibly relative) import to a known package relpath
+    (``a/b.py`` or ``a/b/__init__.py``), else None."""
+    if level == 0:
+        base = (module or "").split(".")
+        if base and base[0] != PACKAGE.split("/")[0]:
+            return None
+        parts = base
+    else:
+        here = cur_path.split("/")[:-1]  # directory of current module
+        up = level - 1
+        if up:
+            here = here[: len(here) - up] if up <= len(here) else []
+        parts = here + ([p for p in (module or "").split(".") if p])
+    cand = "/".join(parts) + ".py"
+    if cand in known:
+        return cand
+    cand = "/".join(parts) + "/__init__.py"
+    if cand in known:
+        return cand
+    return None
+
+
+class PackageIndex:
+    """Cross-module name resolution + the traced-function fixpoint."""
+
+    def __init__(self, modules: dict[str, Module]):
+        self.modules = modules
+        known = set(modules)
+        # (path, qualname) -> def node
+        self.defs: dict[tuple[str, str], ast.AST] = {}
+        # path -> {local name -> ("def", qualname) |
+        #          ("name", path2, remote_name) | ("mod", path2)}
+        self.scope: dict[str, dict] = {}
+        self.parents: dict[str, dict[ast.AST, ast.AST]] = {}
+        for path, mod in modules.items():
+            env: dict = {}
+            parent: dict[ast.AST, ast.AST] = {}
+            for node in ast.walk(mod.tree):
+                for child in ast.iter_child_nodes(node):
+                    parent[child] = node
+            self.parents[path] = parent
+            for node in ast.walk(mod.tree):
+                if isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    q = self._qualname(path, node, parent)
+                    self.defs[(path, q)] = node
+                    if "." not in q:
+                        env[node.name] = ("def", q)
+                elif isinstance(node, ast.ImportFrom):
+                    tgt = _module_of_import(
+                        path, node.level, node.module, known
+                    )
+                    for alias in node.names:
+                        name = alias.asname or alias.name
+                        if tgt is None:
+                            continue
+                        # `from . import staging` resolves the NAME as a
+                        # submodule when one exists.
+                        sub = _module_of_import(
+                            path, node.level,
+                            f"{node.module}.{alias.name}"
+                            if node.module else alias.name,
+                            known,
+                        )
+                        if sub is not None:
+                            env.setdefault(name, ("mod", sub))
+                        else:
+                            env.setdefault(
+                                name, ("name", tgt, alias.name)
+                            )
+                elif isinstance(node, ast.Import):
+                    pass  # absolute external imports — not package code
+            self.scope[path] = env
+        self.traced: set[tuple[str, str]] = set()
+        self._seed_traced()
+        self._propagate()
+
+    # -- qualnames ---------------------------------------------------- #
+    def _qualname(self, path, node, parent) -> str:
+        parts = [node.name]
+        cur = parent.get(node)
+        while cur is not None:
+            if isinstance(
+                cur,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef),
+            ):
+                parts.append(cur.name)
+            cur = parent.get(cur)
+        return ".".join(reversed(parts))
+
+    def qualname(self, path, node) -> str:
+        return self._qualname(path, node, self.parents[path])
+
+    def enclosing_symbol(self, path, node) -> str:
+        cur = node
+        parent = self.parents[path]
+        while cur is not None:
+            if isinstance(
+                cur,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef),
+            ):
+                return self._qualname(path, cur, parent)
+            cur = parent.get(cur)
+        return "<module>"
+
+    # -- traced fixpoint ---------------------------------------------- #
+    def _is_tracing_head(self, func) -> bool:
+        d = _dotted(func)
+        if d is None:
+            # jax.jit(...)(x) etc — head is itself a call; the inner
+            # call was already seen by ast.walk.
+            return False
+        last = d.split(".")[-1]
+        if last in _TRACING_HEADS_LAST:
+            return True
+        if last in _TRACING_HEADS_LAX:
+            head = d.split(".")[0]
+            return head in ("lax", "jax") or d.startswith("jax.lax.")
+        return False
+
+    def _callable_args(self, call: ast.Call):
+        for a in list(call.args) + [k.value for k in call.keywords]:
+            yield a
+            # functools.partial(fn, ...) / partial(fn, ...)
+            if isinstance(a, ast.Call):
+                d = _dotted(a.func) or ""
+                if d.split(".")[-1] == "partial" and a.args:
+                    yield a.args[0]
+
+    def _resolve(self, path: str, name_node,
+                 local_env: dict | None = None):
+        """Resolve a Name/Attribute to a (path, qualname) def key."""
+        if isinstance(name_node, ast.Name):
+            name = name_node.id
+            for env in (local_env or {},):
+                if name in env:
+                    return env[name]
+            entry = self.scope[path].get(name)
+            if entry is None:
+                return None
+            if entry[0] == "def":
+                return ("def@", path, entry[1])
+            if entry[0] == "name":
+                _, p2, remote = entry
+                if (p2, remote) in self.defs:
+                    return ("def@", p2, remote)
+                return None
+            return None
+        if isinstance(name_node, ast.Attribute):
+            base = name_node.value
+            if isinstance(base, ast.Name):
+                entry = self.scope[path].get(base.id)
+                if entry and entry[0] == "mod":
+                    p2 = entry[1]
+                    if (p2, name_node.attr) in self.defs:
+                        return ("def@", p2, name_node.attr)
+        return None
+
+    def _local_defs_env(self, path, fn) -> dict:
+        env = {}
+        for node in ast.walk(fn):
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ) and node is not fn:
+                env[node.name] = (
+                    "def@", path, self.qualname(path, node)
+                )
+        return env
+
+    def _mark(self, key):
+        if key and key[0] == "def@":
+            self.traced.add((key[1], key[2]))
+
+    def _seed_traced(self):
+        for path, mod in self.modules.items():
+            for node in ast.walk(mod.tree):
+                if isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    for dec in node.decorator_list:
+                        head = dec.func if isinstance(
+                            dec, ast.Call
+                        ) else dec
+                        d = _dotted(head) or ""
+                        if d.split(".")[-1] in _TRACING_HEADS_LAST:
+                            self.traced.add(
+                                (path, self.qualname(path, node))
+                            )
+                        if isinstance(dec, ast.Call) and d.split(
+                            "."
+                        )[-1] == "partial":
+                            inner = dec.args[0] if dec.args else None
+                            di = _dotted(inner) or ""
+                            if di.split(".")[-1] in _TRACING_HEADS_LAST:
+                                self.traced.add(
+                                    (path, self.qualname(path, node))
+                                )
+                elif isinstance(node, ast.Call) and self._is_tracing_head(
+                    node.func
+                ):
+                    enc = self._enclosing_fn(path, node)
+                    local = (
+                        self._local_defs_env(path, enc) if enc else {}
+                    )
+                    for a in self._callable_args(node):
+                        self._mark(self._resolve(path, a, local))
+
+    def _enclosing_fn(self, path, node):
+        cur = node
+        parent = self.parents[path]
+        while cur is not None:
+            cur = parent.get(cur)
+            if isinstance(
+                cur, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                return cur
+        return None
+
+    def _propagate(self):
+        """Close traced-ness over lexical nesting and the call graph."""
+        changed = True
+        while changed:
+            changed = False
+            # Lexical: nested defs of traced functions are traced.
+            for (path, q) in list(self.traced):
+                prefix = q + "."
+                for (p2, q2) in self.defs:
+                    if p2 == path and q2.startswith(prefix):
+                        if (p2, q2) not in self.traced:
+                            self.traced.add((p2, q2))
+                            changed = True
+            # Call graph: callees of traced functions are traced.
+            for (path, q) in list(self.traced):
+                fn = self.defs.get((path, q))
+                if fn is None:
+                    continue
+                local = self._local_defs_env(path, fn)
+                local.update(self._fn_import_env(path, fn))
+                for node in ast.walk(fn):
+                    if isinstance(node, ast.Call):
+                        key = self._resolve(path, node.func, local)
+                        if (
+                            key
+                            and key[0] == "def@"
+                            and (key[1], key[2]) not in self.traced
+                        ):
+                            self.traced.add((key[1], key[2]))
+                            changed = True
+
+    def _fn_import_env(self, path, fn) -> dict:
+        """Function-local `from .x import y` imports (idiomatic here for
+        cycle avoidance) resolved like module-level ones."""
+        env = {}
+        known = set(self.modules)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.ImportFrom):
+                tgt = _module_of_import(
+                    path, node.level, node.module, known
+                )
+                if tgt is None:
+                    continue
+                for alias in node.names:
+                    name = alias.asname or alias.name
+                    if (tgt, alias.name) in self.defs:
+                        env[name] = ("def@", tgt, alias.name)
+        return env
+
+    def is_traced(self, path, fn_node) -> bool:
+        return (path, self.qualname(path, fn_node)) in self.traced
+
+
+# --------------------------------------------------------------------- #
+# Per-function taint (positional params + derived locals)
+# --------------------------------------------------------------------- #
+def _taint_set(fn: ast.FunctionDef) -> set[str]:
+    """Names in ``fn`` that (syntactically) carry traced array values:
+    POSITIONAL parameters and anything assigned from an expression that
+    mentions a tainted name or calls into jnp/lax/jax.  Keyword-only
+    parameters are the codebase's static-knob convention (every jit
+    static_argname is kw-only) and stay untainted."""
+    tainted = {
+        a.arg
+        for a in list(fn.args.args) + list(fn.args.posonlyargs)
+        if a.arg not in ("self", "cls")
+    }
+    if fn.args.vararg:
+        tainted.add(fn.args.vararg.arg)
+
+    # Static-at-trace-time metadata: reading .shape/.dtype/... of a
+    # traced array (or len() of it) yields a Python value, not a traced
+    # one — without this, ``n = origin.shape[0]`` would taint ``n`` and
+    # every static size computed from it.
+    _STATIC_ATTRS = {"shape", "ndim", "dtype", "itemsize", "weak_type"}
+    _STATIC_CALLS = {"len", "jnp.finfo", "jnp.iinfo", "jnp.dtype",
+                     "np.finfo", "np.iinfo", "np.dtype", "isinstance",
+                     "getattr", "hasattr", "type"}
+
+    def expr_tainted(e) -> bool:
+        if isinstance(e, ast.Attribute) and e.attr in _STATIC_ATTRS:
+            return False
+        if isinstance(e, ast.Call):
+            d = _dotted(e.func) or ""
+            if d in _STATIC_CALLS:
+                return False
+            if d.split(".")[0] in ("jnp", "lax") or d.startswith(
+                "jax."
+            ):
+                return True
+        if isinstance(e, ast.Name):
+            return e.id in tainted
+        return any(
+            expr_tainted(sub) for sub in ast.iter_child_nodes(e)
+        )
+
+    changed = True
+    while changed:
+        changed = False
+        for node in _walk_shallow(fn):
+            tgt_names: list[str] = []
+            if isinstance(node, ast.Assign) and expr_tainted(node.value):
+                for t in node.targets:
+                    for sub in ast.walk(t):
+                        if isinstance(sub, ast.Name):
+                            tgt_names.append(sub.id)
+            elif isinstance(node, ast.AugAssign) and expr_tainted(
+                node.value
+            ):
+                if isinstance(node.target, ast.Name):
+                    tgt_names.append(node.target.id)
+            elif isinstance(node, ast.For) and expr_tainted(node.iter):
+                for sub in ast.walk(node.target):
+                    if isinstance(sub, ast.Name):
+                        tgt_names.append(sub.id)
+            for n in tgt_names:
+                if n not in tainted:
+                    tainted.add(n)
+                    changed = True
+    return tainted
+
+
+def _is_tainted_ref(node, tainted: set[str]) -> bool:
+    """Direct reference to a tainted value: a tainted Name or an
+    attribute chain rooted at one (``result.done``)."""
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return isinstance(node, ast.Name) and node.id in tainted
+
+
+# --------------------------------------------------------------------- #
+# Rules
+# --------------------------------------------------------------------- #
+def _rule_host_sync(index: PackageIndex, out: list[Finding]):
+    for (path, q), fn in index.defs.items():
+        if (path, q) in index.traced:
+            tainted = _taint_set(fn)
+            for node in _walk_shallow(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                d = _dotted(node.func)
+                msg = None
+                if d in _DEVICE_GET:
+                    msg = (
+                        f"{d}() inside traced body — a host sync "
+                        "compiled into the program (or a tracer leak)"
+                    )
+                elif (
+                    isinstance(node.func, ast.Name)
+                    and node.func.id in _HOST_SYNC_FUNCS
+                    and node.args
+                    and _is_tainted_ref(node.args[0], tainted)
+                ):
+                    msg = (
+                        f"{node.func.id}() on traced value "
+                        f"'{ast.unparse(node.args[0])}' inside traced "
+                        "body — blocks on device readback"
+                    )
+                elif (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _HOST_SYNC_ATTRS
+                    and _is_tainted_ref(node.func.value, tainted)
+                ):
+                    msg = (
+                        f".{node.func.attr}() on traced value "
+                        f"'{ast.unparse(node.func.value)}' inside "
+                        "traced body — blocks on device readback"
+                    )
+                elif (
+                    d in _HOST_SYNC_NP
+                    and node.args
+                    and _is_tainted_ref(node.args[0], tainted)
+                ):
+                    msg = (
+                        f"{d}() on traced value "
+                        f"'{ast.unparse(node.args[0])}' inside traced "
+                        "body — materializes the array on host"
+                    )
+                if msg:
+                    out.append(
+                        Finding(
+                            "PUMI001", path, node.lineno, q, msg
+                        )
+                    )
+
+
+def _rule_transfers(index: PackageIndex, out: list[Finding]):
+    for path, mod in index.modules.items():
+        if path in APPROVED_TRANSFER_MODULES:
+            continue
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                d = _dotted(node.func)
+                if d in _DEVICE_PUT or d in _DEVICE_GET:
+                    out.append(
+                        Finding(
+                            "PUMI002",
+                            path,
+                            node.lineno,
+                            index.enclosing_symbol(path, node),
+                            f"{d}() outside the approved staging "
+                            "modules — every host<->device edge must "
+                            "live in the staging/facade layer so the "
+                            "1 H2D + 1 D2H move contract stays "
+                            "structural",
+                        )
+                    )
+
+
+@dataclass
+class _DonationSpec:
+    """Donated params of one jitted callable, by position and name."""
+
+    argnums: tuple[int, ...] = ()
+    argnames: tuple[str, ...] = ()
+
+
+def _collect_donating(index: PackageIndex) -> dict[tuple[str, str], _DonationSpec]:
+    """Module-level ``X = jax.jit(fn, donate_arg...)`` assignments, plus
+    simple same-module wrappers ``def w(*a, **kw): return X(...)``."""
+    donating: dict[tuple[str, str], _DonationSpec] = {}
+    for path, mod in index.modules.items():
+        for node in mod.tree.body:
+            if not (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)
+            ):
+                continue
+            call = node.value
+            d = _dotted(call.func) or ""
+            if d.split(".")[-1] != "jit":
+                continue
+            spec = _DonationSpec()
+            wrapped = call.args[0] if call.args else None
+            for kw in call.keywords:
+                if kw.arg == "donate_argnums":
+                    spec = _DonationSpec(
+                        tuple(
+                            e.value
+                            for e in ast.walk(kw.value)
+                            if isinstance(e, ast.Constant)
+                            and isinstance(e.value, int)
+                        ),
+                        spec.argnames,
+                    )
+                elif kw.arg == "donate_argnames":
+                    spec = _DonationSpec(
+                        spec.argnums,
+                        tuple(
+                            e.value
+                            for e in ast.walk(kw.value)
+                            if isinstance(e, ast.Constant)
+                            and isinstance(e.value, str)
+                        ),
+                    )
+            if not (spec.argnums or spec.argnames):
+                continue
+            # donate_argnames -> positional indices through the wrapped
+            # def's signature when resolvable in-package.
+            wkey = index._resolve(path, wrapped) if wrapped else None
+            if wkey and wkey[0] == "def@":
+                wfn = index.defs[(wkey[1], wkey[2])]
+                params = [
+                    a.arg
+                    for a in list(wfn.args.posonlyargs)
+                    + list(wfn.args.args)
+                ]
+                nums = set(spec.argnums)
+                for nm in spec.argnames:
+                    if nm in params:
+                        nums.add(params.index(nm))
+                spec = _DonationSpec(
+                    tuple(sorted(nums)), spec.argnames
+                )
+            donating[(path, node.targets[0].id)] = spec
+    # Pass-through wrappers: `def trace(*args, **kwargs): return
+    # _trace_jit(*args, ...)` inherits the jit's donation spec.
+    for path, mod in index.modules.items():
+        for node in mod.tree.body:
+            if not isinstance(node, ast.FunctionDef):
+                continue
+            rets = [
+                s
+                for s in ast.walk(node)
+                if isinstance(s, ast.Return) and s.value is not None
+            ]
+            for r in rets:
+                if isinstance(r.value, ast.Call):
+                    d = _dotted(r.value.func)
+                    if d and (path, d) in donating:
+                        donating.setdefault(
+                            (path, node.name), donating[(path, d)]
+                        )
+    return donating
+
+
+def _rule_use_after_donate(index: PackageIndex, out: list[Finding]):
+    donating = _collect_donating(index)
+
+    def site_spec(path, call, local_env) -> _DonationSpec | None:
+        d = _dotted(call.func)
+        if d is None:
+            return None
+        if (path, d) in donating:
+            return donating[(path, d)]
+        # imported name from another module
+        entry = index.scope[path].get(d.split(".")[0])
+        if entry and entry[0] == "name":
+            _, p2, remote = entry
+            if (p2, remote) in donating and "." not in d:
+                return donating[(p2, remote)]
+        return None
+
+    for (path, q), fn in index.defs.items():
+        events = []  # (lineno, kind, name)
+        for node in _walk_shallow(fn):
+            if isinstance(node, ast.Call):
+                spec = site_spec(path, node, None)
+                if spec is None:
+                    continue
+                donated_exprs = []
+                for i in spec.argnums:
+                    if i < len(node.args):
+                        nm = _dotted(node.args[i])
+                        if nm:
+                            donated_exprs.append(nm)
+                for kw in node.keywords:
+                    if kw.arg in spec.argnames:
+                        nm = _dotted(kw.value)
+                        if nm:
+                            donated_exprs.append(nm)
+                # The donation takes effect once the call completes:
+                # anchor at the call's LAST line so the call's own
+                # multi-line argument list never self-reports.
+                for nm in donated_exprs:
+                    events.append(
+                        (node.end_lineno or node.lineno, "donate", nm)
+                    )
+        if not events:
+            continue
+        for node in _walk_shallow(fn):
+            if isinstance(node, ast.Name):
+                nm = node.id
+            elif isinstance(node, ast.Attribute):
+                nm = _dotted(node)
+                if nm is None:
+                    continue
+            else:
+                continue
+            if isinstance(node.ctx, ast.Store):
+                events.append((node.lineno, "store", nm))
+            elif isinstance(node.ctx, ast.Load):
+                events.append((node.lineno, "load", nm))
+        events.sort(key=lambda e: (e[0], {"donate": 1, "store": 2,
+                                          "load": 0}[e[1]]))
+        live_donated: dict[str, int] = {}
+        reported = set()
+        for lineno, kind, nm in events:
+            if kind == "donate":
+                live_donated[nm] = lineno
+            elif kind == "store":
+                live_donated.pop(nm, None)
+            elif kind == "load" and nm in live_donated:
+                if lineno > live_donated[nm] and nm not in reported:
+                    reported.add(nm)
+                    out.append(
+                        Finding(
+                            "PUMI003",
+                            path,
+                            lineno,
+                            q,
+                            f"'{nm}' read after being donated at line "
+                            f"{live_donated[nm]} — the buffer may "
+                            "already be aliased by the program's "
+                            "output; re-bind it from the result",
+                        )
+                    )
+
+
+def _rule_nondeterminism(index: PackageIndex, out: list[Finding]):
+    for (path, q), fn in index.defs.items():
+        if (path, q) not in index.traced:
+            continue
+        for node in _walk_shallow(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            d = _dotted(node.func)
+            if d is None:
+                continue
+            if any(
+                d.startswith(p) or d == p.rstrip(".")
+                for p in _NONDET_PREFIXES
+            ):
+                out.append(
+                    Finding(
+                        "PUMI004",
+                        path,
+                        node.lineno,
+                        q,
+                        f"{d}() inside traced body — the value is "
+                        "frozen at trace time and differs per retrace, "
+                        "breaking bitwise replay (checkpoint resume, "
+                        "retry re-arm); thread RNG keys / counters "
+                        "through the program inputs instead",
+                    )
+                )
+
+
+_DTYPE_CALL_HEADS = frozenset(
+    {
+        "array",
+        "asarray",
+        "zeros",
+        "ones",
+        "full",
+        "empty",
+        "arange",
+        "astype",
+        "dtype",
+        "zeros_like",
+        "ones_like",
+        "full_like",
+        "convert_element_type",
+    }
+)
+
+
+_DTYPE_DISPATCH_RE = re.compile(r"float64|uint64|uint32|itemsize|x64")
+
+
+def _in_dtype_dispatch(parents, node) -> bool:
+    """True when the usage sits under an ``if``/ternary whose test is a
+    dtype/carrier-width dispatch (``if dtype == jnp.float64:``,
+    ``... if rec.dtype == jnp.uint32 else ...``) — the codebase's
+    sanctioned pattern for dtype-polymorphic helpers, where the f64
+    branch only executes for f64 configs."""
+    cur = node
+    while cur is not None:
+        if isinstance(cur, (ast.If, ast.IfExp)):
+            try:
+                if _DTYPE_DISPATCH_RE.search(ast.unparse(cur.test)):
+                    return True
+            except Exception:
+                pass
+        cur = parents.get(cur)
+    return False
+
+
+def _rule_f64(index: PackageIndex, out: list[Finding]):
+    for path, mod in index.modules.items():
+        if path in F64_EXEMPT_MODULES:
+            continue
+        # jnp.float64 anywhere in the package (device dtype by
+        # construction); np.float64 / "float64" literals only inside
+        # traced bodies (host-side f64 staging is legitimate).
+        for node in ast.walk(mod.tree):
+            d = _dotted(node) if isinstance(node, ast.Attribute) else None
+            if d in ("jnp.float64", "jax.numpy.float64"):
+                if _in_dtype_dispatch(index.parents[path], node):
+                    continue
+                out.append(
+                    Finding(
+                        "PUMI005",
+                        path,
+                        node.lineno,
+                        index.enclosing_symbol(path, node),
+                        f"{d} creates a float64 device array — the "
+                        "f32 production config must stay f64-free on "
+                        "device (integrity/audit.py is the sanctioned "
+                        "f64 surface)",
+                    )
+                )
+    # np.float64 / "float64" literals: traced bodies only (host-side
+    # f64 staging is legitimate).
+    for (path, q), fn in index.defs.items():
+        if path in F64_EXEMPT_MODULES or (path, q) not in index.traced:
+            continue
+        for node in _walk_shallow(fn):
+            if _in_dtype_dispatch(index.parents[path], node):
+                continue
+            if isinstance(node, ast.Attribute):
+                if _dotted(node) in ("np.float64", "numpy.float64"):
+                    out.append(
+                        Finding(
+                            "PUMI005", path, node.lineno, q,
+                            "np.float64 inside traced body — "
+                            "promotes the device path to f64",
+                        )
+                    )
+            elif isinstance(node, ast.Call):
+                d = _dotted(node.func) or ""
+                if d.split(".")[-1] not in _DTYPE_CALL_HEADS:
+                    continue
+                for a in list(node.args) + [
+                    kw.value for kw in node.keywords
+                ]:
+                    if _const_str(a) == "float64":
+                        out.append(
+                            Finding(
+                                "PUMI005", path, node.lineno, q,
+                                f'"float64" dtype literal in '
+                                f"{d}() inside traced body",
+                            )
+                        )
+
+
+def _rule_jit_hygiene(index: PackageIndex, out: list[Finding]):
+    # Static-argnum specs of module-level jits (donating or not).
+    statics: dict[tuple[str, str], tuple[int, ...]] = {}
+    for path, mod in index.modules.items():
+        for node in mod.tree.body:
+            if not (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)
+            ):
+                continue
+            d = _dotted(node.value.func) or ""
+            if d.split(".")[-1] != "jit":
+                continue
+            nums: set[int] = set()
+            for kw in node.value.keywords:
+                if kw.arg == "static_argnums":
+                    nums |= {
+                        e.value
+                        for e in ast.walk(kw.value)
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, int)
+                    }
+            if nums:
+                statics[(path, node.targets[0].id)] = tuple(
+                    sorted(nums)
+                )
+
+    for path, mod in index.modules.items():
+        for loop in ast.walk(mod.tree):
+            if not isinstance(loop, (ast.For, ast.While)):
+                continue
+            loop_vars = set()
+            if isinstance(loop, ast.For):
+                for sub in ast.walk(loop.target):
+                    if isinstance(sub, ast.Name):
+                        loop_vars.add(sub.id)
+            for node in ast.walk(loop):
+                if node is loop or not isinstance(node, ast.Call):
+                    continue
+                d = _dotted(node.func) or ""
+                if d.split(".")[-1] == "jit" and d in (
+                    "jit",
+                    "jax.jit",
+                ):
+                    out.append(
+                        Finding(
+                            "PUMI006",
+                            path,
+                            node.lineno,
+                            index.enclosing_symbol(path, node),
+                            "jax.jit(...) constructed inside a loop — "
+                            "a fresh wrapper (and for local callables "
+                            "a fresh cache entry, i.e. a recompile) "
+                            "per iteration; hoist the jit out of the "
+                            "loop",
+                        )
+                    )
+                    continue
+                key = (path, d)
+                if key in statics and loop_vars:
+                    for i in statics[key]:
+                        if i < len(node.args) and isinstance(
+                            node.args[i], ast.Name
+                        ) and node.args[i].id in loop_vars:
+                            out.append(
+                                Finding(
+                                    "PUMI006",
+                                    path,
+                                    node.lineno,
+                                    index.enclosing_symbol(
+                                        path, node
+                                    ),
+                                    f"loop variable "
+                                    f"'{node.args[i].id}' passed at "
+                                    f"STATIC argnum {i} of jitted "
+                                    f"'{d}' — one recompile per "
+                                    "iteration",
+                                )
+                            )
+
+
+# --------------------------------------------------------------------- #
+# PUMI007: # guarded by: <lock> concurrency lint
+# --------------------------------------------------------------------- #
+def _guard_annotations(mod: Module):
+    """Map line number → lock expression for every ``# guarded by:``
+    comment in the module; the callers associate each with the
+    assignment statement on that line (a ``self.X = ...`` attribute or,
+    with the ``(event)`` suffix, a guarded local)."""
+    annotated_lines: dict[int, str] = {}
+    for lineno, comment in mod.comments.items():
+        m = _GUARD_RE.search(comment)
+        if m:
+            annotated_lines[lineno] = m.group("lock").strip()
+    return annotated_lines
+
+
+def _with_lock_stack(parents, node) -> list[str]:
+    """Lock expressions of every enclosing ``with`` block."""
+    locks = []
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, ast.With):
+            for item in cur.items:
+                try:
+                    locks.append(ast.unparse(item.context_expr))
+                except Exception:
+                    pass
+        cur = parents.get(cur)
+    return locks
+
+
+def _rule_guarded_by(index: PackageIndex, out: list[Finding]):
+    for path, mod in index.modules.items():
+        annotated = _guard_annotations(mod)
+        if not annotated:
+            continue
+        for cls in ast.walk(mod.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            attr_guards: dict[str, str] = {}
+            event_guards: dict[str, str] = {}
+            for node in ast.walk(cls):
+                if not isinstance(
+                    node, (ast.Assign, ast.AnnAssign)
+                ):
+                    continue
+                lock = annotated.get(node.lineno)
+                if lock is None:
+                    continue
+                targets = (
+                    node.targets
+                    if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for t in targets:
+                    if (
+                        isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"
+                    ):
+                        attr_guards[t.attr] = lock
+            if attr_guards:
+                _check_attr_guards(
+                    index, path, cls, attr_guards, out
+                )
+        # Event-guarded locals: annotations on plain local assignments
+        # inside any function ("<name> (event)").
+        for fn_key, fn in index.defs.items():
+            if fn_key[0] != path:
+                continue
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Assign):
+                    continue
+                lock = annotated.get(node.lineno)
+                if lock is None or not _EVENT_SUFFIX_RE.search(lock):
+                    continue
+                event = _EVENT_SUFFIX_RE.sub("", lock).strip()
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        _check_event_guard(
+                            index, path, fn_key[1], fn, t.id,
+                            event, node.lineno, out,
+                        )
+
+
+def _check_attr_guards(index, path, cls, attr_guards, out):
+    parents = index.parents[path]
+    for method in cls.body:
+        if not isinstance(
+            method, (ast.FunctionDef, ast.AsyncFunctionDef)
+        ):
+            continue
+        if method.name in ("__init__", "__del__"):
+            # Construction precedes thread visibility; finalizers run
+            # after every worker is joined.
+            continue
+        q = index.qualname(path, method)
+        for node in ast.walk(method):
+            if not (
+                isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"
+                and node.attr in attr_guards
+            ):
+                continue
+            lock = attr_guards[node.attr]
+            held = _with_lock_stack(parents, node)
+            if lock not in held:
+                out.append(
+                    Finding(
+                        "PUMI007",
+                        path,
+                        node.lineno,
+                        q,
+                        f"self.{node.attr} is annotated "
+                        f"'# guarded by: {lock}' but is accessed "
+                        f"outside 'with {lock}:'",
+                    )
+                )
+
+
+def _check_event_guard(index, path, q, fn, local, event, ann_line, out):
+    """Writes to ``local`` inside nested defs must also call
+    ``<event>.set()`` there; reads of ``local`` in the outer body must
+    come after an ``<event>.wait(...)`` call."""
+    nested = [
+        n
+        for n in ast.walk(fn)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        and n is not fn
+    ]
+    in_nested = set()
+    for nf in nested:
+        for sub in ast.walk(nf):
+            in_nested.add(id(sub))
+
+    def writes_local(node):
+        if isinstance(node, ast.Subscript) and isinstance(
+            node.value, ast.Name
+        ):
+            return (
+                node.value.id == local
+                and isinstance(node.ctx, ast.Store)
+            )
+        return (
+            isinstance(node, ast.Name)
+            and node.id == local
+            and isinstance(node.ctx, ast.Store)
+        )
+
+    def calls(tree, dotted_suffix):
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                d = _dotted(node.func) or ""
+                if d == dotted_suffix:
+                    yield node
+
+    for nf in nested:
+        if any(writes_local(n) for n in ast.walk(nf)):
+            if not any(calls(nf, f"{event}.set")):
+                out.append(
+                    Finding(
+                        "PUMI007",
+                        path,
+                        nf.lineno,
+                        q,
+                        f"worker '{nf.name}' writes "
+                        f"'{local}' (guarded by {event}) without "
+                        f"calling {event}.set() — the reader's "
+                        "happens-before edge is missing",
+                    )
+                )
+    wait_lines = [
+        c.lineno
+        for c in calls(fn, f"{event}.wait")
+        if id(c) not in in_nested
+    ]
+    first_wait = min(wait_lines) if wait_lines else None
+    for node in ast.walk(fn):
+        if id(node) in in_nested or not isinstance(node, ast.Name):
+            continue
+        if (
+            node.id == local
+            and isinstance(node.ctx, ast.Load)
+            and node.lineno > ann_line
+            and (first_wait is None or node.lineno <= first_wait)
+        ):
+            out.append(
+                Finding(
+                    "PUMI007",
+                    path,
+                    node.lineno,
+                    q,
+                    f"'{local}' (guarded by {event}) read before "
+                    f"{event}.wait(...) — the worker may still be "
+                    "writing it",
+                )
+            )
+
+
+# --------------------------------------------------------------------- #
+# Entry points
+# --------------------------------------------------------------------- #
+_RULES = (
+    _rule_host_sync,
+    _rule_transfers,
+    _rule_use_after_donate,
+    _rule_nondeterminism,
+    _rule_f64,
+    _rule_jit_hygiene,
+    _rule_guarded_by,
+)
+
+
+def lint_sources(sources: dict[str, str]) -> list[Finding]:
+    """Lint a {relpath: source} mapping (the test fixtures' entry)."""
+    modules = {p: _parse(p, s) for p, s in sources.items()}
+    index = PackageIndex(modules)
+    out: list[Finding] = []
+    for rule in _RULES:
+        rule(index, out)
+    out.sort(key=lambda f: (f.path, f.line, f.rule))
+    return out
+
+
+def lint_package(root) -> list[Finding]:
+    """Lint every module of the installed package tree under ``root``
+    (the repo checkout: ``root/pumiumtally_tpu/**/*.py``)."""
+    root = Path(root)
+    sources = {}
+    for p in sorted((root / PACKAGE).rglob("*.py")):
+        rel = p.relative_to(root).as_posix()
+        sources[rel] = p.read_text()
+    return lint_sources(sources)

@@ -1,0 +1,467 @@
+"""jaxpr/HLO contract analyzer (layer 2).
+
+The repo's hardest-won invariants are properties of the COMPILED
+programs, not of any single source line: a steady-state move is one H2D
+and one D2H (so the program itself must contain zero transfers and zero
+host callbacks), the flux accumulator is donated (so the compiled
+program must carry an input/output alias), an f32 config never touches
+f64 on device, the megastep move loop is a ``scan`` (not degraded to a
+dynamic ``while`` that XLA cannot pipeline), and the tally scatter count
+is fixed.  Runtime tests witness these only by executing a failure;
+here they are asserted against the *abstract trace* — ``jax.jit(...)
+.trace(...)`` + ``.lower()`` — of the five public program families:
+
+  trace         the legacy single-chip walk step (ops/walk.py trace)
+  trace_packed  the packed-staging step (1+1 contract's compiled half)
+  megastep      K device-sourced moves fused into one program
+  partitioned   the packed partitioned step (shard_map over the mesh)
+  pallas        the Mosaic kernel path (interpret mode off-TPU)
+
+``capture()`` extracts a structural signature per family (primitive
+counts, donated-argument count, f64 aval census, input/output avals);
+``check_structural()`` asserts the invariants that must hold
+regardless of history; ``diff_baseline()`` compares a capture against
+the committed ``CONTRACTS.json`` so ANY structural drift — a new
+transfer, a lost donation, an extra scatter, a while where a scan was —
+fails CI with a named invariant.  Regenerate intentionally with
+``python scripts/lint.py --write-contracts`` (and say why in the PR).
+
+Signatures depend on the runtime environment (x64 widens counter
+dtypes, the device count shapes the partitioned mesh), so captures
+record it and ``diff_baseline`` refuses to compare across environments
+— ``scripts/lint.py`` pins cpu / 8 virtual devices / x64 off.
+"""
+from __future__ import annotations
+
+import collections
+import json
+
+import numpy as np
+
+from . import Finding
+
+CONTRACTS_FILE = "CONTRACTS.json"
+
+# Problem size: small enough to abstract-trace in milliseconds, big
+# enough to exercise every structural feature (two materials, two
+# groups, walk-loop + compaction-free path, 8-way partition).
+_N = 16
+_G = 2
+_MAX_CROSSINGS = 64
+_N_PARTS = 8
+
+_CALLBACK_PRIMS = (
+    "pure_callback",
+    "io_callback",
+    "debug_callback",
+    "outside_call",
+)
+_TRANSFER_PRIMS = ("device_put",)
+
+
+def environment() -> dict:
+    import jax
+
+    return {
+        "backend": jax.default_backend(),
+        "x64": bool(jax.config.jax_enable_x64),
+        "n_devices": jax.device_count(),
+    }
+
+
+# --------------------------------------------------------------------- #
+# Signature extraction
+# --------------------------------------------------------------------- #
+def _iter_subjaxprs(params):
+    for p in params.values():
+        for q in p if isinstance(p, (list, tuple)) else (p,):
+            if hasattr(q, "jaxpr"):  # ClosedJaxpr
+                yield q.jaxpr
+            elif hasattr(q, "eqns"):  # raw Jaxpr (shard_map et al.)
+                yield q
+
+
+def _walk_jaxpr(jaxpr):
+    stack = [jaxpr]
+    while stack:
+        j = stack.pop()
+        yield j
+        for e in j.eqns:
+            stack.extend(_iter_subjaxprs(e.params))
+
+
+def _dtype_name(dt) -> str:
+    try:
+        return np.dtype(dt).name
+    except TypeError:  # extended dtypes (PRNG key arrays)
+        return str(dt)
+
+
+def _is_f64(aval) -> bool:
+    dt = getattr(aval, "dtype", None)
+    return dt is not None and _dtype_name(dt) == "float64"
+
+
+def _aval_str(aval) -> str:
+    dt = getattr(aval, "dtype", None)
+    shape = getattr(aval, "shape", None)
+    if dt is None:
+        return str(aval)
+    return f"{_dtype_name(dt)}[{','.join(map(str, shape or ()))}]"
+
+
+def extract_signature(traced) -> dict:
+    """Structural signature of one ``jax.jit(...).trace(...)`` result."""
+    closed = traced.jaxpr
+    jaxpr = closed.jaxpr
+    prims: collections.Counter = collections.Counter()
+    f64_avals = 0
+    convert_to_f64 = 0
+    for j in _walk_jaxpr(jaxpr):
+        for v in list(j.invars) + list(j.constvars):
+            if _is_f64(getattr(v, "aval", None)):
+                f64_avals += 1
+        for e in j.eqns:
+            prims[e.primitive.name] += 1
+            nd = e.params.get("new_dtype")
+            if (
+                e.primitive.name == "convert_element_type"
+                and nd is not None
+                and _dtype_name(nd) == "float64"
+            ):
+                convert_to_f64 += 1
+            for v in e.outvars:
+                if _is_f64(getattr(v, "aval", None)):
+                    f64_avals += 1
+    text = traced.lower().as_text()
+    donated = text.count("tf.aliasing_output") + text.count(
+        "jax.buffer_donor"
+    )
+    return {
+        "inputs": [_aval_str(v.aval) for v in jaxpr.invars],
+        "outputs": [_aval_str(v.aval) for v in jaxpr.outvars],
+        "donated_args": donated,
+        "f64_avals": f64_avals,
+        "convert_to_f64": convert_to_f64,
+        "prims": dict(sorted(prims.items())),
+    }
+
+
+# --------------------------------------------------------------------- #
+# The five program families at a canonical tiny problem
+# --------------------------------------------------------------------- #
+def _problem(dtype):
+    import jax.numpy as jnp
+
+    from ..mesh.box import build_box_arrays
+    from ..mesh.core import TetMesh
+
+    coords, t2v = build_box_arrays(1.0, 1.0, 1.0, 2, 2, 2)
+    centroids = coords[t2v].mean(axis=1)
+    class_id = np.where(centroids[:, 0] < 0.5, 1, 2).astype(np.int32)
+    mesh = TetMesh.from_numpy(coords, t2v, class_id=class_id, dtype=dtype)
+    rng = np.random.default_rng(7)
+    arrs = dict(
+        origin=jnp.asarray(rng.uniform(0.2, 0.8, (_N, 3)), dtype),
+        dest=jnp.asarray(rng.uniform(0.2, 0.8, (_N, 3)), dtype),
+        elem=jnp.zeros(_N, jnp.int32),
+        in_flight=jnp.ones(_N, bool),
+        weight=jnp.ones(_N, dtype),
+        group=jnp.zeros(_N, jnp.int32),
+        material_id=jnp.full(_N, -1, jnp.int32),
+        flux=jnp.zeros((mesh.tet2tet.shape[0], _G, 2), dtype),
+    )
+    return mesh, arrs
+
+
+def _walk_statics():
+    return dict(
+        initial=False,
+        max_crossings=_MAX_CROSSINGS,
+        tolerance=1e-6,
+        n_groups=_G,
+        tally_scatter="pair",
+        stats=True,
+        integrity=True,
+    )
+
+
+def build_traced(families=None, dtype=None) -> dict:
+    """Abstract-trace the requested program families (all by default).
+
+    Returns {family: jax._src.stages.Traced}.  Pure tracing + lowering:
+    no backend compile, no execution — safe and fast (<1 s) anywhere.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops import staging, walk
+
+    dtype = dtype or jnp.float32
+    mesh, a = _problem(dtype)
+    want = set(families or ("trace", "trace_packed", "megastep",
+                            "partitioned", "pallas"))
+    out = {}
+    statics = _walk_statics()
+    if "trace" in want:
+        out["trace"] = walk._trace_jit.trace(
+            mesh, a["origin"], a["dest"], a["elem"], a["in_flight"],
+            a["weight"], a["group"], a["material_id"], a["flux"],
+            **statics,
+        )
+    if "trace_packed" in want:
+        stager = staging.HostStager()
+        rec = staging.pack_move_record(
+            stager, np.asarray(a["dest"]), np.ones(_N),
+            np.zeros(_N, np.int64), np.ones(_N, bool), dtype,
+        )
+        out["trace_packed"] = walk._trace_packed_jit.trace(
+            mesh, a["origin"], a["elem"], a["material_id"],
+            jnp.asarray(rec), a["flux"], None, a["weight"], a["group"],
+            **statics,
+        )
+    if "megastep" in want:
+        m = dict(statics)
+        m.pop("initial")
+        out["megastep"] = walk._megastep_jit.trace(
+            mesh, a["origin"], a["elem"], a["material_id"], a["weight"],
+            a["group"], a["in_flight"],
+            jnp.arange(_N, dtype=jnp.int32), a["flux"],
+            jnp.int32(0), jax.random.PRNGKey(13),
+            jnp.asarray([4.0, 9.0], dtype), jnp.asarray([0.3, 0.5], dtype),
+            n_moves=4, survival_weight=0.2, downscatter=0.1,
+            eps_near=1e-6, **m,
+        )
+    if "partitioned" in want:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..ops.walk_partitioned import make_partitioned_step
+        from ..parallel.mesh_partition import partition_mesh
+        from ..parallel.particle_sharding import make_device_mesh
+
+        if jax.device_count() < _N_PARTS:
+            raise RuntimeError(
+                f"the partitioned contract needs {_N_PARTS} devices "
+                f"(got {jax.device_count()}); run through "
+                "scripts/lint.py, which pins "
+                "XLA_FLAGS=--xla_force_host_platform_device_count=8"
+            )
+        part = partition_mesh(mesh, _N_PARTS)
+        dmesh = make_device_mesh(_N_PARTS)
+        step = make_partitioned_step(
+            dmesh, part, n_groups=_G, max_crossings=_MAX_CROSSINGS,
+            tolerance=1e-6, packed_io=True, integrity=True,
+            tally_scatter="pair",
+        )
+        sh = NamedSharding(dmesh, P("p"))
+        cap = 8
+        carrier = staging.np_carrier(np.dtype(dtype))
+        rec = jax.device_put(
+            jnp.zeros((_N_PARTS * cap, staging.PART_IN_COLS),
+                      carrier.name), sh,
+        )
+        pflux = jax.device_put(
+            jnp.zeros((_N_PARTS, part.max_local, _G, 2), dtype), sh
+        )
+        out["partitioned"] = step.trace(rec, pflux)
+    if "pallas" in want:
+        # The facade path: trace_impl(kernel="pallas") through the SAME
+        # jitted wrapper, interpret mode forced so the capture is
+        # platform-independent (ops/walk_pallas.py defaults to
+        # interpret off-TPU anyway).
+        out["pallas"] = walk._trace_jit.trace(
+            mesh, a["origin"], a["dest"], a["elem"], a["in_flight"],
+            a["weight"], a["group"], a["material_id"], a["flux"],
+            kernel="pallas", **statics,
+        )
+    return out
+
+
+def capture(families=None) -> dict:
+    traced = build_traced(families)
+    return {
+        "environment": environment(),
+        "families": {
+            name: extract_signature(tr)
+            for name, tr in sorted(traced.items())
+        },
+    }
+
+
+# --------------------------------------------------------------------- #
+# Invariants
+# --------------------------------------------------------------------- #
+def _finding(invariant: str, family: str, message: str) -> Finding:
+    return Finding(
+        rule="CONTRACT",
+        path=CONTRACTS_FILE,
+        line=0,
+        symbol=f"{invariant}.{family}",
+        message=message,
+    )
+
+
+def check_structural(sigs: dict) -> list[Finding]:
+    """History-independent invariants every family must satisfy.
+
+    These fire even with no baseline at all — they are the compiled
+    half of contracts the facades promise:
+
+      io.callbacks    zero host callbacks in-program (a callback is a
+                      hidden per-dispatch host sync — the 1+1 transfer
+                      contract would silently become 1+1+N).
+      io.transfers    zero ``device_put`` primitives in-program (same
+                      contract, H2D side).
+      donation        the flux accumulator's donation survived to the
+                      lowered module (``tf.aliasing_output`` /
+                      ``jax.buffer_donor`` on at least one argument) —
+                      a dropped donation doubles accumulator HBM and
+                      breaks the re-arm contract.
+      dtype.f32_purity  an f32-config program contains no f64 aval and
+                      no convert_element_type to f64.
+      structure.walk_loop   trace/trace_packed contain the walk
+                      ``while`` loop.
+      structure.scan  the megastep's move loop is a ``scan`` — XLA
+                      pipelines a static trip count; degrading to a
+                      dynamic ``while`` is a silent perf cliff.
+      structure.scatter  the XLA walk bodies keep their scatter-add
+                      tally writes (losing them means the tally moved
+                      off the fused path).
+      structure.pallas_call  the pallas family actually lowers to one
+                      ``pallas_call`` (a silent fallback to the XLA
+                      body would fake every parity test green).
+      structure.shard_map  the partitioned step still shard_maps over
+                      the device mesh.
+    """
+    out: list[Finding] = []
+    for fam, sig in sigs["families"].items():
+        prims = sig["prims"]
+        ncb = sum(prims.get(p, 0) for p in _CALLBACK_PRIMS)
+        if ncb:
+            out.append(_finding(
+                "io.callbacks", fam,
+                f"{ncb} host-callback primitive(s) inside the compiled "
+                "program — each one is a hidden per-dispatch host sync",
+            ))
+        ntr = sum(prims.get(p, 0) for p in _TRANSFER_PRIMS)
+        if ntr:
+            out.append(_finding(
+                "io.transfers", fam,
+                f"{ntr} device_put primitive(s) inside the compiled "
+                "program — transfers must stay in the staging layer, "
+                "outside the program",
+            ))
+        if sig["donated_args"] < 1:
+            out.append(_finding(
+                "donation", fam,
+                "no donated argument survived lowering — the flux "
+                "accumulator must be donated (input_output_alias / "
+                "buffer_donor)",
+            ))
+        if sig["f64_avals"] or sig["convert_to_f64"]:
+            out.append(_finding(
+                "dtype.f32_purity", fam,
+                f"{sig['f64_avals']} float64 aval(s) and "
+                f"{sig['convert_to_f64']} convert_element_type->f64 in "
+                "an f32-config program",
+            ))
+        if fam in ("trace", "trace_packed") and not prims.get("while"):
+            out.append(_finding(
+                "structure.walk_loop", fam,
+                "the walk while-loop is gone from the program",
+            ))
+        if fam == "megastep":
+            if not prims.get("scan"):
+                out.append(_finding(
+                    "structure.scan", fam,
+                    "the fused move loop is no longer a scan — a "
+                    "dynamic while defeats XLA's static trip-count "
+                    "pipelining",
+                ))
+        if fam in ("trace", "trace_packed", "megastep") and not prims.get(
+            "scatter-add"
+        ):
+            out.append(_finding(
+                "structure.scatter", fam,
+                "no scatter-add left in the walk body — the tally "
+                "write moved off the fused path",
+            ))
+        if fam == "pallas" and prims.get("pallas_call", 0) != 1:
+            out.append(_finding(
+                "structure.pallas_call", fam,
+                f"expected exactly 1 pallas_call, found "
+                f"{prims.get('pallas_call', 0)} — the kernel path "
+                "silently fell back",
+            ))
+        if fam == "partitioned" and not prims.get("shard_map"):
+            out.append(_finding(
+                "structure.shard_map", fam,
+                "the partitioned step no longer shard_maps over the "
+                "device mesh",
+            ))
+    return out
+
+
+def diff_baseline(current: dict, baseline: dict) -> list[Finding]:
+    """Compare a fresh capture against the committed CONTRACTS.json.
+
+    Any difference is a named finding; intentional changes regenerate
+    the baseline with ``scripts/lint.py --write-contracts``.
+    """
+    out: list[Finding] = []
+    if current["environment"] != baseline.get("environment"):
+        out.append(_finding(
+            "environment", "all",
+            f"capture environment {current['environment']} != baseline "
+            f"{baseline.get('environment')} — contracts must be "
+            "checked under the canonical lint environment "
+            "(scripts/lint.py pins it)",
+        ))
+        return out
+    cur_f, base_f = current["families"], baseline.get("families", {})
+    for fam in sorted(set(cur_f) | set(base_f)):
+        if fam not in base_f:
+            out.append(_finding(
+                "family.added", fam,
+                "program family captured but absent from "
+                "CONTRACTS.json — regenerate the baseline",
+            ))
+            continue
+        if fam not in cur_f:
+            out.append(_finding(
+                "family.removed", fam,
+                "program family in CONTRACTS.json but no longer "
+                "captured",
+            ))
+            continue
+        c, b = cur_f[fam], base_f[fam]
+        for field in ("inputs", "outputs", "donated_args", "f64_avals",
+                      "convert_to_f64"):
+            if c[field] != b[field]:
+                out.append(_finding(
+                    f"signature.{field}", fam,
+                    f"{field} drifted: baseline {b[field]!r} -> "
+                    f"current {c[field]!r}",
+                ))
+        cp, bp = c["prims"], b["prims"]
+        for prim in sorted(set(cp) | set(bp)):
+            if cp.get(prim, 0) != bp.get(prim, 0):
+                out.append(_finding(
+                    f"prims.{prim}", fam,
+                    f"primitive count drifted: {prim} "
+                    f"{bp.get(prim, 0)} -> {cp.get(prim, 0)}",
+                ))
+    return out
+
+
+def load_contracts(path) -> dict:
+    with open(path) as fh:
+        return json.load(fh)
+
+
+def write_contracts(path, cap: dict | None = None) -> dict:
+    cap = cap or capture()
+    with open(path, "w") as fh:
+        json.dump(cap, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    return cap

@@ -42,7 +42,11 @@ def run_with_deadline(fn, seconds: float | None, what: str = "move"):
     """
     if not seconds:
         return fn()
-    outcome: dict = {}
+    # The worker publishes into ``outcome`` and the caller reads it
+    # only after the event fires (or never, on timeout) — the
+    # happens-before edge is the Event, machine-checked by
+    # analysis/astlint.py PUMI007.
+    outcome = {}  # guarded by: finished (event)
     finished = threading.Event()
 
     def target():

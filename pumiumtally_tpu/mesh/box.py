@@ -53,8 +53,8 @@ def build_box_arrays(
     xs = np.linspace(0.0, lx, nx + 1)
     ys = np.linspace(0.0, ly, ny + 1)
     zs = np.linspace(0.0, lz, nz + 1)
-    K, J, I = np.meshgrid(zs, ys, xs, indexing="ij")
-    coords = np.stack([I.ravel(), J.ravel(), K.ravel()], axis=1)
+    gz, gy, gx = np.meshgrid(zs, ys, xs, indexing="ij")
+    coords = np.stack([gx.ravel(), gy.ravel(), gz.ravel()], axis=1)
 
     ci, cj, ck = np.meshgrid(
         np.arange(nx), np.arange(ny), np.arange(nz), indexing="ij"

@@ -190,7 +190,8 @@ class TetMesh:
                 axis=1,
             )
 
-        put = lambda a, dt: jnp.asarray(a, dtype=dt)
+        def put(a, dt):
+            return jnp.asarray(a, dtype=dt)
         return cls(
             coords=put(coords, dtype),
             tet2vert=put(tet2vert, jnp.int32),

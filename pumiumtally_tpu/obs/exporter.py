@@ -56,7 +56,12 @@ class MetricsExporter:
 
         self._server = ThreadingHTTPServer((host, int(port)), _Handler)
         self._server.daemon_threads = True
-        self._stopped = False
+        # stop() races between facade close() and the GC finalizer
+        # thread; the flag flip must be atomic so exactly one caller
+        # runs the shutdown sequence (machine-checked by
+        # analysis/astlint.py PUMI007).
+        self._stop_lock = threading.Lock()
+        self._stopped = False  # guarded by: self._stop_lock
         self._thread = threading.Thread(
             target=self._server.serve_forever,
             name="pumi-metrics-exporter",
@@ -77,9 +82,10 @@ class MetricsExporter:
     def stop(self) -> None:
         """Shut the server down and release the socket (idempotent —
         called from facade close() AND the facade's GC finalizer)."""
-        if self._stopped:
-            return
-        self._stopped = True
+        with self._stop_lock:
+            if self._stopped:
+                return
+            self._stopped = True
         self._server.shutdown()
         self._server.server_close()
         self._thread.join(timeout=5)

@@ -20,16 +20,15 @@ class FlightRecorder:
     def __init__(self, capacity: int = 512, sink: str | None = None):
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
-        self._records: collections.deque = collections.deque(
-            maxlen=capacity
-        )
-        self._seq = 0
         # Writers are not single-threaded: the integrity watchdog
         # dispatches from a worker thread and the Prometheus exporter
         # reads concurrently, so sequencing + the ring append happen
         # under a lock (an unlocked _seq increment can duplicate or
-        # skip sequence numbers under interleaving).
+        # skip sequence numbers under interleaving).  The annotations
+        # are machine-checked by analysis/astlint.py PUMI007.
         self._lock = threading.Lock()
+        self._records = collections.deque(maxlen=capacity)  # guarded by: self._lock
+        self._seq = 0  # guarded by: self._lock
         # None defers to PUMI_TPU_METRICS at record time (env can change
         # between moves, e.g. under pytest monkeypatch).
         self._sink = sink
@@ -57,9 +56,11 @@ class FlightRecorder:
             return list(self._records)[-n:]
 
     def __len__(self) -> int:
-        return len(self._records)
+        with self._lock:
+            return len(self._records)
 
     @property
     def total_recorded(self) -> int:
         """Records ever appended (>= len() once the ring wraps)."""
-        return self._seq
+        with self._lock:
+            return self._seq

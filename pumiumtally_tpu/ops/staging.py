@@ -45,6 +45,8 @@ in-flight copy of move k.
 """
 from __future__ import annotations
 
+import threading
+
 import numpy as np
 
 import jax
@@ -177,23 +179,31 @@ class HostStager:
 
     def __init__(self, depth: int = 1):
         self.depth = max(1, int(depth))
+        # The ring rotation is not single-threaded: a watchdog-
+        # supervised dispatch closure or an escalation re-walk can
+        # request a cold-path buffer from a worker thread while the
+        # facade thread packs the next move's record, and an unlocked
+        # setdefault/rotate pair can hand the same buffer out twice.
+        # Machine-checked by analysis/astlint.py PUMI007.
+        self._lock = threading.Lock()
         # Per-(shape, dtype) ring + its own rotation counter: reuse must
         # hand back the OLDEST buffer (the one whose H2D copy is the
         # furthest in the past), and interleaved record shapes (init vs
         # move) must not steal each other's rotation.
-        self._bufs: dict = {}
+        self._bufs: dict = {}  # guarded by: self._lock
 
     def buf(self, shape: tuple, dtype) -> np.ndarray:
         key = (tuple(shape), np.dtype(dtype))
         if jax.default_backend() == "cpu":
             return np.zeros(shape, dtype)
-        ring, turn = self._bufs.setdefault(key, ([], 0))
-        if len(ring) < self.depth:
-            ring.append(np.zeros(shape, dtype))
-            self._bufs[key] = (ring, turn)
-            return ring[-1]
-        b = ring[turn % self.depth]
-        self._bufs[key] = (ring, turn + 1)
+        with self._lock:
+            ring, turn = self._bufs.setdefault(key, ([], 0))
+            if len(ring) < self.depth:
+                ring.append(np.zeros(shape, dtype))
+                self._bufs[key] = (ring, turn)
+                return ring[-1]
+            b = ring[turn % self.depth]
+            self._bufs[key] = (ring, turn + 1)
         b.fill(0)
         return b
 

@@ -71,12 +71,10 @@ unless ``PUMI_TPU_PALLAS_INTERPRET=1`` opts interpret mode in.
 """
 from __future__ import annotations
 
-import functools
 import os
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax.experimental import pallas as pl
 
 from .geometry import exit_face

@@ -102,8 +102,6 @@ def ladder_slots_rounds(active, n, stages, unroll=8):
 
 
 def main():
-    import jax
-
     from pumiumtally_tpu.utils.platform import maybe_force_cpu
 
     maybe_force_cpu()

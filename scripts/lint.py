@@ -1,0 +1,166 @@
+#!/usr/bin/env python
+"""graft-check: run both static-analysis layers (+ ruff when present).
+
+  python scripts/lint.py                 # astlint + contracts + ruff
+  python scripts/lint.py --ast-only
+  python scripts/lint.py --contracts-only
+  python scripts/lint.py --write-contracts   # regenerate CONTRACTS.json
+                                             # (intentional drift only)
+
+Layer 1 (pumiumtally_tpu/analysis/astlint.py) lints the package source
+against the codebase-specific rules PUMI001..PUMI007.  Layer 2
+(analysis/contracts.py) abstract-traces the five public program
+families and checks the structural invariants plus drift against the
+committed CONTRACTS.json.  Findings are suppressed per (rule, path,
+symbol) through LINT_BASELINE.json; every suppression carries a
+justification.  Exit 0 = no non-baselined findings; 1 = findings;
+2 = environment/usage error.
+
+The contract capture is environment-sensitive, so this runner pins the
+canonical lint environment BEFORE importing jax: CPU backend, 8 virtual
+devices (the partitioned family's mesh), x64 off (the f32 production
+dtype whose purity the contracts assert).
+"""
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+# Pin the canonical contract environment before jax can be imported.
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("JAX_ENABLE_X64", None)
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    )
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def run_ast(baseline_entries, verbose):
+    from pumiumtally_tpu.analysis import apply_baseline
+    from pumiumtally_tpu.analysis.astlint import lint_package
+
+    findings = lint_package(ROOT)
+    kept, suppressed, unused = apply_baseline(
+        findings, [e for e in baseline_entries
+                   if not e["rule"].startswith("CONTRACT")]
+    )
+    return report("astlint", kept, suppressed, unused, verbose)
+
+
+def run_contracts(args, baseline_entries, verbose):
+    from pumiumtally_tpu.analysis import apply_baseline
+    from pumiumtally_tpu.analysis import contracts as C
+
+    contracts_path = os.path.join(ROOT, args.contracts)
+    if args.write_contracts:
+        cap = C.write_contracts(contracts_path)
+        print(
+            f"wrote {args.contracts} for "
+            f"{sorted(cap['families'])} under {cap['environment']}"
+        )
+        findings = C.check_structural(cap)
+        kept, suppressed, unused = apply_baseline(
+            findings, [e for e in baseline_entries
+                       if e["rule"].startswith("CONTRACT")]
+        )
+        return report("contracts", kept, suppressed, unused, verbose)
+    cap = C.capture()
+    findings = C.check_structural(cap)
+    if os.path.exists(contracts_path):
+        findings += C.diff_baseline(cap, C.load_contracts(contracts_path))
+    else:
+        findings.append(
+            C._finding(
+                "baseline.missing", "all",
+                f"{args.contracts} not found — generate it with "
+                "scripts/lint.py --write-contracts",
+            )
+        )
+    kept, suppressed, unused = apply_baseline(
+        findings, [e for e in baseline_entries
+                   if e["rule"].startswith("CONTRACT")]
+    )
+    return report("contracts", kept, suppressed, unused, verbose)
+
+
+def run_ruff():
+    ruff = shutil.which("ruff")
+    if ruff is None:
+        print(
+            "ruff: not installed here — skipped (CI installs and runs "
+            "it; config lives in pyproject.toml [tool.ruff])"
+        )
+        return 0
+    proc = subprocess.run([ruff, "check", ROOT])
+    print(f"ruff: {'clean' if proc.returncode == 0 else 'FINDINGS'}")
+    return 1 if proc.returncode else 0
+
+
+def report(layer, kept, suppressed, unused, verbose):
+    for f in kept:
+        print(f.render())
+    if verbose:
+        for f in suppressed:
+            print(f"suppressed: {f.render()}")
+    for e in unused:
+        print(
+            f"warning: stale baseline entry {e['rule']} {e['path']} "
+            f"[{e['symbol']}] — the finding is gone; retire the "
+            "suppression"
+        )
+    state = "clean" if not kept else f"{len(kept)} finding(s)"
+    print(
+        f"{layer}: {state}"
+        + (f", {len(suppressed)} baselined" if suppressed else "")
+    )
+    return 1 if kept else 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--ast-only", action="store_true")
+    ap.add_argument("--contracts-only", action="store_true")
+    ap.add_argument("--ruff-only", action="store_true")
+    ap.add_argument("--write-contracts", action="store_true")
+    ap.add_argument("--baseline", default="LINT_BASELINE.json")
+    ap.add_argument("--contracts", default="CONTRACTS.json")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args()
+
+    only = [args.ast_only, args.contracts_only, args.ruff_only]
+    if sum(only) > 1:
+        ap.error("--ast-only/--contracts-only/--ruff-only are exclusive")
+    do_ast = not (args.contracts_only or args.ruff_only)
+    do_contracts = not (args.ast_only or args.ruff_only)
+    do_ruff = not (args.ast_only or args.contracts_only)
+
+    baseline_path = os.path.join(ROOT, args.baseline)
+    if os.path.exists(baseline_path):
+        from pumiumtally_tpu.analysis import load_baseline
+
+        entries = load_baseline(baseline_path)
+    else:
+        entries = []
+
+    rc = 0
+    if do_ast:
+        rc |= run_ast(entries, args.verbose)
+    if do_contracts:
+        rc |= run_contracts(args, entries, args.verbose)
+    if do_ruff:
+        rc |= run_ruff()
+    return rc
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except (RuntimeError, ValueError, json.JSONDecodeError) as e:
+        print(f"lint environment/config error: {e}", file=sys.stderr)
+        sys.exit(2)

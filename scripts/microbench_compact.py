@@ -20,10 +20,10 @@ ITERS = 20
 
 def timeit(name, fn, *args):
     t0 = time.perf_counter()
-    out = jax.block_until_ready(fn(*args))
+    jax.block_until_ready(fn(*args))
     comp = time.perf_counter() - t0
     t0 = time.perf_counter()
-    out = jax.block_until_ready(fn(*args))
+    jax.block_until_ready(fn(*args))
     dt = (time.perf_counter() - t0) / ITERS
     print(f"{name:28s} {dt*1e3:9.3f} ms/call  (compile {comp:4.1f}s)",
           flush=True)

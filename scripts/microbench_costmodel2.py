@@ -92,7 +92,8 @@ def main():
     def plain(flux, i, c):
         return flux.at[i].add(c, mode="drop")
 
-    z = lambda: jnp.zeros(bins, jnp.float32)
+    def z():
+        return jnp.zeros(bins, jnp.float32)
     dt = timeit_donated(jax.jit(plain, donate_argnums=(0,)), z(), idx, c)
     print(f"  unsorted        {dt*1e3:8.2f} ms")
 

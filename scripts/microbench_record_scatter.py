@@ -123,11 +123,12 @@ def main():
         flux = flux.at[fk, 1].add(fc * fc, mode="drop")
         return flux
 
-    z = lambda: jnp.zeros((NG, 2), jnp.float32)
+    def z():
+        return jnp.zeros((NG, 2), jnp.float32)
     print(f"n={n} K={K} ntet={ntet} G={G}  ({K*n/1e6:.1f}M records)")
     t_iter = timeit("iter_scatter", iter_scatter, (z(), key0, c0))
     t_rec = timeit("record+flush", record_flush, (z(), key0, c0))
-    t_seg = timeit("record+seg", record_seg, (z(), key0, c0))
+    timeit("record+seg", record_seg, (z(), key0, c0))
     t_fl = timeit("flush_only", flush_only, (z(), big_k, big_c))
     print(
         f"per-iter: scatter {t_iter/K*1e3:.2f} ms vs record "

@@ -73,7 +73,6 @@ def ladder_slots(active: np.ndarray, n: int, stages, round_cost: float,
         if start >= kmax:
             break
         nxt = min(starts[i + 1], kmax)
-        alive = active[min(start, kmax)]
         if i + 1 < len(stages):
             # One round of `width`; overflow waits (still counts later —
             # conservatively assume it joins the next stage unharmed).
@@ -169,8 +168,6 @@ def optimize_ladder(active, n, round_cost, unroll=8, grid_step=4,
 
 
 def main():
-    import jax
-
     from pumiumtally_tpu.utils.platform import maybe_force_cpu
 
     maybe_force_cpu()
@@ -189,7 +186,8 @@ def main():
     rng = np.random.default_rng(0)
     elem = jnp.asarray(rng.integers(0, mesh.ntet, n).astype(np.int32))
     origin = jnp.asarray(np.asarray(mesh.centroids())[np.asarray(elem)], dtype)
-    d = rng.normal(0, 1, (n, 3)); d /= np.linalg.norm(d, axis=1, keepdims=True)
+    d = rng.normal(0, 1, (n, 3))
+    d /= np.linalg.norm(d, axis=1, keepdims=True)
     ln = rng.exponential(mean_path, (n, 1))
     dest = jnp.asarray(np.clip(np.asarray(origin) + d * ln, 0.01, 0.99), dtype)
     r = trace_impl(

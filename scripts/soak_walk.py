@@ -46,10 +46,12 @@ n_seeds = int(args[0]) if args else 12
 fails = 0
 for seed in range(n_seeds):
     rng = np.random.default_rng(1000 + seed)
-    nx = int(rng.integers(3, 8)); jitter = float(rng.uniform(0.0, 0.28))
+    nx = int(rng.integers(3, 8))
+    jitter = float(rng.uniform(0.0, 0.28))
     coords, tets = build_box_arrays(1.0, 1.0, 1.0, nx, nx, nx)
     interior = ((coords > 1e-9).all(1) & (coords < 1 - 1e-9).all(1))
-    c = coords.copy(); c[interior] += rng.uniform(-jitter/nx, jitter/nx, (interior.sum(), 3))
+    c = coords.copy()
+    c[interior] += rng.uniform(-jitter/nx, jitter/nx, (interior.sum(), 3))
     cid = (c[tets].mean(1)[:, 0] > 0.5).astype(np.int32)
     try:
         mesh = TetMesh.from_numpy(c, tets, cid, dtype=jnp.float32)
@@ -74,7 +76,8 @@ for seed in range(n_seeds):
         robust=robust, tally_scatter=scatter, gathers=gath,
         compact_stages=((6, max(n//2, 32)), (12, max(n//4, 32), 4)), unroll=2,
     )
-    pos = np.asarray(r.position); tl = np.asarray(r.track_length)
+    pos = np.asarray(r.position)
+    tl = np.asarray(r.track_length)
     ok = (np.isfinite(pos).all()
           and np.allclose(tl, np.linalg.norm(pos - origin, axis=1), atol=3e-4)
           and np.isclose(float(np.asarray(r.flux)[..., 0].sum()), tl.sum(), rtol=1e-4)

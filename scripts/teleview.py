@@ -101,8 +101,11 @@ def print_table(rows: list[tuple], totals: dict, bad: int) -> None:
     print(f"{'kind':<16} {'count':>8} {'total s':>10} "
           f"{'mean s':>10} {'max s':>10}")
     print("-" * 58)
+
+    def fmt(v):
+        return f"{v:10.4f}" if v is not None else f"{'-':>10}"
+
     for kind, count, tot, mean, mx in rows:
-        fmt = lambda v: f"{v:10.4f}" if v is not None else f"{'-':>10}"
         print(
             f"{kind:<16} {count:>8} {fmt(tot if mean is not None else None)}"
             f" {fmt(mean)} {fmt(mx)}"

@@ -70,23 +70,23 @@ def test_partition_adjacency_encoding(box):
     ncls = np.asarray(part.nbr_class)
     cls = np.asarray(box.class_id)
     for p in range(N_DEV):
-        for l in range(int(part.counts[p])):
-            g = part.local2global[p, l]
+        for li in range(int(part.counts[p])):
+            g = part.local2global[p, li]
             for f in range(4):
                 nb = t2t[g, f]
-                e = enc[p, l, f]
+                e = enc[p, li, f]
                 if nb < 0:
                     assert e == -1
-                    assert ncls[p, l, f] == cls[g]
+                    assert ncls[p, li, f] == cls[g]
                 elif part.owner[nb] == p:
                     assert e == part.global2local[nb]
-                    assert ncls[p, l, f] == cls[nb]
+                    assert ncls[p, li, f] == cls[nb]
                 else:
                     owner, loc = decode_remote(e, part.max_local)
                     assert owner == part.owner[nb]
                     assert loc == part.global2local[nb]
                     assert part.local2global[owner, loc] == nb
-                    assert ncls[p, l, f] == cls[nb]
+                    assert ncls[p, li, f] == cls[nb]
     # Padded rows are inert.
     for p in range(N_DEV):
         assert np.all(enc[p, int(part.counts[p]) :] == -1)
@@ -219,7 +219,6 @@ def test_partitioned_material_boundaries(two_region_box):
     part = partition_mesh(mesh, N_DEV)
     # Rays crossing x=0.5 must stop at the material interface.
     n = 40
-    rng = np.random.default_rng(7)
     elem, origin, dest, weight, group = _random_batch(mesh, n, seed=7)
     # Force crossings: send everything toward the far half in x.
     dest[:, 0] = np.where(origin[:, 0] < 0.5, 0.95, 0.05)

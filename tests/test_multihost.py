@@ -11,7 +11,6 @@ Skips when the CPU backend lacks multi-process collective support.
 """
 from __future__ import annotations
 
-import json
 import os
 import socket
 import subprocess
@@ -336,7 +335,7 @@ class TestWriteParallelVtk:
         flux = np.zeros((mesh.ntet, 1, 2))
         monkeypatch.setattr(jax, "process_index", lambda: 1)
         monkeypatch.setattr(jax, "process_count", lambda: 2)
-        piece = write_parallel_vtk(
+        write_parallel_vtk(
             str(tmp_path / "out"), mesh, flux,
             elem_slice=slice(0, mesh.ntet // 2),
         )
@@ -415,9 +414,10 @@ WORKER_PARTITIONED = textwrap.dedent(
     )
     # Globalize results host-side (process_allgather collects every
     # process's addressable shards).
-    ag = lambda x: np.asarray(
-        multihost_utils.process_allgather(x, tiled=True)
-    )
+    def ag(x):
+        return np.asarray(
+            multihost_utils.process_allgather(x, tiled=True)
+        )
     slabs = ag(res.flux)
     valid = ag(res.valid)
     done = ag(res.done)

@@ -11,7 +11,6 @@ from pumiumtally_tpu.parallel.particle_sharding import (
     make_device_mesh,
     make_sharded_flux,
     make_sharded_trace,
-    n_shards,
     reduce_flux,
     replicate,
     shard_particles,

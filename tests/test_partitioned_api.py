@@ -83,7 +83,7 @@ def test_partitioned_tally_writes_vtk(mesh, tmp_path):
         buf, np.ones(64, np.int8), np.ones(64),
         np.zeros(64, np.int32), np.zeros(64, np.int32),
     )
-    out = t.write_pumi_tally_mesh(str(tmp_path / "part_flux.vtu"))
+    t.write_pumi_tally_mesh(str(tmp_path / "part_flux.vtu"))
     body = (tmp_path / "part_flux.vtu").read_text()
     assert "flux_group_0" in body and "volume" in body
     assert t.total_rounds >= 1 and t.iter_count == 1

@@ -32,7 +32,6 @@ from pumiumtally_tpu.resilience import (
     parse_faults,
 )
 from pumiumtally_tpu.utils.checkpoint import (
-    CheckpointIntegrityError,
     verify_checkpoint,
 )
 

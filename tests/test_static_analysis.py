@@ -1,0 +1,649 @@
+"""Static-analysis subsystem tests (pumiumtally_tpu/analysis/).
+
+Layer 1 (astlint): positive AND negative fixture snippets per rule —
+every rule must fire on its target pattern and stay quiet on the
+sanctioned idiom next to it.  Layer 2 (contracts): the extraction and
+invariant machinery is exercised against real traced programs, then
+regressions are INJECTED — an extra in-program transfer in a wrapped
+step, a host callback, a dropped donation, an f64 leak, a scan degraded
+away — and the named invariant must fire.  Finally the whole runner
+(scripts/lint.py) must exit 0 on the repo itself: the codebase stays
+lint-clean, and CONTRACTS.json matches the committed programs.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pumiumtally_tpu.analysis import (
+    Finding,
+    apply_baseline,
+    load_baseline,
+)
+from pumiumtally_tpu.analysis.astlint import lint_package, lint_sources
+
+ROOT = Path(__file__).resolve().parents[1]
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+def at(findings, rule):
+    return [f for f in findings if f.rule == rule]
+
+
+# --------------------------------------------------------------------- #
+# PUMI001: host sync in traced bodies
+# --------------------------------------------------------------------- #
+def test_host_sync_fires_in_jitted_fn():
+    src = """
+import jax, jax.numpy as jnp
+import numpy as np
+
+def step(x, y):
+    s = jnp.sum(x)
+    bad = float(s)
+    return bad * y
+
+_jit = jax.jit(step)
+"""
+    fs = lint_sources({"pumiumtally_tpu/ops/fake.py": src})
+    assert [f.rule for f in fs] == ["PUMI001"]
+    assert fs[0].symbol == "step"
+    assert "float()" in fs[0].message
+
+
+def test_host_sync_item_and_asarray_fire_via_call_graph():
+    # helper() is not itself jitted, but the traced step calls it —
+    # the package-wide fixpoint must propagate tracedness into it.
+    src = """
+import jax, jax.numpy as jnp
+import numpy as np
+
+def helper(v):
+    return np.asarray(v)
+
+def step(x):
+    n = x.item()
+    return helper(x) + n
+
+_jit = jax.jit(step)
+"""
+    fs = lint_sources({"pumiumtally_tpu/ops/fake.py": src})
+    assert len(at(fs, "PUMI001")) == 2
+    assert {f.symbol for f in fs} == {"step", "helper"}
+
+
+def test_host_sync_quiet_on_host_fn_and_static_knobs():
+    src = """
+import jax, jax.numpy as jnp
+import numpy as np
+
+def host_reader(x):
+    return float(np.asarray(x).sum())  # never traced: fine
+
+def step(x, *, stages):
+    # kw-only params are the static-knob convention: probing them at
+    # trace time is sanctioned.
+    k = int(stages)
+    n = x.shape[0]        # static metadata of a traced array
+    m = int(n)            # derived static: fine
+    return x * k * m
+
+_jit = jax.jit(step, static_argnames=("stages",))
+"""
+    fs = lint_sources({"pumiumtally_tpu/ops/fake.py": src})
+    assert fs == []
+
+
+def test_device_get_always_fires_in_traced():
+    src = """
+import jax
+
+def body(c, t):
+    jax.device_get(c)
+    return c, t
+
+def run(xs):
+    import jax.numpy as jnp
+    from jax import lax
+    return lax.scan(body, jnp.zeros(3), xs)
+"""
+    fs = lint_sources({"pumiumtally_tpu/ops/fake.py": src})
+    # PUMI001 (host sync in traced body) AND PUMI002 (transfer outside
+    # the staging modules) both apply — the call breaks two contracts.
+    assert rules_of(fs) == ["PUMI001", "PUMI002"]
+    assert at(fs, "PUMI001")[0].symbol == "body"
+
+
+# --------------------------------------------------------------------- #
+# PUMI002: transfers outside the staging modules
+# --------------------------------------------------------------------- #
+def test_transfer_outside_staging_fires():
+    src = """
+import jax
+
+def leak(x):
+    return jax.device_put(x)
+"""
+    fs = lint_sources({"pumiumtally_tpu/obs/fake.py": src})
+    assert [f.rule for f in fs] == ["PUMI002"]
+
+
+def test_transfer_in_approved_module_clean():
+    src = """
+import jax
+
+def stage(x):
+    return jax.device_put(x)
+"""
+    fs = lint_sources({"pumiumtally_tpu/api.py": src})
+    assert fs == []
+
+
+# --------------------------------------------------------------------- #
+# PUMI003: use after donate
+# --------------------------------------------------------------------- #
+_DONATE_MODULE = """
+import jax, jax.numpy as jnp
+
+def impl(state, flux):
+    return state + 1, flux + state
+
+_step = jax.jit(impl, donate_argnames=("flux",))
+
+def step(*args, **kwargs):
+    return _step(*args, **kwargs)
+"""
+
+
+def test_use_after_donate_fires_on_kwarg_and_positional():
+    src = _DONATE_MODULE + """
+def caller(state, flux):
+    out = _step(state, flux=flux)
+    return flux.sum() + out[0]
+
+def caller_pos(state, flux):
+    out = _step(state, flux)
+    return flux.sum() + out[0]
+"""
+    fs = lint_sources({"pumiumtally_tpu/ops/fake.py": src})
+    assert len(at(fs, "PUMI003")) == 2
+    assert {f.symbol for f in at(fs, "PUMI003")} == {
+        "caller", "caller_pos"
+    }
+
+
+def test_use_after_donate_quiet_after_rebind_and_via_wrapper():
+    src = _DONATE_MODULE + """
+def good(state, flux):
+    state2, flux = _step(state, flux=flux)
+    return flux.sum() + state2
+
+def wrapper_caller(state, flux):
+    out = step(state, flux=flux)   # pass-through wrapper donates too
+    return flux.sum()
+"""
+    fs = lint_sources({"pumiumtally_tpu/ops/fake.py": src})
+    assert {f.symbol for f in at(fs, "PUMI003")} == {"wrapper_caller"}
+
+
+def test_use_after_donate_tracks_self_attributes():
+    src = _DONATE_MODULE + """
+class Facade:
+    def move(self):
+        out = _step(self.state, flux=self.flux)
+        self.state = out[0]
+        return self.flux
+"""
+    fs = lint_sources({"pumiumtally_tpu/ops/fake.py": src})
+    assert len(at(fs, "PUMI003")) == 1
+    assert "self.flux" in fs[0].message
+
+
+# --------------------------------------------------------------------- #
+# PUMI004: nondeterminism in traced bodies
+# --------------------------------------------------------------------- #
+def test_nondeterminism_fires_only_in_traced():
+    src = """
+import time, random
+import jax
+
+def step(x):
+    t = time.time()
+    r = random.random()
+    return x + t + r
+
+_jit = jax.jit(step)
+
+def host_bench(x):
+    t0 = time.perf_counter()   # host timing: fine
+    return t0
+"""
+    fs = lint_sources({"pumiumtally_tpu/ops/fake.py": src})
+    assert [f.rule for f in fs] == ["PUMI004", "PUMI004"]
+    assert all(f.symbol == "step" for f in fs)
+
+
+# --------------------------------------------------------------------- #
+# PUMI005: f64 on device paths
+# --------------------------------------------------------------------- #
+def test_f64_fires_outside_dispatch_and_audit_exempt():
+    bad = """
+import jax.numpy as jnp
+
+ACC = jnp.zeros(4, jnp.float64)
+"""
+    fs = lint_sources({"pumiumtally_tpu/ops/fake.py": bad})
+    assert [f.rule for f in fs] == ["PUMI005"]
+    # integrity/audit.py is the sanctioned f64 surface.
+    fs = lint_sources({"pumiumtally_tpu/integrity/audit.py": bad})
+    assert fs == []
+
+
+def test_f64_quiet_in_dtype_dispatch_branch():
+    src = """
+import jax, jax.numpy as jnp
+from jax import lax
+
+def exp2i(k, dtype):
+    if dtype == jnp.float64:
+        return lax.bitcast_convert_type(
+            (k.astype(jnp.int64) + 1023) << 52, jnp.float64
+        )
+    return jnp.exp2(k)
+
+def unpack(rec):
+    dtype = jnp.float32 if rec.dtype == jnp.uint32 else jnp.float64
+    return rec.astype(dtype)
+"""
+    fs = lint_sources({"pumiumtally_tpu/ops/fake.py": src})
+    assert fs == []
+
+
+def test_f64_literal_string_fires_in_traced():
+    src = """
+import jax, jax.numpy as jnp
+
+def step(x):
+    return x.astype("float64")
+
+_jit = jax.jit(step)
+"""
+    fs = lint_sources({"pumiumtally_tpu/ops/fake.py": src})
+    assert [f.rule for f in fs] == ["PUMI005"]
+
+
+# --------------------------------------------------------------------- #
+# PUMI006: jit static hygiene
+# --------------------------------------------------------------------- #
+def test_jit_inside_loop_fires():
+    src = """
+import jax
+
+def sweep(xs):
+    out = []
+    for x in xs:
+        out.append(jax.jit(lambda v: v * 2)(x))
+    return out
+"""
+    fs = lint_sources({"pumiumtally_tpu/models/fake.py": src})
+    assert [f.rule for f in fs] == ["PUMI006"]
+
+
+def test_static_loop_var_fires_and_hoisted_clean():
+    src = """
+import jax
+
+def impl(k, x):
+    return x * k
+
+_jit = jax.jit(impl, static_argnums=(0,))
+
+def bad(xs):
+    acc = 0
+    for i in range(8):
+        acc += _jit(i, xs)       # new compile every i
+    return acc
+
+def good(xs, k):
+    acc = 0
+    for i in range(8):
+        acc += _jit(k, xs)       # static arg fixed across the loop
+    return acc
+"""
+    fs = lint_sources({"pumiumtally_tpu/models/fake.py": src})
+    assert [f.rule for f in fs] == ["PUMI006"]
+    assert fs[0].symbol == "bad"
+
+
+# --------------------------------------------------------------------- #
+# PUMI007: guarded-by
+# --------------------------------------------------------------------- #
+def test_guarded_attr_fires_outside_lock_quiet_inside():
+    src = """
+import threading
+
+class Rec:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._seq = 0  # guarded by: self._lock
+
+    def bad(self):
+        self._seq += 1
+
+    def good(self):
+        with self._lock:
+            self._seq += 1
+            return self._seq
+"""
+    fs = lint_sources({"pumiumtally_tpu/obs/fake.py": src})
+    assert [f.rule for f in fs] == ["PUMI007"]
+    assert fs[0].symbol == "Rec.bad"
+
+
+def test_event_guard_requires_set_and_wait():
+    src = """
+import threading
+
+def run(fn, seconds):
+    outcome = {}  # guarded by: finished (event)
+    finished = threading.Event()
+
+    def target():
+        outcome["value"] = fn()   # missing finished.set()
+
+    t = threading.Thread(target=target)
+    t.start()
+    return outcome.get("value")   # read before finished.wait()
+"""
+    fs = lint_sources({"pumiumtally_tpu/integrity/fake.py": src})
+    msgs = [f.message for f in at(fs, "PUMI007")]
+    assert len(msgs) == 2
+    assert any("happens-before" in m for m in msgs)
+    assert any("may still be writing" in m for m in msgs)
+
+
+def test_event_guard_clean_pattern():
+    src = """
+import threading
+
+def run(fn, seconds):
+    outcome = {}  # guarded by: finished (event)
+    finished = threading.Event()
+
+    def target():
+        try:
+            outcome["value"] = fn()
+        finally:
+            finished.set()
+
+    t = threading.Thread(target=target)
+    t.start()
+    if not finished.wait(seconds):
+        raise TimeoutError
+    return outcome["value"]
+"""
+    fs = lint_sources({"pumiumtally_tpu/integrity/fake.py": src})
+    assert fs == []
+
+
+# --------------------------------------------------------------------- #
+# Baseline machinery
+# --------------------------------------------------------------------- #
+def test_baseline_suppresses_by_symbol_and_reports_stale(tmp_path):
+    f1 = Finding("PUMI002", "pumiumtally_tpu/obs/x.py", 3, "leak", "m")
+    entries = [
+        {"rule": "PUMI002", "path": "pumiumtally_tpu/obs/x.py",
+         "symbol": "leak", "justification": "test"},
+        {"rule": "PUMI001", "path": "pumiumtally_tpu/obs/x.py",
+         "symbol": "gone", "justification": "stale"},
+    ]
+    kept, suppressed, unused = apply_baseline([f1], entries)
+    assert kept == [] and len(suppressed) == 1 and len(unused) == 1
+    assert unused[0]["symbol"] == "gone"
+
+
+def test_baseline_rejects_missing_justification(tmp_path):
+    p = tmp_path / "b.json"
+    p.write_text(json.dumps({"suppressions": [
+        {"rule": "PUMI001", "path": "x.py", "symbol": "f",
+         "justification": ""}
+    ]}))
+    with pytest.raises(ValueError, match="justification"):
+        load_baseline(p)
+
+
+# --------------------------------------------------------------------- #
+# The repo itself stays clean
+# --------------------------------------------------------------------- #
+def test_repo_astlint_clean_modulo_baseline():
+    findings = lint_package(ROOT)
+    entries = load_baseline(ROOT / "LINT_BASELINE.json")
+    kept, _, _ = apply_baseline(findings, entries)
+    assert kept == [], "\n".join(f.render() for f in kept)
+
+
+def test_threaded_surface_is_annotated():
+    """The concurrency lint only protects what is annotated: the four
+    threaded classes must each declare at least one guarded member."""
+    for rel in (
+        "pumiumtally_tpu/obs/recorder.py",
+        "pumiumtally_tpu/ops/staging.py",
+        "pumiumtally_tpu/obs/exporter.py",
+        "pumiumtally_tpu/integrity/watchdog.py",
+    ):
+        text = (ROOT / rel).read_text()
+        assert "# guarded by:" in text, f"{rel} lost its annotations"
+
+
+# --------------------------------------------------------------------- #
+# Layer 2: contract extraction + injected regressions
+# --------------------------------------------------------------------- #
+def _sig_of(jitted, *args, **kwargs):
+    from pumiumtally_tpu.analysis.contracts import extract_signature
+
+    return extract_signature(jitted.trace(*args, **kwargs))
+
+
+def _structural(fam, sig):
+    from pumiumtally_tpu.analysis.contracts import check_structural
+
+    return check_structural({"families": {fam: sig}})
+
+
+def test_extract_signature_shape():
+    sig = _sig_of(
+        jax.jit(lambda x: x * 2, donate_argnums=(0,)),
+        jnp.ones(3, jnp.float32),
+    )
+    assert sig["donated_args"] == 1
+    assert sig["inputs"] == ["float32[3]"]
+    assert sig["f64_avals"] == 0
+    assert "mul" in sig["prims"]
+
+
+def test_injected_transfer_in_wrapped_step_fires():
+    """Regression injection: a 'helpful' jax.device_put inside a
+    wrapped walk step — io.transfers must name it."""
+    from pumiumtally_tpu.ops import walk
+
+    mesh, a = _tiny_problem()
+
+    def wrapped(origin, dest, elem, fly, w, g, mat, flux):
+        flux = jax.device_put(flux)  # the injected contract break
+        return walk.trace_impl(
+            mesh, origin, dest, elem, fly, w, g, mat, flux,
+            **_tiny_statics(),
+        )
+
+    sig = _sig_of(
+        jax.jit(wrapped, donate_argnums=(7,)),
+        a["origin"], a["dest"], a["elem"], a["in_flight"],
+        a["weight"], a["group"], a["material_id"], a["flux"],
+    )
+    assert sig["prims"].get("device_put", 0) >= 1
+    syms = [f.symbol for f in _structural("trace_packed", sig)]
+    assert "io.transfers.trace_packed" in syms
+
+
+def test_injected_host_callback_fires():
+    """Regression injection: a host peek (the traceable analogue of a
+    device_get mid-step) — io.callbacks must name it."""
+    from pumiumtally_tpu.ops import walk
+
+    mesh, a = _tiny_problem()
+
+    def wrapped(origin, dest, elem, fly, w, g, mat, flux):
+        r = walk.trace_impl(
+            mesh, origin, dest, elem, fly, w, g, mat, flux,
+            **_tiny_statics(),
+        )
+        peeked = jax.pure_callback(
+            lambda v: np.asarray(v), jax.ShapeDtypeStruct((), r.flux.dtype),
+            r.n_segments.astype(r.flux.dtype),
+        )
+        return r._replace(n_segments=peeked.astype(r.n_segments.dtype))
+
+    sig = _sig_of(
+        jax.jit(wrapped, donate_argnums=(7,)),
+        a["origin"], a["dest"], a["elem"], a["in_flight"],
+        a["weight"], a["group"], a["material_id"], a["flux"],
+    )
+    syms = [f.symbol for f in _structural("trace", sig)]
+    assert "io.callbacks.trace" in syms
+
+
+def test_injected_dropped_donation_fires():
+    """Regression injection: re-jitting the step WITHOUT donation —
+    donation.<family> must fire."""
+    from pumiumtally_tpu.ops import walk
+
+    mesh, a = _tiny_problem()
+
+    def plain(origin, dest, elem, fly, w, g, mat, flux):
+        return walk.trace_impl(
+            mesh, origin, dest, elem, fly, w, g, mat, flux,
+            **_tiny_statics(),
+        )
+
+    sig = _sig_of(
+        jax.jit(plain),  # donation dropped
+        a["origin"], a["dest"], a["elem"], a["in_flight"],
+        a["weight"], a["group"], a["material_id"], a["flux"],
+    )
+    assert sig["donated_args"] == 0
+    syms = [f.symbol for f in _structural("trace", sig)]
+    assert "donation.trace" in syms
+
+
+def test_injected_f64_leak_fires():
+    sig = _sig_of(
+        jax.jit(
+            lambda x: (x.astype(jnp.float64) * 2).astype(x.dtype),
+            donate_argnums=(0,),
+        ),
+        jnp.ones(3, jnp.float32),
+    )
+    assert sig["f64_avals"] > 0 and sig["convert_to_f64"] >= 1
+    syms = [f.symbol for f in _structural("trace", sig)]
+    assert "dtype.f32_purity.trace" in syms
+
+
+def test_degraded_scan_fires_on_megastep():
+    from pumiumtally_tpu.analysis.contracts import check_structural
+
+    sig = _sig_of(
+        jax.jit(lambda x: x + 1, donate_argnums=(0,)),
+        jnp.ones(3, jnp.float32),
+    )  # no scan anywhere
+    syms = [
+        f.symbol
+        for f in check_structural({"families": {"megastep": sig}})
+    ]
+    assert "structure.scan.megastep" in syms
+    assert "structure.scatter.megastep" in syms
+
+
+def test_real_trace_family_satisfies_structural_invariants():
+    from pumiumtally_tpu.analysis import contracts as C
+
+    traced = C.build_traced(families=("trace",))
+    sigs = {
+        "environment": C.environment(),
+        "families": {k: C.extract_signature(v) for k, v in traced.items()},
+    }
+    # Under the x64 test env the f64 census is not meaningful; the
+    # transfer/callback/donation/structure halves must hold everywhere.
+    findings = [
+        f
+        for f in C.check_structural(sigs)
+        if not f.symbol.startswith("dtype.")
+    ]
+    assert findings == [], [f.symbol for f in findings]
+
+
+def test_diff_baseline_names_drift():
+    from pumiumtally_tpu.analysis import contracts as C
+
+    traced = C.build_traced(families=("trace",))
+    cap = {
+        "environment": C.environment(),
+        "families": {k: C.extract_signature(v) for k, v in traced.items()},
+    }
+    base = json.loads(json.dumps(cap))  # deep copy
+    assert C.diff_baseline(cap, base) == []
+
+    tampered = json.loads(json.dumps(base))
+    fam = tampered["families"]["trace"]
+    fam["prims"]["scatter-add"] = fam["prims"].get("scatter-add", 0) + 1
+    fam["donated_args"] = 0
+    fam["inputs"] = fam["inputs"][:-1]
+    syms = {f.symbol for f in C.diff_baseline(cap, tampered)}
+    assert "prims.scatter-add.trace" in syms
+    assert "signature.donated_args.trace" in syms
+    assert "signature.inputs.trace" in syms
+
+    other_env = json.loads(json.dumps(base))
+    other_env["environment"]["x64"] = not other_env["environment"]["x64"]
+    syms = {f.symbol for f in C.diff_baseline(cap, other_env)}
+    assert syms == {"environment.all"}
+
+
+def _tiny_problem():
+    from pumiumtally_tpu.analysis.contracts import _problem
+
+    return _problem(jnp.float32)
+
+
+def _tiny_statics():
+    from pumiumtally_tpu.analysis.contracts import _walk_statics
+
+    return _walk_statics()
+
+
+# --------------------------------------------------------------------- #
+# End to end: the committed baseline matches the committed programs
+# --------------------------------------------------------------------- #
+def test_lint_runner_exits_clean():
+    """scripts/lint.py (fresh process: canonical cpu/8-device/x64-off
+    environment) must exit 0 against the committed CONTRACTS.json and
+    LINT_BASELINE.json — zero non-baselined findings in the repo."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # the runner pins its own
+    proc = subprocess.run(
+        [sys.executable, str(ROOT / "scripts" / "lint.py")],
+        capture_output=True, text=True, env=env, cwd=str(ROOT),
+        timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr

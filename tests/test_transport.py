@@ -12,7 +12,7 @@ import os
 import numpy as np
 import pytest
 
-from pumiumtally_tpu import PumiTally, TallyConfig, build_box
+from pumiumtally_tpu import PumiTally, TallyConfig
 from pumiumtally_tpu.mesh.box import build_box_arrays
 from pumiumtally_tpu.mesh.core import TetMesh
 from pumiumtally_tpu.models.transport import Material, SyntheticTransport
