@@ -5,7 +5,7 @@ donated-buffer discipline, bitwise XLA↔Pallas parity, f32 dtype hygiene,
 and the lock protocols of the threaded observers — were until now pinned
 only by runtime tests that must *execute* a failure to see it.  This
 package makes them machine-checked properties of the code and of the
-lowered programs themselves, in two layers:
+lowered programs themselves, in three layers:
 
   * :mod:`analysis.astlint` — an AST lint engine with codebase-specific
     rules (PUMI001..PUMI007): host syncs inside traced bodies, transfers
@@ -13,6 +13,7 @@ lowered programs themselves, in two layers:
     nondeterminism, stray float64 on device paths, jit static-argnum
     hygiene, and a ``# guarded by: <lock>`` concurrency lint over the
     threaded surface (FlightRecorder / watchdog / HostStager / exporter).
+    The traced-body rules also cover ``scripts/`` and ``bench.py``.
   * :mod:`analysis.contracts` — abstract-traces the public program
     families (trace, trace_packed, megastep, the partitioned packed
     step, the Pallas kernel in interpret mode) to jaxpr + lowered
@@ -22,10 +23,23 @@ lowered programs themselves, in two layers:
     control flow, expected scatter counts — then diffs the extracted
     signatures against the committed ``CONTRACTS.json`` baseline so any
     structural drift fails CI with a named invariant.
+  * :mod:`analysis.costmodel` — COMPILES the same five families over a
+    small shape ladder (still CPU-only, no execution) and gates the
+    resource signatures XLA's cost/memory analysis exposes: f64 flop
+    census, donation/peak-memory bounds derived from the donated flux +
+    per-lane state, the Pallas VMEM-estimator contract mirror, and
+    fitted scaling exponents in n_particles / ntet (an accidental
+    O(n^2) broadcast becomes a named failure such as
+    ``cost.scaling.n_particles.megastep``) — then diffs against the
+    committed ``PERF_CONTRACTS.json`` within per-metric tolerance
+    bands.  Hardware-free perf regression gates for every program
+    family.
 
-``scripts/lint.py`` runs both layers with the ``LINT_BASELINE.json``
-suppression file (every suppression carries a justification string); the
-``static-analysis`` CI step fails on any non-baselined finding.
+``scripts/lint.py`` runs all three layers with the
+``LINT_BASELINE.json`` suppression file (every suppression carries a
+justification string, and a STALE entry is itself a failure unless
+``--allow-stale``); the ``static-analysis`` and ``perf-contracts`` CI
+steps fail on any non-baselined finding.
 """
 from __future__ import annotations
 

@@ -43,6 +43,16 @@ function a traced function calls (resolved through module-level defs and
 intra-package imports, including function-local imports) is traced, and
 nested defs inherit the enclosing function's tracedness.
 
+The fixpoint also covers ``scripts/*.py`` and ``bench.py`` (they jit
+package functions and their own bodies, and their absolute
+``pumiumtally_tpu.*`` imports resolve into the package index), but only
+the value-safety rule subset applies there — PUMI001 host syncs,
+PUMI003 use-after-donate (bench.py builds donating jits of its own),
+PUMI004 nondeterminism, PUMI005 f64 — because scripts legitimately
+stage their own device transfers (PUMI002's approved-module list is a
+*package* contract) and throwaway per-config jits in microbenches are
+the point of the file (PUMI006).
+
 Findings are suppressed per (rule, path, symbol) through
 ``LINT_BASELINE.json`` (analysis.apply_baseline) — justification
 required.
@@ -80,6 +90,14 @@ APPROVED_TRANSFER_MODULES = frozenset(
 # The one module allowed to hold float64 on purpose: the shadow-audit
 # reference walker is DEFINED as an f64 NumPy oracle.
 F64_EXEMPT_MODULES = frozenset({f"{PACKAGE}/integrity/audit.py"})
+
+# Rule subset applied to sources OUTSIDE the package tree (scripts/,
+# bench.py): the traced-body contracts travel with the jitted code
+# wherever it is launched from, and use-after-donate corrupts data no
+# matter who built the donating jit (bench.py does); the
+# transfer-placement and jit-hygiene rules are package-structure
+# contracts and stay package-scoped.
+SCRIPT_RULES = frozenset({"PUMI001", "PUMI003", "PUMI004", "PUMI005"})
 
 # Call heads whose function-valued arguments become traced.
 _TRACING_HEADS_LAST = frozenset(
@@ -1209,22 +1227,38 @@ _RULES = (
 
 
 def lint_sources(sources: dict[str, str]) -> list[Finding]:
-    """Lint a {relpath: source} mapping (the test fixtures' entry)."""
+    """Lint a {relpath: source} mapping (the test fixtures' entry).
+
+    Paths outside the package tree (scripts, bench) participate fully
+    in the index and the traced fixpoint, but only their
+    ``SCRIPT_RULES`` findings are reported."""
     modules = {p: _parse(p, s) for p, s in sources.items()}
     index = PackageIndex(modules)
     out: list[Finding] = []
     for rule in _RULES:
         rule(index, out)
+    out = [
+        f
+        for f in out
+        if f.path.startswith(f"{PACKAGE}/") or f.rule in SCRIPT_RULES
+    ]
     out.sort(key=lambda f: (f.path, f.line, f.rule))
     return out
 
 
 def lint_package(root) -> list[Finding]:
-    """Lint every module of the installed package tree under ``root``
-    (the repo checkout: ``root/pumiumtally_tpu/**/*.py``)."""
+    """Lint every module of the package tree under ``root`` (the repo
+    checkout: ``root/pumiumtally_tpu/**/*.py``) plus the launch surface
+    — ``root/scripts/*.py`` and ``root/bench.py`` — under the
+    ``SCRIPT_RULES`` subset."""
     root = Path(root)
     sources = {}
     for p in sorted((root / PACKAGE).rglob("*.py")):
         rel = p.relative_to(root).as_posix()
         sources[rel] = p.read_text()
+    for p in sorted((root / "scripts").glob("*.py")):
+        sources[p.relative_to(root).as_posix()] = p.read_text()
+    bench = root / "bench.py"
+    if bench.exists():
+        sources["bench.py"] = bench.read_text()
     return lint_sources(sources)

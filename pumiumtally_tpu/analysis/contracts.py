@@ -44,8 +44,12 @@ CONTRACTS_FILE = "CONTRACTS.json"
 
 # Problem size: small enough to abstract-trace in milliseconds, big
 # enough to exercise every structural feature (two materials, two
-# groups, walk-loop + compaction-free path, 8-way partition).
+# groups, walk-loop + compaction-free path, 8-way partition).  The
+# cost-model layer (analysis/costmodel.py) re-traces the same problem
+# at a ladder of (n, cells) rungs; the defaults here are its base rung
+# and the shape CONTRACTS.json is pinned at.
 _N = 16
+_CELLS = 2  # box subdivisions per axis -> ntet = 6 * cells**3
 _G = 2
 _MAX_CROSSINGS = 64
 _N_PARTS = 8
@@ -150,25 +154,25 @@ def extract_signature(traced) -> dict:
 # --------------------------------------------------------------------- #
 # The five program families at a canonical tiny problem
 # --------------------------------------------------------------------- #
-def _problem(dtype):
+def _problem(dtype, n=_N, cells=_CELLS):
     import jax.numpy as jnp
 
     from ..mesh.box import build_box_arrays
     from ..mesh.core import TetMesh
 
-    coords, t2v = build_box_arrays(1.0, 1.0, 1.0, 2, 2, 2)
+    coords, t2v = build_box_arrays(1.0, 1.0, 1.0, cells, cells, cells)
     centroids = coords[t2v].mean(axis=1)
     class_id = np.where(centroids[:, 0] < 0.5, 1, 2).astype(np.int32)
     mesh = TetMesh.from_numpy(coords, t2v, class_id=class_id, dtype=dtype)
     rng = np.random.default_rng(7)
     arrs = dict(
-        origin=jnp.asarray(rng.uniform(0.2, 0.8, (_N, 3)), dtype),
-        dest=jnp.asarray(rng.uniform(0.2, 0.8, (_N, 3)), dtype),
-        elem=jnp.zeros(_N, jnp.int32),
-        in_flight=jnp.ones(_N, bool),
-        weight=jnp.ones(_N, dtype),
-        group=jnp.zeros(_N, jnp.int32),
-        material_id=jnp.full(_N, -1, jnp.int32),
+        origin=jnp.asarray(rng.uniform(0.2, 0.8, (n, 3)), dtype),
+        dest=jnp.asarray(rng.uniform(0.2, 0.8, (n, 3)), dtype),
+        elem=jnp.zeros(n, jnp.int32),
+        in_flight=jnp.ones(n, bool),
+        weight=jnp.ones(n, dtype),
+        group=jnp.zeros(n, jnp.int32),
+        material_id=jnp.full(n, -1, jnp.int32),
         flux=jnp.zeros((mesh.tet2tet.shape[0], _G, 2), dtype),
     )
     return mesh, arrs
@@ -186,11 +190,13 @@ def _walk_statics():
     )
 
 
-def build_traced(families=None, dtype=None) -> dict:
+def build_traced(families=None, dtype=None, n=_N, cells=_CELLS) -> dict:
     """Abstract-trace the requested program families (all by default).
 
     Returns {family: jax._src.stages.Traced}.  Pure tracing + lowering:
     no backend compile, no execution — safe and fast (<1 s) anywhere.
+    ``n`` / ``cells`` size the problem (the cost-model layer sweeps a
+    shape ladder through them; the defaults are the contracts rung).
     """
     import jax
     import jax.numpy as jnp
@@ -198,7 +204,7 @@ def build_traced(families=None, dtype=None) -> dict:
     from ..ops import staging, walk
 
     dtype = dtype or jnp.float32
-    mesh, a = _problem(dtype)
+    mesh, a = _problem(dtype, n=n, cells=cells)
     want = set(families or ("trace", "trace_packed", "megastep",
                             "partitioned", "pallas"))
     out = {}
@@ -212,8 +218,8 @@ def build_traced(families=None, dtype=None) -> dict:
     if "trace_packed" in want:
         stager = staging.HostStager()
         rec = staging.pack_move_record(
-            stager, np.asarray(a["dest"]), np.ones(_N),
-            np.zeros(_N, np.int64), np.ones(_N, bool), dtype,
+            stager, np.asarray(a["dest"]), np.ones(n),
+            np.zeros(n, np.int64), np.ones(n, bool), dtype,
         )
         out["trace_packed"] = walk._trace_packed_jit.trace(
             mesh, a["origin"], a["elem"], a["material_id"],
@@ -226,7 +232,7 @@ def build_traced(families=None, dtype=None) -> dict:
         out["megastep"] = walk._megastep_jit.trace(
             mesh, a["origin"], a["elem"], a["material_id"], a["weight"],
             a["group"], a["in_flight"],
-            jnp.arange(_N, dtype=jnp.int32), a["flux"],
+            jnp.arange(n, dtype=jnp.int32), a["flux"],
             jnp.int32(0), jax.random.PRNGKey(13),
             jnp.asarray([4.0, 9.0], dtype), jnp.asarray([0.3, 0.5], dtype),
             n_moves=4, survival_weight=0.2, downscatter=0.1,
@@ -254,7 +260,10 @@ def build_traced(families=None, dtype=None) -> dict:
             tally_scatter="pair",
         )
         sh = NamedSharding(dmesh, P("p"))
-        cap = 8
+        # Per-part staging capacity scales with the lane count so the
+        # cost ladder sees a growing record; at the default n it is
+        # exactly the historical 8 (CONTRACTS.json stays pinned).
+        cap = partitioned_cap(n)
         carrier = staging.np_carrier(np.dtype(dtype))
         rec = jax.device_put(
             jnp.zeros((_N_PARTS * cap, staging.PART_IN_COLS),
@@ -277,8 +286,22 @@ def build_traced(families=None, dtype=None) -> dict:
     return out
 
 
-def capture(families=None) -> dict:
-    traced = build_traced(families)
+def partitioned_cap(n: int) -> int:
+    """Per-part staging-record capacity for an ``n``-lane partitioned
+    trace; floor 8 keeps the default rung identical to the historical
+    capture."""
+    return max(8, (2 * n) // _N_PARTS)
+
+
+def capture(families=None, traced=None) -> dict:
+    """Extract the structural signatures.
+
+    ``traced`` reuses an existing :func:`build_traced` result (the lint
+    runner shares one base-rung trace between the contracts and
+    cost-model layers instead of re-tracing the five programs).
+    """
+    if traced is None:
+        traced = build_traced(families)
     return {
         "environment": environment(),
         "families": {

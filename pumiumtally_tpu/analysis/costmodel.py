@@ -1,0 +1,589 @@
+"""Compile-time performance contracts (layer 3).
+
+No TPU headline number has been captured since round 4, so a perf
+regression in the walk / megastep / Pallas programs is invisible until
+a rare hardware window — unless it is visible in the *compiled program
+itself*.  XLA's ``lower().compile().cost_analysis()`` and
+``memory_analysis()`` expose flops, transcendentals, bytes accessed and
+the argument/output/temp/alias memory split on the CPU backend, for the
+exact programs the facades dispatch.  This layer compiles the five
+program families (trace, trace_packed, megastep, the packed partitioned
+step, the Pallas kernel in interpret mode) on the pinned
+cpu/8-device/x64-off lint environment at a small ladder of shapes and
+gates three kinds of contract:
+
+Baseline-free invariants (``check_cost``) — hold with no committed
+capture at all:
+
+  cost.f64.<family>       zero f64-typed ops in the optimized HLO of an
+                          f32-config program (under an x64-capable
+                          runtime an audit-path f64 leak compiles real
+                          f64 flops into the hot loop; under the pinned
+                          x64-off env this doubles as a pin that the
+                          lint environment itself stayed f32).
+  cost.donation.<family>  the aliased (donated) byte count covers the
+                          flux accumulator — a dropped donation shows
+                          up here as alias_bytes collapsing below the
+                          analytic flux size, i.e. a peak-memory jump
+                          of exactly one accumulator.
+  cost.peak.<family>      temp (and hence peak = args + outputs + temp
+                          - alias) memory stays inside an analytic
+                          allowance derived from the donated flux, the
+                          per-lane state and the mesh tables — a lost
+                          fusion that materializes a big intermediate
+                          breaks it.
+  cost.vmem.pallas        ``walk_pallas.kernel_vmem_bytes`` (the
+                          auto-fallback budget estimator) stays within
+                          tolerance of this module's own analytic tile
+                          footprint — the two are a deliberately
+                          duplicated contract mirror, so an estimator
+                          edit that forgets a term is named here.
+  cost.scaling.<axis>.<family>
+                          fitted log-log scaling exponents of flops /
+                          bytes / temp across the shape ladder stay
+                          sublinear-or-linear in ``n_particles`` and
+                          ``ntet`` — an accidental O(n^2) broadcast or
+                          a lost fusion becomes a named CI failure
+                          (clean programs measure <= 1.0; the gate is
+                          ``SCALING_MAX``).
+
+Committed-baseline drift (``diff_cost``) — the full resource signature
+(metrics, per-segment normalized costs, exponents) is diffed against
+``PERF_CONTRACTS.json`` with per-metric tolerance bands (``DRIFT_TOL``:
+flop counts are near-exact, temp memory is allowed scheduler slack).
+Intentional changes regenerate the capture with ``python
+scripts/lint.py --write-perf-contracts`` (and say why in the PR);
+``scripts/perfdiff.py`` pretty-prints the old->new delta for the PR
+description.
+
+Like CONTRACTS.json the capture is environment-pinned (backend, device
+count, x64) and ``diff_cost`` refuses cross-environment compares.
+Everything here runs on CPU in seconds — every future perf PR gets a
+hardware-free regression gate.
+"""
+from __future__ import annotations
+
+import json
+import re
+
+from . import Finding
+from . import contracts as C
+
+PERF_CONTRACTS_FILE = "PERF_CONTRACTS.json"
+
+FAMILIES = ("megastep", "pallas", "partitioned", "trace", "trace_packed")
+
+# The shape ladder: n_particles at fixed mesh, mesh cells at fixed
+# n_particles.  First rung of each axis is the contracts base shape
+# (_N, _CELLS) — shared, so the lint runner compiles it once.
+LADDER_N = (16, 64, 256)
+LADDER_CELLS = (2, 3, 4)  # box(c) -> ntet = 6 * c**3
+
+# Superlinear-growth gate on fitted exponents.  Clean programs measure
+# <= 1.0 on every axis (the walk is linear in lanes; flux/table traffic
+# is linear in ntet); 1.35 leaves fit noise while an accidental
+# quadratic broadcast fits ~2.0.
+SCALING_MAX = {"n_particles": 1.35, "ntet": 1.35}
+SCALING_METRICS = ("flops", "bytes_accessed", "temp_bytes")
+# Absolute drift band on committed exponents.
+SCALING_TOL = 0.10
+
+# Per-metric relative tolerance bands for diff against the committed
+# capture.  Flop/op counts are properties of the optimized HLO and are
+# near-exact across runs; byte counts and especially temp memory absorb
+# scheduler/layout slack across jaxlib point releases.
+DRIFT_TOL = {
+    "flops": 0.02,
+    "transcendentals": 0.02,
+    "bytes_accessed": 0.05,
+    "arg_bytes": 0.0,
+    "out_bytes": 0.0,
+    "alias_bytes": 0.0,
+    "temp_bytes": 0.25,
+    "peak_bytes": 0.10,
+    "f64_ops": 0.0,
+}
+
+# kernel_vmem_bytes vs. the analytic tile footprint mirror.
+VMEM_TOL = 0.20
+
+# Fixed slack of the temp-memory allowance: XLA's own small scratch
+# (sort buffers, reduction scratch) independent of problem size.
+TEMP_SLACK_BYTES = 64 * 1024
+
+# -- contract mirror of the Pallas kernel's VMEM layout ---------------- #
+# Deliberately DUPLICATED from ops/walk_pallas.py (TABLE_COLS /
+# DEFAULT_LANE_BLOCK / kernel_vmem_bytes): the estimator gates the
+# auto-fallback policy, this mirror gates the estimator.  If the kernel
+# layout changes, both must change in the same PR — that is the point.
+_MIRROR_TABLE_COLS = 28
+_MIRROR_LANE_BLOCK = 128
+
+
+def pallas_footprint_bytes(ntet, n_particles, n_groups, itemsize) -> int:
+    """Analytic VMEM working set of one kernel launch: decoded walk
+    table + flux tiles (operand, accumulator, output) + per-lane state
+    + per-block one-hot / peel temporaries."""
+    b = min(_MIRROR_LANE_BLOCK, max(n_particles, 1))
+    table = ntet * _MIRROR_TABLE_COLS * itemsize
+    flux = 3 * ntet * n_groups * 2 * itemsize
+    lanes = n_particles * (10 * itemsize + 9 * 4)
+    blocks = b * ntet * itemsize + b * b + b * 2 * n_groups * itemsize
+    return table + flux + lanes + blocks
+
+
+# --------------------------------------------------------------------- #
+# Metric extraction from one compiled program
+# --------------------------------------------------------------------- #
+def compile_metrics(traced) -> dict:
+    """Compile one ``jax.jit(...).trace(...)`` result on the current
+    backend and extract its resource signature.  Unlike the contracts
+    layer this DOES invoke the backend compiler (still CPU-only, still
+    no execution) — that is where flop counts and the memory plan live.
+
+    The persistent compilation cache is bypassed for the compile: an
+    executable DESERIALIZED from the cache reports an empty aliasing
+    plan (``alias_size_in_bytes == 0``) and slightly different temp
+    sizes, which would fake a dropped donation on warm runs and make
+    the capture depend on cache state.  Unsetting the dir alone is not
+    enough — the cache module keeps serving once initialized — so the
+    cache is also reset; restoring the dir afterwards lets the host
+    process re-initialize it lazily (the on-disk entries survive).
+    """
+    import jax
+    from jax.experimental.compilation_cache import (
+        compilation_cache as _cc,
+    )
+
+    prev = jax.config.jax_compilation_cache_dir
+    jax.config.update("jax_compilation_cache_dir", None)
+    _cc.reset_cache()
+    try:
+        compiled = traced.lower().compile()
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev)
+    ca = compiled.cost_analysis()
+    props = ca[0] if isinstance(ca, (list, tuple)) else (ca or {})
+    mem = compiled.memory_analysis()
+    arg = int(getattr(mem, "argument_size_in_bytes", 0))
+    out = int(getattr(mem, "output_size_in_bytes", 0))
+    temp = int(getattr(mem, "temp_size_in_bytes", 0))
+    alias = int(getattr(mem, "alias_size_in_bytes", 0))
+    # Optimized-HLO f64 census: every f64-typed value in the compiled
+    # module (the per-dtype flop split XLA does not expose; any f64 op
+    # in an f32-config program is a contract break regardless).
+    f64_ops = len(re.findall(r"f64\[", compiled.as_text()))
+    return {
+        "flops": int(props.get("flops", 0)),
+        "transcendentals": int(props.get("transcendentals", 0)),
+        "bytes_accessed": int(props.get("bytes accessed", 0)),
+        "arg_bytes": arg,
+        "out_bytes": out,
+        "temp_bytes": temp,
+        "alias_bytes": alias,
+        "peak_bytes": arg + out + temp - alias,
+        "f64_ops": f64_ops,
+    }
+
+
+def family_analytic(
+    family,
+    *,
+    n,
+    cells,
+    n_groups=C._G,
+    itemsize=4,
+    max_local=None,
+) -> dict:
+    """Analytic resource model of one family at one rung — the
+    baseline-free side of every memory check.  All quantities are
+    per-device (the partitioned step's memory_analysis reports
+    per-shard sizes)."""
+    ntet = 6 * cells**3
+    n_moves = 4 if family == "megastep" else 1
+    if family == "partitioned":
+        if max_local is None:
+            raise ValueError(
+                "partitioned analytic needs max_local (owned + halo "
+                "tets per part, from partition_mesh)"
+            )
+        flux = max_local * n_groups * 2 * itemsize
+        # Per-part staging record + migration scratch, with margin.
+        lanes = C.partitioned_cap(n) * 128
+        table = max_local * _MIRROR_TABLE_COLS * itemsize
+        blocks = 0
+    else:
+        flux = ntet * n_groups * 2 * itemsize
+        # Positions/dest (6 floats), weight, travel + int lane state,
+        # with margin (the megastep adds RNG counters per lane).
+        lanes = n * 80
+        table = ntet * _MIRROR_TABLE_COLS * itemsize
+        blocks = 0
+        if family == "pallas":
+            b = min(_MIRROR_LANE_BLOCK, max(n, 1))
+            blocks = (
+                b * ntet * itemsize + b * b
+                + b * 2 * n_groups * itemsize
+            )
+    return {
+        "family": family,
+        "n": n,
+        "cells": cells,
+        "ntet": ntet,
+        "n_groups": n_groups,
+        "itemsize": itemsize,
+        "n_moves": n_moves,
+        "flux_bytes": flux,
+        "lane_bytes": lanes,
+        "table_bytes": table,
+        "block_bytes": blocks,
+    }
+
+
+def temp_allowance_bytes(analytic: dict) -> int:
+    """Analytic ceiling on a program's temp memory: a few copies of the
+    flux accumulator and the lane state (double buffering, packing), the
+    mesh tables once or twice, the Pallas block temporaries, plus fixed
+    scratch slack.  At the tiny base rung the fixed slack dominates, so
+    the peak gate is ALSO applied at the top n_particles rung, where the
+    analytic terms dominate and a materialized O(n*ntet) or O(n^2)
+    intermediate — or a duplicated flux accumulator — overflows the
+    allowance instead of hiding under the slack."""
+    return (
+        TEMP_SLACK_BYTES
+        + 4 * (analytic["flux_bytes"] + analytic["lane_bytes"])
+        + 2 * analytic["table_bytes"]
+        + 4 * analytic["block_bytes"]
+    )
+
+
+def rung_signature(metrics: dict, analytic: dict) -> dict:
+    """metrics + per-segment normalized costs + the analytic context
+    they are checked against, for one (family, rung) compile.
+
+    "Segment" here is one modeled lane-move: HLO cost analysis counts
+    the walk while-body once (trip counts are dynamic), so the honest
+    normalization unit is lanes x fused moves, not physical segments.
+    """
+    seg = max(analytic["n"] * analytic["n_moves"], 1)
+    return {
+        "metrics": metrics,
+        "normalized": {
+            "flops_per_segment": round(metrics["flops"] / seg, 2),
+            "bytes_per_segment": round(
+                metrics["bytes_accessed"] / seg, 2
+            ),
+        },
+        "analytic": analytic,
+    }
+
+
+# --------------------------------------------------------------------- #
+# Scaling fits
+# --------------------------------------------------------------------- #
+def fit_exponent(sizes, values) -> float:
+    """Least-squares slope of log(value) vs log(size) — the asymptotic
+    exponent of the metric in the ladder variable."""
+    import math
+
+    if len(sizes) != len(values) or len(sizes) < 2:
+        raise ValueError("need >= 2 ladder rungs to fit an exponent")
+    if min(values) <= 0 or min(sizes) <= 0:
+        raise ValueError("exponent fit needs positive sizes and values")
+    ls = [math.log(s) for s in sizes]
+    lv = [math.log(v) for v in values]
+    k = len(ls)
+    sx, sy = sum(ls), sum(lv)
+    sxx = sum(a * a for a in ls)
+    sxy = sum(a * b for a, b in zip(ls, lv))
+    return (k * sxy - sx * sy) / (k * sxx - sx * sx)
+
+
+# --------------------------------------------------------------------- #
+# Capture
+# --------------------------------------------------------------------- #
+def _base_max_local(dtype=None):
+    import jax.numpy as jnp
+
+    from ..parallel.mesh_partition import partition_mesh
+
+    mesh, _ = C._problem(dtype or jnp.float32)
+    return partition_mesh(mesh, C._N_PARTS).max_local
+
+
+def capture(families=None, base_traced=None) -> dict:
+    """Compile the requested families over the shape ladder and build
+    the full resource capture.
+
+    ``base_traced`` reuses the contracts layer's :func:`C.build_traced`
+    result for the shared base rung (same (n, cells) — the lint runner
+    traces the five programs once for both layers); the ladder's other
+    rungs are traced and compiled here.
+    """
+    # The first rung of each axis IS the contracts base shape — the
+    # shared-trace reuse and the fitted exponents' size vector both
+    # assume it, so an edit to either side must fail loudly here.
+    assert LADDER_N[0] == C._N and LADDER_CELLS[0] == C._CELLS, (
+        "ladder rung 0 must equal the contracts base shape "
+        f"({C._N}, {C._CELLS})"
+    )
+    fams = tuple(families or FAMILIES)
+    max_local = _base_max_local() if "partitioned" in fams else None
+
+    # One compile_metrics sweep per rung; the base rung is rung 0 of
+    # BOTH axes, so the ladder costs 1 + 2 + 2 compiled rungs total.
+    def rung_metrics(n, cells, traced=None):
+        traced = traced or C.build_traced(fams, n=n, cells=cells)
+        return {f: compile_metrics(traced[f]) for f in fams}
+
+    base_n, base_cells = C._N, C._CELLS
+    base_metrics = rung_metrics(base_n, base_cells, traced=base_traced)
+    n_axis = [base_metrics]
+    for n in LADDER_N[1:]:
+        n_axis.append(rung_metrics(n, base_cells))
+    t_axis = [base_metrics]
+    for cells in LADDER_CELLS[1:]:
+        t_axis.append(rung_metrics(base_n, cells))
+
+    out_families = {}
+    for fam in fams:
+        scaling = {}
+        for axis, sizes, rungs in (
+            ("n_particles", LADDER_N, n_axis),
+            ("ntet", [6 * c**3 for c in LADDER_CELLS], t_axis),
+        ):
+            exps = {}
+            for metric in SCALING_METRICS:
+                vals = [r[fam][metric] for r in rungs]
+                if min(vals) > 0:
+                    exps[metric] = round(
+                        fit_exponent(list(sizes), vals), 3
+                    )
+            scaling[axis] = exps
+        out_families[fam] = {
+            "base": rung_signature(
+                base_metrics[fam],
+                family_analytic(fam, n=base_n, cells=base_cells,
+                                max_local=max_local),
+            ),
+            # The top n_particles rung carries its own memory checks:
+            # there the analytic flux/lane terms dominate the fixed
+            # slack, so a materialized quadratic intermediate cannot
+            # hide under it (see temp_allowance_bytes).
+            "top": rung_signature(
+                n_axis[-1][fam],
+                family_analytic(fam, n=LADDER_N[-1], cells=base_cells,
+                                max_local=max_local),
+            ),
+            "scaling": scaling,
+        }
+    return {
+        "environment": C.environment(),
+        "ladder": {
+            "n_particles": list(LADDER_N),
+            "ntet": [6 * c**3 for c in LADDER_CELLS],
+        },
+        "families": out_families,
+    }
+
+
+# --------------------------------------------------------------------- #
+# Invariants
+# --------------------------------------------------------------------- #
+def _finding(symbol: str, message: str) -> Finding:
+    return Finding(
+        rule="COST",
+        path=PERF_CONTRACTS_FILE,
+        line=0,
+        symbol=symbol,
+        message=message,
+    )
+
+
+def check_cost(cap: dict) -> list[Finding]:
+    """Baseline-free resource invariants — fire with no committed
+    capture at all (see the module docstring for the catalogue).
+
+    The per-rung checks (f64 census, donation alias, temp/peak
+    allowance) run on every captured rung — the base rung and, when
+    present, the top n_particles rung, where the analytic memory terms
+    dominate the fixed slack.  A finding symbol is emitted once per
+    family even when both rungs trip."""
+    out: list[Finding] = []
+    seen: set[str] = set()
+
+    def emit(symbol, message):
+        if symbol not in seen:
+            seen.add(symbol)
+            out.append(_finding(symbol, message))
+
+    for fam, entry in sorted(cap["families"].items()):
+        for rung in ("base", "top"):
+            if rung not in entry:
+                continue
+            m = entry[rung]["metrics"]
+            a = entry[rung]["analytic"]
+            if m["f64_ops"]:
+                emit(
+                    f"cost.f64.{fam}",
+                    f"{m['f64_ops']} f64-typed op(s) in the optimized "
+                    f"HLO of an f32-config program ({rung} rung) — f64 "
+                    "flops on the hot path (integrity/audit.py is the "
+                    "sanctioned f64 surface, and it runs on host)",
+                )
+            if m["alias_bytes"] < a["flux_bytes"]:
+                emit(
+                    f"cost.donation.{fam}",
+                    f"aliased (donated) bytes {m['alias_bytes']} < "
+                    f"analytic flux accumulator {a['flux_bytes']} "
+                    f"({rung} rung) — the donation was dropped, peak "
+                    "memory grows by one accumulator and the re-arm "
+                    "contract breaks",
+                )
+            allow = temp_allowance_bytes(a)
+            if m["temp_bytes"] > allow:
+                emit(
+                    f"cost.peak.{fam}",
+                    f"temp memory {m['temp_bytes']} B exceeds the "
+                    f"analytic allowance {allow} B at the {rung} rung "
+                    "(flux + lane state + tables + slack) — peak "
+                    "memory left the donated-flux + per-lane envelope; "
+                    "a fused intermediate probably materialized",
+                )
+        a = entry["base"]["analytic"]
+        if fam == "pallas":
+            from ..ops.walk_pallas import kernel_vmem_bytes
+
+            est = kernel_vmem_bytes(
+                a["ntet"], a["n"], a["n_groups"], a["itemsize"]
+            )
+            ref = pallas_footprint_bytes(
+                a["ntet"], a["n"], a["n_groups"], a["itemsize"]
+            )
+            if abs(est - ref) > VMEM_TOL * ref:
+                out.append(_finding(
+                    "cost.vmem.pallas",
+                    f"kernel_vmem_bytes estimates {est} B but the "
+                    f"analytic tile footprint is {ref} B (>"
+                    f"{VMEM_TOL:.0%} apart) — the auto-fallback budget "
+                    "estimator drifted from the kernel's real VMEM "
+                    "layout",
+                ))
+        for axis, exps in sorted(entry.get("scaling", {}).items()):
+            gate = SCALING_MAX[axis]
+            bad = {k: v for k, v in sorted(exps.items()) if v > gate}
+            if bad:
+                desc = ", ".join(
+                    f"{k}~O(size^{v})" for k, v in bad.items()
+                )
+                out.append(_finding(
+                    f"cost.scaling.{axis}.{fam}",
+                    f"superlinear growth in {axis}: {desc} exceeds the "
+                    f"{gate} gate — an accidental quadratic broadcast "
+                    "or a lost fusion scales with the ladder",
+                ))
+    return out
+
+
+def _within(old, new, tol) -> bool:
+    if old == new:
+        return True
+    return abs(new - old) <= tol * max(abs(old), abs(new), 1)
+
+
+def diff_cost(current: dict, baseline: dict) -> list[Finding]:
+    """Diff a fresh capture against the committed PERF_CONTRACTS.json
+    within the per-metric tolerance bands.  Intentional changes
+    regenerate with ``scripts/lint.py --write-perf-contracts``."""
+    out: list[Finding] = []
+    if current["environment"] != baseline.get("environment"):
+        out.append(_finding(
+            "cost.environment.all",
+            f"capture environment {current['environment']} != baseline "
+            f"{baseline.get('environment')} — resource signatures are "
+            "environment-pinned (scripts/lint.py sets the canonical "
+            "one)",
+        ))
+        return out
+    if current["ladder"] != baseline.get("ladder"):
+        out.append(_finding(
+            "cost.ladder.all",
+            f"shape ladder changed: baseline "
+            f"{baseline.get('ladder')} -> current {current['ladder']} "
+            "— regenerate PERF_CONTRACTS.json",
+        ))
+        return out
+    cur_f, base_f = current["families"], baseline.get("families", {})
+    for fam in sorted(set(cur_f) | set(base_f)):
+        if fam not in base_f:
+            out.append(_finding(
+                f"cost.family.added.{fam}",
+                "family captured but absent from PERF_CONTRACTS.json "
+                "— regenerate the baseline",
+            ))
+            continue
+        if fam not in cur_f:
+            out.append(_finding(
+                f"cost.family.removed.{fam}",
+                "family in PERF_CONTRACTS.json but no longer captured",
+            ))
+            continue
+        cur_rungs = {r for r in ("base", "top") if r in cur_f[fam]}
+        base_rungs = {r for r in ("base", "top") if r in base_f[fam]}
+        if cur_rungs != base_rungs:
+            out.append(_finding(
+                f"cost.drift.rungs.{fam}",
+                f"captured rungs {sorted(cur_rungs)} != baseline "
+                f"{sorted(base_rungs)} — regenerate "
+                "PERF_CONTRACTS.json",
+            ))
+        for rung in sorted(cur_rungs & base_rungs):
+            cm = cur_f[fam][rung]["metrics"]
+            bm = base_f[fam][rung]["metrics"]
+            # The base rung keeps the short historical symbol; the top
+            # rung is tagged so one drifted metric at both sizes reads
+            # as two distinct findings.
+            tag = "" if rung == "base" else f"{rung}."
+            for metric, tol in sorted(DRIFT_TOL.items()):
+                if not _within(
+                    bm.get(metric, 0), cm.get(metric, 0), tol
+                ):
+                    pct = (
+                        100.0
+                        * (cm.get(metric, 0) - bm.get(metric, 0))
+                        / max(abs(bm.get(metric, 0)), 1)
+                    )
+                    out.append(_finding(
+                        f"cost.drift.{tag}{metric}.{fam}",
+                        f"{metric} drifted {bm.get(metric, 0)} -> "
+                        f"{cm.get(metric, 0)} ({pct:+.1f}%) at the "
+                        f"{rung} rung, outside the ±{tol:.0%} band",
+                    ))
+        cs = cur_f[fam].get("scaling", {})
+        bs = base_f[fam].get("scaling", {})
+        for axis in sorted(set(cs) | set(bs)):
+            ce, be = cs.get(axis, {}), bs.get(axis, {})
+            for metric in sorted(set(ce) | set(be)):
+                if abs(ce.get(metric, 0.0) - be.get(metric, 0.0)) > (
+                    SCALING_TOL
+                ):
+                    out.append(_finding(
+                        f"cost.drift.scaling.{axis}.{metric}.{fam}",
+                        f"{axis} exponent of {metric} drifted "
+                        f"{be.get(metric)} -> {ce.get(metric)} "
+                        f"(>±{SCALING_TOL} band)",
+                    ))
+    return out
+
+
+def load_perf_contracts(path) -> dict:
+    with open(path) as fh:
+        return json.load(fh)
+
+
+def write_perf_contracts(path, cap: dict | None = None, **kw) -> dict:
+    cap = cap or capture(**kw)
+    with open(path, "w") as fh:
+        json.dump(cap, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    return cap

@@ -1,25 +1,43 @@
 #!/usr/bin/env python
-"""graft-check: run both static-analysis layers (+ ruff when present).
+"""graft-check: run the static-analysis layers (+ ruff when present).
 
-  python scripts/lint.py                 # astlint + contracts + ruff
+  python scripts/lint.py                 # astlint + contracts + cost + ruff
   python scripts/lint.py --ast-only
   python scripts/lint.py --contracts-only
+  python scripts/lint.py --perf-only         # cost layer alone
+  python scripts/lint.py --no-perf           # everything BUT the cost
+                                             # layer (CI pairs this
+                                             # with a --perf-only step)
   python scripts/lint.py --write-contracts   # regenerate CONTRACTS.json
+  python scripts/lint.py --write-perf-contracts  # regenerate
+                                             # PERF_CONTRACTS.json
                                              # (intentional drift only)
+  python scripts/lint.py --allow-stale       # mid-refactor: stale
+                                             # baseline entries warn
+                                             # instead of failing
 
 Layer 1 (pumiumtally_tpu/analysis/astlint.py) lints the package source
+— plus scripts/ and bench.py under the traced-body rule subset —
 against the codebase-specific rules PUMI001..PUMI007.  Layer 2
 (analysis/contracts.py) abstract-traces the five public program
 families and checks the structural invariants plus drift against the
-committed CONTRACTS.json.  Findings are suppressed per (rule, path,
+committed CONTRACTS.json.  Layer 3 (analysis/costmodel.py) compiles the
+same five families over a shape ladder and checks the resource
+invariants — f64 flop census, donation/peak memory bounds, the Pallas
+VMEM-estimator mirror, scaling exponents — plus drift against
+PERF_CONTRACTS.json within per-metric tolerance bands.  The base-rung
+trace is built ONCE and shared between layers 2 and 3 (the whole run
+stays well under 90 s).  Findings are suppressed per (rule, path,
 symbol) through LINT_BASELINE.json; every suppression carries a
-justification.  Exit 0 = no non-baselined findings; 1 = findings;
-2 = environment/usage error.
+justification, and a STALE entry (its finding no longer exists) is
+itself a failure unless --allow-stale.  Exit 0 = no non-baselined
+findings and no stale entries; 1 = findings; 2 = environment/usage
+error.
 
-The contract capture is environment-sensitive, so this runner pins the
-canonical lint environment BEFORE importing jax: CPU backend, 8 virtual
-devices (the partitioned family's mesh), x64 off (the f32 production
-dtype whose purity the contracts assert).
+The contract captures are environment-sensitive, so this runner pins
+the canonical lint environment BEFORE importing jax: CPU backend, 8
+virtual devices (the partitioned family's mesh), x64 off (the f32
+production dtype whose purity the contracts assert).
 """
 import argparse
 import json
@@ -31,6 +49,10 @@ import sys
 # Pin the canonical contract environment before jax can be imported.
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.pop("JAX_ENABLE_X64", None)
+# A persistent compile cache would hand layer 3 DESERIALIZED
+# executables whose memory_analysis drops the aliasing plan — the
+# cost capture must always measure fresh compiles.
+os.environ.pop("JAX_COMPILATION_CACHE_DIR", None)
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -41,36 +63,45 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
 
 
-def run_ast(baseline_entries, verbose):
+def _layer_entries(baseline_entries, layer):
+    """Route baseline suppressions to their layer by rule family, so a
+    CONTRACT/COST entry never shows up as stale to the AST layer (and
+    vice versa)."""
+    prefix = {"astlint": "PUMI", "contracts": "CONTRACT",
+              "costmodel": "COST"}[layer]
+    return [e for e in baseline_entries
+            if e["rule"].startswith(prefix)]
+
+
+def run_ast(args, baseline_entries, verbose):
     from pumiumtally_tpu.analysis import apply_baseline
     from pumiumtally_tpu.analysis.astlint import lint_package
 
     findings = lint_package(ROOT)
     kept, suppressed, unused = apply_baseline(
-        findings, [e for e in baseline_entries
-                   if not e["rule"].startswith("CONTRACT")]
+        findings, _layer_entries(baseline_entries, "astlint")
     )
-    return report("astlint", kept, suppressed, unused, verbose)
+    return report("astlint", kept, suppressed, unused, verbose,
+                  args.allow_stale)
 
 
-def run_contracts(args, baseline_entries, verbose):
+def run_contracts(args, baseline_entries, verbose, traced=None):
     from pumiumtally_tpu.analysis import apply_baseline
     from pumiumtally_tpu.analysis import contracts as C
 
+    entries = _layer_entries(baseline_entries, "contracts")
     contracts_path = os.path.join(ROOT, args.contracts)
     if args.write_contracts:
-        cap = C.write_contracts(contracts_path)
+        cap = C.write_contracts(contracts_path, C.capture(traced=traced))
         print(
             f"wrote {args.contracts} for "
             f"{sorted(cap['families'])} under {cap['environment']}"
         )
         findings = C.check_structural(cap)
-        kept, suppressed, unused = apply_baseline(
-            findings, [e for e in baseline_entries
-                       if e["rule"].startswith("CONTRACT")]
-        )
-        return report("contracts", kept, suppressed, unused, verbose)
-    cap = C.capture()
+        kept, suppressed, unused = apply_baseline(findings, entries)
+        return report("contracts", kept, suppressed, unused, verbose,
+                      args.allow_stale)
+    cap = C.capture(traced=traced)
     findings = C.check_structural(cap)
     if os.path.exists(contracts_path):
         findings += C.diff_baseline(cap, C.load_contracts(contracts_path))
@@ -82,11 +113,42 @@ def run_contracts(args, baseline_entries, verbose):
                 "scripts/lint.py --write-contracts",
             )
         )
-    kept, suppressed, unused = apply_baseline(
-        findings, [e for e in baseline_entries
-                   if e["rule"].startswith("CONTRACT")]
-    )
-    return report("contracts", kept, suppressed, unused, verbose)
+    kept, suppressed, unused = apply_baseline(findings, entries)
+    return report("contracts", kept, suppressed, unused, verbose,
+                  args.allow_stale)
+
+
+def run_costmodel(args, baseline_entries, verbose, traced=None):
+    from pumiumtally_tpu.analysis import apply_baseline
+    from pumiumtally_tpu.analysis import costmodel as M
+
+    entries = _layer_entries(baseline_entries, "costmodel")
+    perf_path = os.path.join(ROOT, args.perf_contracts)
+    cap = M.capture(base_traced=traced)
+    if args.write_perf_contracts:
+        M.write_perf_contracts(perf_path, cap)
+        print(
+            f"wrote {args.perf_contracts} for "
+            f"{sorted(cap['families'])} under {cap['environment']}"
+        )
+        findings = M.check_cost(cap)
+        kept, suppressed, unused = apply_baseline(findings, entries)
+        return report("costmodel", kept, suppressed, unused, verbose,
+                      args.allow_stale)
+    findings = M.check_cost(cap)
+    if os.path.exists(perf_path):
+        findings += M.diff_cost(cap, M.load_perf_contracts(perf_path))
+    else:
+        findings.append(
+            M._finding(
+                "cost.baseline.missing.all",
+                f"{args.perf_contracts} not found — generate it with "
+                "scripts/lint.py --write-perf-contracts",
+            )
+        )
+    kept, suppressed, unused = apply_baseline(findings, entries)
+    return report("costmodel", kept, suppressed, unused, verbose,
+                  args.allow_stale)
 
 
 def run_ruff():
@@ -102,43 +164,82 @@ def run_ruff():
     return 1 if proc.returncode else 0
 
 
-def report(layer, kept, suppressed, unused, verbose):
+def report(layer, kept, suppressed, unused, verbose, allow_stale=False):
     for f in kept:
         print(f.render())
     if verbose:
         for f in suppressed:
             print(f"suppressed: {f.render()}")
     for e in unused:
+        severity = "warning" if allow_stale else "error"
         print(
-            f"warning: stale baseline entry {e['rule']} {e['path']} "
+            f"{severity}: stale baseline entry {e['rule']} {e['path']} "
             f"[{e['symbol']}] — the finding is gone; retire the "
             "suppression"
+            + ("" if allow_stale else
+               " (or re-run with --allow-stale mid-refactor)")
         )
     state = "clean" if not kept else f"{len(kept)} finding(s)"
+    stale_fails = bool(unused) and not allow_stale
     print(
         f"{layer}: {state}"
         + (f", {len(suppressed)} baselined" if suppressed else "")
+        + (f", {len(unused)} STALE baseline entr"
+           f"{'y' if len(unused) == 1 else 'ies'}" if unused else "")
     )
-    return 1 if kept else 0
+    return 1 if (kept or stale_fails) else 0
 
 
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--ast-only", action="store_true")
     ap.add_argument("--contracts-only", action="store_true")
+    ap.add_argument("--perf-only", action="store_true",
+                    help="run only the cost-model layer")
+    ap.add_argument("--no-perf", action="store_true",
+                    help="skip the cost-model layer (CI runs it as its "
+                         "own perf-contracts step; avoids compiling "
+                         "the ladder twice)")
     ap.add_argument("--ruff-only", action="store_true")
     ap.add_argument("--write-contracts", action="store_true")
+    ap.add_argument("--write-perf-contracts", action="store_true")
+    ap.add_argument("--allow-stale", action="store_true",
+                    help="stale baseline entries warn instead of "
+                         "failing (mid-refactor escape hatch)")
     ap.add_argument("--baseline", default="LINT_BASELINE.json")
     ap.add_argument("--contracts", default="CONTRACTS.json")
+    ap.add_argument("--perf-contracts", default="PERF_CONTRACTS.json")
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args()
 
-    only = [args.ast_only, args.contracts_only, args.ruff_only]
+    only = [args.ast_only, args.contracts_only, args.perf_only,
+            args.ruff_only]
     if sum(only) > 1:
-        ap.error("--ast-only/--contracts-only/--ruff-only are exclusive")
-    do_ast = not (args.contracts_only or args.ruff_only)
-    do_contracts = not (args.ast_only or args.ruff_only)
-    do_ruff = not (args.ast_only or args.contracts_only)
+        ap.error("--ast-only/--contracts-only/--perf-only/--ruff-only "
+                 "are exclusive")
+    if args.no_perf and args.perf_only:
+        ap.error("--no-perf contradicts --perf-only")
+    do_ast = not any(
+        (args.contracts_only, args.perf_only, args.ruff_only)
+    )
+    do_contracts = not any(
+        (args.ast_only, args.perf_only, args.ruff_only)
+    )
+    do_perf = not any(
+        (args.ast_only, args.contracts_only, args.ruff_only,
+         args.no_perf)
+    )
+    do_ruff = not any(
+        (args.ast_only, args.contracts_only, args.perf_only)
+    )
+    # A write flag aimed at a disabled layer would exit 0 with the
+    # baseline silently NOT regenerated — refuse the combination.
+    if args.write_contracts and not do_contracts:
+        ap.error("--write-contracts needs the contracts layer; drop "
+                 "the --*-only flag that disables it")
+    if args.write_perf_contracts and not do_perf:
+        ap.error("--write-perf-contracts needs the cost-model layer; "
+                 "drop --no-perf / the --*-only flag that disables it")
 
     baseline_path = os.path.join(ROOT, args.baseline)
     if os.path.exists(baseline_path):
@@ -147,12 +248,33 @@ def main() -> int:
         entries = load_baseline(baseline_path)
     else:
         entries = []
+    # Every entry must route to a layer — an unroutable rule (a typo
+    # like "UMI001") would suppress nothing AND dodge the stale-entry
+    # failure, leaving a permanently dead hole in the baseline.
+    for e in entries:
+        if not e["rule"].startswith(("PUMI", "CONTRACT", "COST")):
+            raise ValueError(
+                f"baseline entry rule {e['rule']!r} matches no lint "
+                "layer (PUMI* / CONTRACT* / COST*) — fix the rule "
+                "name or remove the entry"
+            )
+
+    # The contracts and cost layers analyze the SAME base-rung programs
+    # — trace them once and hand the cache to both (the cost layer adds
+    # its own ladder rungs on top).
+    traced = None
+    if do_contracts and do_perf:
+        from pumiumtally_tpu.analysis import contracts as C
+
+        traced = C.build_traced()
 
     rc = 0
     if do_ast:
-        rc |= run_ast(entries, args.verbose)
+        rc |= run_ast(args, entries, args.verbose)
     if do_contracts:
-        rc |= run_contracts(args, entries, args.verbose)
+        rc |= run_contracts(args, entries, args.verbose, traced=traced)
+    if do_perf:
+        rc |= run_costmodel(args, entries, args.verbose, traced=traced)
     if do_ruff:
         rc |= run_ruff()
     return rc

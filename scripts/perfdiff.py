@@ -1,0 +1,113 @@
+#!/usr/bin/env python
+"""Pretty-print the delta between two PERF_CONTRACTS.json captures.
+
+  python scripts/perfdiff.py OLD.json NEW.json
+  python scripts/perfdiff.py --all OLD.json NEW.json   # unchanged rows too
+  git show main:PERF_CONTRACTS.json > /tmp/old.json && \\
+      python scripts/perfdiff.py /tmp/old.json PERF_CONTRACTS.json
+
+One row per (family, metric): old -> new with the % change, plus the
+scaling-exponent and normalized-cost deltas — paste the table into the
+PR description whenever a PR regenerates PERF_CONTRACTS.json with
+``scripts/lint.py --write-perf-contracts`` so reviewers see exactly
+which resource moved and by how much.  Purely textual: no jax import,
+no compile, safe anywhere.
+"""
+import argparse
+import json
+import sys
+
+
+def _rows(old: dict, new: dict):
+    """Yield (family, metric, old, new) over every leaf the two
+    captures mention, metrics then normalized then scaling."""
+    fams = sorted(set(old.get("families", {}))
+                  | set(new.get("families", {})))
+    for fam in fams:
+        fo = old.get("families", {}).get(fam, {})
+        fn = new.get("families", {}).get(fam, {})
+        for rung, prefix in (("base", ""), ("top", "top.")):
+            for section in ("metrics", "normalized"):
+                so = fo.get(rung, {}).get(section, {})
+                sn = fn.get(rung, {}).get(section, {})
+                for metric in sorted(set(so) | set(sn)):
+                    yield (fam, prefix + metric, so.get(metric),
+                           sn.get(metric))
+        so = fo.get("scaling", {})
+        sn = fn.get("scaling", {})
+        for axis in sorted(set(so) | set(sn)):
+            ao, an = so.get(axis, {}), sn.get(axis, {})
+            for metric in sorted(set(ao) | set(an)):
+                yield (fam, f"scaling.{axis}.{metric}",
+                       ao.get(metric), an.get(metric))
+
+
+def _fmt(v):
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:g}"
+    return str(v)
+
+
+def _pct(old, new):
+    if old is None or new is None:
+        return "new" if old is None else "gone"
+    if old == new:
+        return "0%"
+    if old == 0:
+        return "was 0"  # any % against a zero baseline is meaningless
+    return f"{100.0 * (new - old) / abs(old):+.1f}%"
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("old")
+    ap.add_argument("new")
+    ap.add_argument("--all", action="store_true",
+                    help="print unchanged rows too")
+    args = ap.parse_args()
+    with open(args.old) as fh:
+        old = json.load(fh)
+    with open(args.new) as fh:
+        new = json.load(fh)
+
+    if old.get("environment") != new.get("environment"):
+        print(
+            f"environment: {old.get('environment')} -> "
+            f"{new.get('environment')}  (captures are only "
+            "comparable within one pinned environment)"
+        )
+    if old.get("ladder") != new.get("ladder"):
+        print(f"ladder: {old.get('ladder')} -> {new.get('ladder')}")
+
+    rows = [
+        (fam, metric, vo, vn)
+        for fam, metric, vo, vn in _rows(old, new)
+        if args.all or vo != vn
+    ]
+    if not rows:
+        print("no per-family deltas")
+        return 0
+    headers = ("family", "metric", "old", "new", "delta")
+    table = [
+        (fam, metric, _fmt(vo), _fmt(vn), _pct(vo, vn))
+        for fam, metric, vo, vn in rows
+    ]
+    widths = [
+        max(len(headers[i]), *(len(r[i]) for r in table))
+        for i in range(5)
+    ]
+    line = "  ".join(h.ljust(w) for h, w in zip(headers, widths))
+    print(line)
+    print("  ".join("-" * w for w in widths))
+    for r in table:
+        print("  ".join(c.ljust(w) for c, w in zip(r, widths)))
+    changed = sum(1 for _, _, vo, vn in rows if vo != vn)
+    print(f"\n{changed} changed value(s) across "
+          f"{len({r[0] for r in rows})} family(ies)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

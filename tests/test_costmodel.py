@@ -1,0 +1,440 @@
+"""Cost-model layer tests (pumiumtally_tpu/analysis/costmodel.py).
+
+The compile-time performance contracts must (a) be deterministic — the
+committed PERF_CONTRACTS.json is byte-stable across fresh processes on
+one environment, (b) hold on the committed capture (the baseline-free
+invariants pass with no tolerance games), and (c) actually catch the
+regressions they claim to: an accidental f64 upcast (flop census), a
+dropped donation (peak-memory jump via the alias bound), a quadratic
+broadcast (scaling exponent across the shape ladder), and a drifted
+Pallas VMEM estimator — each INJECTED here and asserted to fail with
+its *named* finding.  The drift diff and its per-metric tolerance bands
+are unit-tested on tampered captures, and scripts/perfdiff.py's table
+is smoke-tested end to end.
+
+The in-process tests run under the pytest environment (x64 ON — which
+is exactly what makes the injected f64 upcast representable); the
+determinism tests spawn fresh processes that pin the canonical
+cpu/8-device/x64-off lint environment like scripts/lint.py does.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pumiumtally_tpu.analysis import contracts as C
+from pumiumtally_tpu.analysis import costmodel as M
+from pumiumtally_tpu.ops import walk
+
+ROOT = Path(__file__).resolve().parents[1]
+
+_N_LADDER = (16, 64, 256)
+
+
+def _symbols(findings):
+    return [f.symbol for f in findings]
+
+
+# --------------------------------------------------------------------- #
+# Helpers: compile a (possibly poisoned) wrapped walk-trace program and
+# assemble a single-family capture the check functions accept.
+# --------------------------------------------------------------------- #
+def _wrapped_trace(n, poison=None, donate=True):
+    mesh, a = C._problem(jnp.float32, n=n)
+    statics = C._walk_statics()
+
+    def wrapped(origin, dest, elem, fly, w, g, mat, flux):
+        r = walk.trace_impl(
+            mesh, origin, dest, elem, fly, w, g, mat, flux, **statics
+        )
+        if poison == "f64":
+            # The accidental audit-path upcast: real f64 flops under an
+            # x64-capable runtime (and a truncation warning otherwise).
+            r = r._replace(
+                flux=(r.flux.astype(jnp.float64) * 1.0000001).astype(
+                    r.flux.dtype
+                )
+            )
+        elif poison == "quadratic":
+            # The accidental quadratic broadcast: an [n, n] outer
+            # product materialized and folded into the tally.
+            outer = jnp.outer(w, w)
+            r = r._replace(flux=r.flux + outer.sum(axis=1).sum())
+        return r
+
+    jitted = (
+        jax.jit(wrapped, donate_argnums=(7,)) if donate
+        else jax.jit(wrapped)
+    )
+    return jitted.trace(
+        a["origin"], a["dest"], a["elem"], a["in_flight"], a["weight"],
+        a["group"], a["material_id"], a["flux"],
+    )
+
+
+def _cap_for(metrics, scaling=None, family="trace", n=16, cells=2,
+             top=None):
+    entry = {
+        "base": M.rung_signature(
+            metrics, M.family_analytic(family, n=n, cells=cells)
+        ),
+        "scaling": scaling or {},
+    }
+    if top is not None:
+        top_metrics, top_n = top
+        entry["top"] = M.rung_signature(
+            top_metrics, M.family_analytic(family, n=top_n, cells=cells)
+        )
+    return {
+        "environment": C.environment(),
+        "ladder": {
+            "n_particles": list(M.LADDER_N),
+            "ntet": [6 * c**3 for c in M.LADDER_CELLS],
+        },
+        "families": {family: entry},
+    }
+
+
+# --------------------------------------------------------------------- #
+# Exponent fitting
+# --------------------------------------------------------------------- #
+def test_fit_exponent_recovers_powers():
+    sizes = [16, 64, 256]
+    assert M.fit_exponent(sizes, [7 * s for s in sizes]) == pytest.approx(
+        1.0
+    )
+    assert M.fit_exponent(sizes, [s * s for s in sizes]) == pytest.approx(
+        2.0
+    )
+    assert M.fit_exponent(sizes, [5000] * 3) == pytest.approx(0.0)
+
+
+def test_fit_exponent_rejects_degenerate_input():
+    with pytest.raises(ValueError):
+        M.fit_exponent([16], [100])
+    with pytest.raises(ValueError):
+        M.fit_exponent([16, 64], [0, 100])
+
+
+# --------------------------------------------------------------------- #
+# The committed capture: invariants hold, the diff is clean vs itself
+# --------------------------------------------------------------------- #
+def test_committed_perf_contracts_satisfy_invariants():
+    cap = M.load_perf_contracts(ROOT / "PERF_CONTRACTS.json")
+    assert M.check_cost(cap) == [], _symbols(M.check_cost(cap))
+    assert M.diff_cost(cap, json.loads(json.dumps(cap))) == []
+
+
+def test_committed_capture_carries_both_rungs():
+    """Every family records the base AND the top n_particles rung —
+    the top rung is where the analytic memory terms dominate the fixed
+    slack, making the peak gate meaningful."""
+    cap = M.load_perf_contracts(ROOT / "PERF_CONTRACTS.json")
+    for fam, entry in cap["families"].items():
+        assert set(entry) >= {"base", "top", "scaling"}, fam
+        assert entry["base"]["analytic"]["n"] == M.LADDER_N[0]
+        assert entry["top"]["analytic"]["n"] == M.LADDER_N[-1]
+
+
+def test_committed_scaling_exponents_are_linear_or_better():
+    cap = M.load_perf_contracts(ROOT / "PERF_CONTRACTS.json")
+    for fam, entry in cap["families"].items():
+        for axis, exps in entry["scaling"].items():
+            for metric, e in exps.items():
+                assert e <= 1.1, (
+                    f"{fam}.{axis}.{metric} exponent {e} — the clean "
+                    "programs are supposed to be (sub)linear"
+                )
+
+
+# --------------------------------------------------------------------- #
+# Injected regression: accidental f64 upcast -> flop census
+# --------------------------------------------------------------------- #
+def test_injected_f64_upcast_names_cost_f64():
+    clean = M.compile_metrics(_wrapped_trace(16))
+    assert clean["f64_ops"] == 0  # the control stays pure even on x64
+    poisoned = M.compile_metrics(_wrapped_trace(16, poison="f64"))
+    assert poisoned["f64_ops"] > 0
+    syms = _symbols(M.check_cost(_cap_for(poisoned)))
+    assert "cost.f64.trace" in syms
+    assert "cost.f64.trace" not in _symbols(
+        M.check_cost(_cap_for(clean))
+    )
+
+
+# --------------------------------------------------------------------- #
+# Injected regression: dropped donation -> peak-memory jump
+# --------------------------------------------------------------------- #
+def test_injected_dropped_donation_names_cost_donation():
+    donated = M.compile_metrics(_wrapped_trace(16))
+    dropped = M.compile_metrics(_wrapped_trace(16, donate=False))
+    flux_bytes = M.family_analytic("trace", n=16, cells=2)["flux_bytes"]
+    assert donated["alias_bytes"] >= flux_bytes
+    assert dropped["alias_bytes"] < flux_bytes
+    # The whole point: losing the alias IS a peak-memory jump of one
+    # accumulator.
+    assert dropped["peak_bytes"] >= donated["peak_bytes"] + flux_bytes
+    syms = _symbols(M.check_cost(_cap_for(dropped)))
+    assert "cost.donation.trace" in syms
+    assert "cost.donation.trace" not in _symbols(
+        M.check_cost(_cap_for(donated))
+    )
+
+
+# --------------------------------------------------------------------- #
+# Injected regression: quadratic broadcast -> scaling exponent
+# --------------------------------------------------------------------- #
+def test_injected_quadratic_broadcast_names_cost_scaling():
+    def ladder(poison):
+        rungs = [
+            M.compile_metrics(_wrapped_trace(n, poison=poison))
+            for n in _N_LADDER
+        ]
+        exps = {
+            metric: round(
+                M.fit_exponent(
+                    list(_N_LADDER), [r[metric] for r in rungs]
+                ),
+                3,
+            )
+            for metric in M.SCALING_METRICS
+        }
+        return rungs, exps
+
+    clean_rungs, clean_exps = ladder(None)
+    assert all(e <= M.SCALING_MAX["n_particles"]
+               for e in clean_exps.values()), clean_exps
+    quad_rungs, quad_exps = ladder("quadratic")
+    # The [n, n] intermediate shows up in the memory plan even when the
+    # flop fit is still masked by the linear walk term.
+    assert any(e > M.SCALING_MAX["n_particles"]
+               for e in quad_exps.values()), quad_exps
+
+    cap = _cap_for(quad_rungs[0], scaling={"n_particles": quad_exps},
+                   top=(quad_rungs[-1], _N_LADDER[-1]))
+    findings = M.check_cost(cap)
+    assert "cost.scaling.n_particles.trace" in _symbols(findings)
+    offender = [f for f in findings
+                if f.symbol == "cost.scaling.n_particles.trace"][0]
+    assert "superlinear" in offender.message
+    # At the top rung the materialized [256, 256] f32 intermediate also
+    # overflows the analytic temp allowance — the peak gate catches the
+    # same regression even without the ladder fit.
+    assert "cost.peak.trace" in _symbols(findings)
+    top_a = M.family_analytic("trace", n=_N_LADDER[-1], cells=2)
+    assert quad_rungs[-1]["temp_bytes"] > M.temp_allowance_bytes(top_a)
+
+    clean_cap = _cap_for(
+        clean_rungs[0], scaling={"n_particles": clean_exps},
+        top=(clean_rungs[-1], _N_LADDER[-1]),
+    )
+    clean_syms = _symbols(M.check_cost(clean_cap))
+    assert "cost.scaling.n_particles.trace" not in clean_syms
+    assert "cost.peak.trace" not in clean_syms
+
+
+# --------------------------------------------------------------------- #
+# Injected regression: VMEM estimator drift -> contract mirror
+# --------------------------------------------------------------------- #
+def test_injected_vmem_estimator_drift_names_cost_vmem(monkeypatch):
+    from pumiumtally_tpu.ops import walk_pallas
+
+    cap = M.load_perf_contracts(ROOT / "PERF_CONTRACTS.json")
+    assert "cost.vmem.pallas" not in _symbols(M.check_cost(cap))
+
+    real = walk_pallas.kernel_vmem_bytes
+    monkeypatch.setattr(
+        walk_pallas, "kernel_vmem_bytes",
+        lambda *a, **kw: real(*a, **kw) // 2,  # "forgot half the tiles"
+    )
+    syms = _symbols(M.check_cost(cap))
+    assert "cost.vmem.pallas" in syms
+
+
+def test_vmem_estimator_matches_analytic_footprint():
+    """The live estimator and the costmodel mirror agree at every rung
+    of the ladder (the real gate checks the base rung; drift at any
+    size would eventually migrate there)."""
+    from pumiumtally_tpu.ops.walk_pallas import kernel_vmem_bytes
+
+    for n in M.LADDER_N:
+        for cells in M.LADDER_CELLS:
+            ntet = 6 * cells**3
+            est = kernel_vmem_bytes(ntet, n, 2, 4)
+            ref = M.pallas_footprint_bytes(ntet, n, 2, 4)
+            assert abs(est - ref) <= M.VMEM_TOL * ref
+
+
+# --------------------------------------------------------------------- #
+# Drift diff: tolerance bands and named findings
+# --------------------------------------------------------------------- #
+def _tampered(cap, fn):
+    t = json.loads(json.dumps(cap))
+    fn(t)
+    return t
+
+
+def test_diff_cost_names_out_of_band_drift():
+    cap = M.load_perf_contracts(ROOT / "PERF_CONTRACTS.json")
+
+    t = _tampered(cap, lambda c: c["families"]["megastep"]["base"][
+        "metrics"].__setitem__("flops", int(
+            cap["families"]["megastep"]["base"]["metrics"]["flops"]
+            * 1.5)))
+    assert "cost.drift.flops.megastep" in _symbols(M.diff_cost(t, cap))
+
+    # Inside the band: ±1% flops is tolerated (band is 2%).
+    t = _tampered(cap, lambda c: c["families"]["megastep"]["base"][
+        "metrics"].__setitem__("flops", int(
+            cap["families"]["megastep"]["base"]["metrics"]["flops"]
+            * 1.01)))
+    assert M.diff_cost(t, cap) == []
+
+    t = _tampered(cap, lambda c: c["families"]["trace"]["scaling"][
+        "n_particles"].__setitem__("flops", 1.9))
+    assert "cost.drift.scaling.n_particles.flops.trace" in _symbols(
+        M.diff_cost(t, cap)
+    )
+
+    t = _tampered(cap, lambda c: c["families"].pop("pallas"))
+    assert "cost.family.removed.pallas" in _symbols(M.diff_cost(t, cap))
+    assert "cost.family.added.pallas" in _symbols(M.diff_cost(cap, t))
+
+
+def test_diff_cost_refuses_cross_environment_and_ladder():
+    cap = M.load_perf_contracts(ROOT / "PERF_CONTRACTS.json")
+    t = _tampered(cap, lambda c: c["environment"].__setitem__(
+        "x64", not cap["environment"]["x64"]))
+    assert _symbols(M.diff_cost(cap, t)) == ["cost.environment.all"]
+    t = _tampered(cap, lambda c: c["ladder"].__setitem__(
+        "n_particles", [16, 64]))
+    assert _symbols(M.diff_cost(cap, t)) == ["cost.ladder.all"]
+
+
+# --------------------------------------------------------------------- #
+# Determinism: fresh processes, identical capture
+# --------------------------------------------------------------------- #
+_CAPTURE_SNIPPET = textwrap.dedent(
+    """
+    import os, sys, json
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("JAX_ENABLE_X64", None)
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    )
+    sys.path.insert(0, {root!r})
+    from pumiumtally_tpu.analysis import costmodel as M
+    cap = M.capture(families=("trace",))
+    print(json.dumps(cap, sort_keys=True))
+    """
+)
+
+
+def _fresh_env():
+    env = dict(os.environ)
+    for k in ("XLA_FLAGS", "JAX_ENABLE_X64", "JAX_PLATFORMS"):
+        env.pop(k, None)
+    return env
+
+
+def test_capture_deterministic_across_fresh_processes():
+    """Two cold processes on the pinned lint environment produce the
+    byte-identical capture — PERF_CONTRACTS.json can be committed."""
+    snippet = _CAPTURE_SNIPPET.format(root=str(ROOT))
+    outs = []
+    for _ in range(2):
+        proc = subprocess.run(
+            [sys.executable, "-c", snippet],
+            capture_output=True, text=True, env=_fresh_env(),
+            cwd=str(ROOT), timeout=300,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        outs.append(proc.stdout.strip().splitlines()[-1])
+    assert outs[0] == outs[1]
+    cap = json.loads(outs[0])
+    assert set(cap["families"]) == {"trace"}
+    assert cap["environment"]["x64"] is False
+
+
+@pytest.mark.slow
+def test_full_write_perf_contracts_byte_stable(tmp_path):
+    """The full five-family ladder writes byte-identical
+    PERF_CONTRACTS.json in two fresh scripts/lint.py processes."""
+    paths = [tmp_path / f"perf{i}.json" for i in (1, 2)]
+    for p in paths:
+        proc = subprocess.run(
+            [sys.executable, str(ROOT / "scripts" / "lint.py"),
+             "--perf-only", "--write-perf-contracts",
+             "--perf-contracts", str(p)],
+            capture_output=True, text=True, env=_fresh_env(),
+            cwd=str(ROOT), timeout=600,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert paths[0].read_bytes() == paths[1].read_bytes()
+
+
+# --------------------------------------------------------------------- #
+# perfdiff.py
+# --------------------------------------------------------------------- #
+def test_perfdiff_prints_delta_table(tmp_path):
+    cap = M.load_perf_contracts(ROOT / "PERF_CONTRACTS.json")
+    new = _tampered(cap, lambda c: c["families"]["megastep"]["base"][
+        "metrics"].__setitem__("flops", int(
+            cap["families"]["megastep"]["base"]["metrics"]["flops"]
+            * 1.5)))
+    p = tmp_path / "new.json"
+    p.write_text(json.dumps(new))
+    proc = subprocess.run(
+        [sys.executable, str(ROOT / "scripts" / "perfdiff.py"),
+         str(ROOT / "PERF_CONTRACTS.json"), str(p)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "megastep" in proc.stdout
+    assert "flops" in proc.stdout
+    assert "+50.0%" in proc.stdout
+    # unchanged families do not clutter the default table
+    assert "trace_packed" not in proc.stdout
+
+
+def test_perfdiff_reports_no_delta(tmp_path):
+    proc = subprocess.run(
+        [sys.executable, str(ROOT / "scripts" / "perfdiff.py"),
+         str(ROOT / "PERF_CONTRACTS.json"),
+         str(ROOT / "PERF_CONTRACTS.json")],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0
+    assert "no per-family deltas" in proc.stdout
+
+
+# --------------------------------------------------------------------- #
+# Capture plumbing
+# --------------------------------------------------------------------- #
+def test_capture_reuses_base_traced():
+    """The lint runner hands the contracts layer's traced programs to
+    the cost layer; the base-rung metrics must be identical to a
+    self-traced capture (same shapes, same programs)."""
+    traced = C.build_traced(families=("trace",))
+    a = M.capture(families=("trace",), base_traced=traced)
+    b = M.capture(families=("trace",))
+    assert a["families"]["trace"]["base"] == b["families"]["trace"][
+        "base"
+    ]
+
+
+def test_family_analytic_partitioned_requires_max_local():
+    with pytest.raises(ValueError, match="max_local"):
+        M.family_analytic("partitioned", n=16, cells=2)
+    a = M.family_analytic("partitioned", n=16, cells=2, max_local=6)
+    assert a["flux_bytes"] == 6 * 2 * 2 * 4

@@ -399,6 +399,110 @@ def run(fn, seconds):
 
 
 # --------------------------------------------------------------------- #
+# scripts/ + bench.py coverage (traced-body rule subset)
+# --------------------------------------------------------------------- #
+def test_scripts_traced_body_rules_fire():
+    """The traced-body contracts travel with the jitted code: a host
+    sync / nondeterminism / f64 literal in a script's traced function
+    is a finding, exactly as in the package."""
+    src = """
+import time
+import jax, jax.numpy as jnp
+
+def step(x):
+    t = time.time()
+    s = jnp.sum(x)
+    bad = float(s)
+    return x.astype("float64") * t * bad
+
+_jit = jax.jit(step)
+"""
+    fs = lint_sources({"scripts/fake_probe.py": src})
+    assert rules_of(fs) == ["PUMI001", "PUMI004", "PUMI005"]
+    assert all(f.path == "scripts/fake_probe.py" for f in fs)
+
+
+def test_scripts_package_scoped_rules_filtered():
+    """PUMI002 (transfer placement) and PUMI006 (jit hygiene) are
+    package-structure contracts: scripts stage their own transfers and
+    microbenches build throwaway jits by design."""
+    src = """
+import jax
+
+def main(xs):
+    staged = jax.device_put(xs)          # scripts stage on purpose
+    out = []
+    for x in xs:
+        out.append(jax.jit(lambda v: v * 2)(x))  # probe-by-config
+    return staged, out
+"""
+    assert lint_sources({"scripts/fake_probe.py": src}) == []
+    # ... while the SAME source inside the package keeps both findings.
+    fs = lint_sources({"pumiumtally_tpu/obs/fake_probe.py": src})
+    assert rules_of(fs) == ["PUMI002", "PUMI006"]
+
+
+def test_scripts_use_after_donate_fires():
+    """bench.py builds donating jits of its own — PUMI003 is in the
+    scripts subset because use-after-donate corrupts data no matter
+    who constructed the jit."""
+    src = """
+import jax
+
+def impl(state, flux):
+    return state + 1, flux + state
+
+_step = jax.jit(impl, donate_argnames=("flux",))
+
+def measure(state, flux):
+    out = _step(state, flux=flux)
+    return flux.sum() + out[0]   # read after donation
+"""
+    fs = lint_sources({"scripts/fake_bench.py": src})
+    assert [f.rule for f in fs] == ["PUMI003"]
+    assert fs[0].symbol == "measure"
+
+
+def test_scripts_fixpoint_reaches_into_package():
+    """A script jitting a package function makes that function traced:
+    the finding lands on the PACKAGE path with the full rule set."""
+    pkg = """
+def helper(x):
+    return float(x)
+"""
+    script = """
+import jax
+from pumiumtally_tpu.ops.fake_helper import helper
+
+_jit = jax.jit(helper)
+"""
+    fs = lint_sources({
+        "pumiumtally_tpu/ops/fake_helper.py": pkg,
+        "scripts/fake_run.py": script,
+    })
+    assert [f.rule for f in fs] == ["PUMI001"]
+    assert fs[0].path == "pumiumtally_tpu/ops/fake_helper.py"
+    assert fs[0].symbol == "helper"
+
+
+def test_repo_scripts_and_bench_clean_under_subset():
+    """The launch surface itself carries no traced-body findings (the
+    repo-stays-clean pin for the satellite coverage)."""
+    findings = lint_package(ROOT)
+    entries = load_baseline(ROOT / "LINT_BASELINE.json")
+    kept, _, _ = apply_baseline(findings, entries)
+    outside = [f for f in kept
+               if not f.path.startswith("pumiumtally_tpu/")]
+    assert outside == [], "\n".join(f.render() for f in outside)
+    # and the covered files really are in the index
+    paths = {f.path for f in findings}
+    assert not paths or all(
+        p.startswith(("pumiumtally_tpu/", "scripts/", "bench.py"))
+        for p in paths
+    )
+
+
+# --------------------------------------------------------------------- #
 # Baseline machinery
 # --------------------------------------------------------------------- #
 def test_baseline_suppresses_by_symbol_and_reports_stale(tmp_path):
@@ -422,6 +526,81 @@ def test_baseline_rejects_missing_justification(tmp_path):
     ]}))
     with pytest.raises(ValueError, match="justification"):
         load_baseline(p)
+
+
+def _lint_ast_only(tmp_path, extra_entries, *flags):
+    """Run scripts/lint.py --ast-only in a fresh process against the
+    committed suppressions plus ``extra_entries``."""
+    committed = json.loads(
+        (ROOT / "LINT_BASELINE.json").read_text()
+    )["suppressions"]
+    p = tmp_path / "baseline.json"
+    p.write_text(json.dumps(
+        {"suppressions": committed + list(extra_entries)}
+    ))
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    return subprocess.run(
+        [sys.executable, str(ROOT / "scripts" / "lint.py"),
+         "--ast-only", "--baseline", str(p), *flags],
+        capture_output=True, text=True, env=env, cwd=str(ROOT),
+        timeout=300,
+    )
+
+
+def test_stale_baseline_entry_is_a_hard_failure(tmp_path):
+    """A suppression whose finding no longer exists must FAIL the run —
+    a stale hole is exactly where the next regression slips through."""
+    stale = {"rule": "PUMI001", "path": "pumiumtally_tpu/ops/walk.py",
+             "symbol": "long_gone_fn",
+             "justification": "finding fixed three PRs ago"}
+    proc = _lint_ast_only(tmp_path, [stale])
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "error: stale baseline entry" in proc.stdout
+    assert "long_gone_fn" in proc.stdout
+
+
+def test_allow_stale_escape_hatch_downgrades_to_warning(tmp_path):
+    stale = {"rule": "PUMI001", "path": "pumiumtally_tpu/ops/walk.py",
+             "symbol": "long_gone_fn",
+             "justification": "finding fixed three PRs ago"}
+    proc = _lint_ast_only(tmp_path, [stale], "--allow-stale")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "warning: stale baseline entry" in proc.stdout
+
+
+def test_clean_baseline_still_exits_zero(tmp_path):
+    proc = _lint_ast_only(tmp_path, [])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_write_flag_for_disabled_layer_is_rejected(tmp_path):
+    """`--no-perf --write-perf-contracts` (or an --*-only flag that
+    disables the targeted layer) must be a usage error — exiting 0
+    without regenerating the baseline would be a silent no-op."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    for flags in (["--no-perf", "--write-perf-contracts"],
+                  ["--ast-only", "--write-perf-contracts"],
+                  ["--perf-only", "--write-contracts"]):
+        proc = subprocess.run(
+            [sys.executable, str(ROOT / "scripts" / "lint.py"), *flags],
+            capture_output=True, text=True, env=env, cwd=str(ROOT),
+            timeout=120,
+        )
+        assert proc.returncode == 2, (flags, proc.stdout, proc.stderr)
+        assert "needs the" in proc.stderr, flags
+
+
+def test_unroutable_baseline_rule_is_a_config_error(tmp_path):
+    """A typo'd rule ("UMI001") routes to no lint layer: it would
+    suppress nothing AND dodge the stale-entry failure — the runner
+    must reject it outright."""
+    typo = {"rule": "UMI001", "path": "pumiumtally_tpu/ops/walk.py",
+            "symbol": "whatever", "justification": "typo'd rule"}
+    proc = _lint_ast_only(tmp_path, [typo])
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert "matches no lint layer" in proc.stderr
 
 
 # --------------------------------------------------------------------- #
