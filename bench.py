@@ -12,7 +12,10 @@ walk+scatter kernel (plus one scalar readback per run at the end).
 Knobs (env): BENCH_CELLS (default 55 → 6*55^3 = 997,500 tets),
 BENCH_PARTICLES (1048576), BENCH_STEPS (10), BENCH_GROUPS (8),
 BENCH_DTYPE (float32), BENCH_UNROLL (8), walk strategy A/B knobs
-BENCH_ROBUST/BENCH_SCATTER/BENCH_GATHERS/BENCH_LEDGER, and
+BENCH_ROBUST/BENCH_SCATTER/BENCH_GATHERS/BENCH_LEDGER,
+BENCH_KERNEL/BENCH_LANE_BLOCK (walk kernel + Mosaic block width;
+PUMI_TPU_TUNING points the run at an autotuning database and the
+record's lane_block/tuning_db/tuned axes say what actually ran), and
 BENCH_FUSED (default 1) runs all steps in ONE device program
 (lax.fori_loop) — pure device time, immune to per-dispatch tunnel
 latency; BENCH_FUSED=0 launches one program per step (the gap between
@@ -52,6 +55,7 @@ def run(
     flat_flux: bool = True,
     sd_mode: str = "segment",
     kernel: str = "xla",
+    lane_block: int | None = None,
 ) -> dict:
     import contextlib
 
@@ -93,9 +97,53 @@ def run(
     mesh = build_box(1.0, 1.0, 1.0, cells, cells, cells, dtype=dtype)
     build_s = time.perf_counter() - t0
 
+    # Autotuning axes (round 7): the record carries the resolved
+    # tuning-database path (PUMI_TPU_TUNING / BENCH knob semantics of
+    # TallyConfig.resolve_tuning), whether THIS workload's shape class
+    # hit an entry, and the Pallas lane_block that actually ran — so
+    # A/B captures can be grouped by tuning decision exactly like the
+    # PR 7 kernel axis.
+    from pumiumtally_tpu.utils.config import TallyConfig as _TC
+
+    tuning_db = _TC().resolve_tuning()
+    tuned = None
+    if tuning_db is not None:
+        from pumiumtally_tpu.tuning import lookup_tuned
+
+        tuned = lookup_tuned(
+            tuning_db,
+            ntet=mesh.ntet,
+            n_particles=n_particles,
+            n_groups=n_groups,
+            dtype=dtype,
+            packed=getattr(mesh, "geo20", None) is not None,
+        )
+    # Two resolution layers, kept separate on purpose: the EXPLICIT
+    # knob (BENCH_LANE_BLOCK / the env override) goes to the facade
+    # rows as a config field, while the headline trace additionally
+    # falls through to the database winner for ITS shape class. The
+    # event/pipeline facades consult the database themselves for their
+    # own (smaller) shape classes — handing them the headline's tuned
+    # winner as an "explicit" knob would override their resolve.
+    # The explicit value stays UNCLAMPED (validated power of two): it
+    # re-enters resolve_lane_block as a config field in the facade
+    # rows, where the pow2 check runs before the batch clamp — a
+    # batch-clamped (possibly non-pow2) value would be rejected there.
+    lane_block_explicit = _TC(
+        pallas_lane_block=lane_block
+    ).resolve_lane_block()
+    lane_block = (
+        _TC(
+            pallas_lane_block=lane_block_explicit
+        ).resolve_lane_block(n_particles)
+        if lane_block_explicit is not None
+        else _TC().resolve_lane_block(n_particles, tuned=tuned)
+    )
+
     # Walk-kernel axis (round 6): "pallas" routes every trace through
     # the Mosaic kernel (ops/walk_pallas.py); "auto" resolves against
-    # THIS workload so the record names the backend that actually ran.
+    # THIS workload — steered by the tuning database's winner when one
+    # is active — so the record names the backend that actually ran.
     # An explicit "pallas" outside its regime (no packed table, over
     # the VMEM budget) fails here, before any measurement.
     if kernel != "xla":
@@ -108,7 +156,20 @@ def run(
             n_groups=n_groups,
             dtype=dtype,
             packed=getattr(mesh, "geo20", None) is not None,
+            lane_block=lane_block,
+            tuned_kernel=tuned.kernel if tuned and tuned.hit else None,
         )
+    # The effective block width of the kernel that runs: the resolved
+    # knob (or the kernel default clamped to the batch) on the Mosaic
+    # path, null on the XLA walk.
+    if kernel == "pallas":
+        from pumiumtally_tpu.ops.walk_pallas import DEFAULT_LANE_BLOCK
+
+        lane_block_eff = min(
+            lane_block or DEFAULT_LANE_BLOCK, n_particles
+        )
+    else:
+        lane_block_eff = None
 
     rng = np.random.default_rng(seed)
     elem = jnp.asarray(
@@ -183,6 +244,11 @@ def run(
             ledger=ledger,
             n_groups=n_groups,
             kernel=kernel,
+            **(
+                {"lane_block": lane_block_eff}
+                if kernel == "pallas" and lane_block_eff
+                else {}
+            ),
         )
         return (
             r.position, r.elem, r.flux, r.n_segments, r.n_crossings,
@@ -361,6 +427,7 @@ def run(
             mean_path=mean_path,
             seed=seed,
             kernel=kernel,
+            lane_block=lane_block_explicit,
         )
 
     per_chip_baseline = 1e9 / 64.0
@@ -375,6 +442,17 @@ def run(
         # the scattered body, "pallas" the Mosaic matrixized-tally
         # kernel — the RESOLVED value when the caller asked for "auto".
         "kernel": kernel,
+        # Autotuning axes (round 7): the EFFECTIVE Pallas one-hot block
+        # width (null on the XLA walk), the tuning database consulted
+        # (null = tuning off), and whether this workload's shape class
+        # hit an entry — A/B captures group rows by these exactly like
+        # the kernel axis.
+        "lane_block": lane_block_eff,
+        "tuning_db": tuning_db,
+        "tuned": (
+            ("hit" if tuned.hit else "miss")
+            if tuned is not None else "miss"
+        ),
         "vs_baseline": round(segments_per_sec / per_chip_baseline, 4),
         # Dispatch-amortization axes (the megastep tentpole's tracked
         # win): moves retired per wall-second, and how many host→device
@@ -406,6 +484,9 @@ def run(
             "tally_scatter": tally_scatter,
             "gathers": gathers,
             "kernel": kernel,
+            "lane_block": lane_block_eff,
+            "tuning_db": tuning_db,
+            "tuned_key": tuned.key if tuned is not None else None,
             "ledger": ledger,
             "fused_steps": fused,
             "flat_flux": flat_flux,
@@ -431,7 +512,7 @@ def run(
 
 def run_event_loop(
     mesh, n_particles, moves, n_groups, dtype, mean_path, seed,
-    kernel="xla",
+    kernel="xla", lane_block=None,
 ) -> dict:
     """Measure the full per-event host loop and the streaming pipeline.
 
@@ -465,8 +546,11 @@ def run_event_loop(
         # the event-loop / pipeline rows A/B the same backend as the
         # headline (the megastep rows below stay XLA — the fused
         # megastep program never rides the Mosaic kernel,
-        # TallyConfig.resolve_kernel).
+        # TallyConfig.resolve_kernel). The resolved lane_block rides
+        # as the explicit config knob; a PUMI_TPU_TUNING database is
+        # consulted by the facade's own construction-time resolve.
         kernel=kernel,
+        pallas_lane_block=lane_block,
     )
     tally = PumiTally(mesh, n_particles, cfg)
     cents = np.asarray(mesh.centroids())
@@ -580,6 +664,13 @@ def run_event_loop(
         "event_particles": n_particles,
         "event_moves": moves,
         "event_kernel": kernel,
+        # Autotuning axes on the facade rows (the facade's OWN resolved
+        # values — the truthful record of what construction decided).
+        "event_lane_block": getattr(tally, "_lane_block", None),
+        "event_tuned": (
+            ("hit" if tally._tuned.hit else "miss")
+            if getattr(tally, "_tuned", None) is not None else "miss"
+        ),
         # Per-move dispatch accounting for the facade loop (each
         # move_to_next_location is one program dispatch).
         "event_moves_per_sec": round(moves / dt, 2),
@@ -857,6 +948,14 @@ def main() -> None:
         # xla (scattered body) | pallas (Mosaic matrixized tally) |
         # auto (pallas inside its VMEM regime) — the round-6 A/B axis.
         kernel=os.environ.get("BENCH_KERNEL", "xla"),
+        # Explicit Pallas one-hot block width (the round-7 tuning axis;
+        # unset = the tuning database's winner under PUMI_TPU_TUNING,
+        # else the kernel default 128).
+        lane_block=(
+            int(os.environ["BENCH_LANE_BLOCK"])
+            if os.environ.get("BENCH_LANE_BLOCK")
+            else None
+        ),
     )
     print(
         f"[bench] {result['detail']}", file=sys.stderr

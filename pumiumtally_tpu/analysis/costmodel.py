@@ -576,6 +576,110 @@ def diff_cost(current: dict, baseline: dict) -> list[Finding]:
     return out
 
 
+# --------------------------------------------------------------------- #
+# Hardware calibration: joining measured timings to compiled signatures
+# --------------------------------------------------------------------- #
+# Nominal effective coefficients for PREDICTED seconds when no
+# calibration exists yet: the deterministic ranking basis of the
+# autotuner's rehearsal mode (tuning/search.py) and the placeholder the
+# first hardware capture replaces.  Order-of-magnitude v5p-ish figures;
+# their absolute accuracy is irrelevant to rehearsal ranking (only the
+# flop/byte/dispatch trade-off ordering matters) and the calibrated
+# per-shape-class values supersede them wherever a TUNING.json entry
+# exists.
+NOMINAL_COEFFS = {
+    "flops_per_s": 2.0e11,   # effective f32 throughput
+    "bytes_per_s": 5.0e10,   # effective HBM bandwidth
+    "dispatch_s": 5.0e-5,    # per-program-launch overhead
+}
+
+
+def predict_seconds(metrics: dict, coeffs: dict, *, dispatches: float = 0.0) -> float:
+    """Roofline-style predicted wall seconds of one compiled program
+    from its :func:`compile_metrics` signature: compute time + memory
+    time + (optionally) launch overhead.  With per-shape-class FITTED
+    coefficients (:func:`calibrate_points`, persisted in TUNING.json)
+    this is the compile-time contracts' bridge from a flop/byte drift
+    to a predicted hardware regression between capture windows.  A
+    coefficient :func:`calibrate_points` could not fit (its explicit
+    None fallback on degenerate point sets) contributes no term."""
+    t = dispatches * (coeffs.get("dispatch_s") or 0.0)
+    f = coeffs.get("flops_per_s")
+    if f:
+        t += metrics["flops"] / f
+    b = coeffs.get("bytes_per_s")
+    if b:
+        t += metrics["bytes_accessed"] / b
+    return t
+
+
+def calibrate_points(points: list[dict]) -> dict | None:
+    """Fit effective-throughput / effective-bandwidth coefficients from
+    measured (flops, bytes_accessed, seconds) points of one shape class
+    — the autotuner's calibration join (every timed candidate is one
+    point; the compiled signatures come from :func:`compile_metrics`
+    over the exact programs that were timed).
+
+    Model: ``t ≈ flops·x + bytes·y`` with x = 1/flops_per_s,
+    y = 1/bytes_per_s, solved by 2×2 least squares.  A degenerate or
+    unphysical fit (singular system, non-positive coefficient — common
+    when every candidate has near-identical signatures) falls back to
+    the single-term fit that explains the timings best, with the other
+    coefficient reported as None.  Returns None with no points."""
+    import math
+
+    pts = [
+        (float(p["flops"]), float(p["bytes_accessed"]),
+         float(p["seconds"]))
+        for p in points
+        if p.get("seconds") and p["seconds"] > 0
+    ]
+    if not pts:
+        return None
+
+    def _one_term(idx):
+        # t ≈ v·x  →  x = Σ v·t / Σ v²  (least squares through origin)
+        num = sum(p[idx] * p[2] for p in pts)
+        den = sum(p[idx] * p[idx] for p in pts)
+        return (num / den) if den > 0 and num > 0 else None
+
+    def _rmse(x, y):
+        err = [
+            (f * (x or 0.0) + b * (y or 0.0) - t) ** 2
+            for f, b, t in pts
+        ]
+        return math.sqrt(sum(err) / len(err))
+
+    x = y = None
+    if len(pts) >= 2:
+        sff = sum(f * f for f, _, _ in pts)
+        sbb = sum(b * b for _, b, _ in pts)
+        sfb = sum(f * b for f, b, _ in pts)
+        sft = sum(f * t for f, _, t in pts)
+        sbt = sum(b * t for _, b, t in pts)
+        det = sff * sbb - sfb * sfb
+        if det > 0 and abs(det) > 1e-12 * max(sff * sbb, 1.0):
+            x = (sft * sbb - sbt * sfb) / det
+            y = (sbt * sff - sft * sfb) / det
+    if x is None or y is None or x <= 0 or y <= 0:
+        xf, yb = _one_term(0), _one_term(1)
+        cand = []
+        if xf is not None:
+            cand.append((xf, None))
+        if yb is not None:
+            cand.append((None, yb))
+        if not cand:
+            return None
+        x, y = min(cand, key=lambda c: _rmse(*c))
+    return {
+        "flops_per_s": (1.0 / x) if x else None,
+        "bytes_per_s": (1.0 / y) if y else None,
+        "rmse_s": _rmse(x, y),
+        "points": len(pts),
+        "model": "seconds = flops/flops_per_s + bytes/bytes_per_s",
+    }
+
+
 def load_perf_contracts(path) -> dict:
     with open(path) as fh:
         return json.load(fh)

@@ -169,6 +169,29 @@ class PumiTally:
             # double-buffered staging + deferred telemetry folds;
             # "legacy" is the pre-pipeline multi-transfer path.
             self._io = cfg.resolve_io_pipeline()
+            # Autotuning database (tuning/): consulted ONCE, here at
+            # construction, for the knobs left at their defer values —
+            # kernel="auto"'s backend pick, the Pallas lane_block,
+            # megastep K. Explicit config/env knobs beat it; a miss
+            # (or no database — the default) changes nothing, and every
+            # database winner is bitwise parity-gated by the tuner, so
+            # outputs are byte-identical either way.
+            from .tuning import resolve_tuned
+
+            self._tuned = resolve_tuned(
+                cfg,
+                ntet=mesh.ntet,
+                n_particles=self.num_particles,
+                n_groups=cfg.n_groups,
+                dtype=cfg.dtype,
+                packed=getattr(mesh, "geo20", None) is not None,
+            )
+            # Pallas one-hot block width: validated here (power of two,
+            # clamped to the batch) whatever the kernel resolves to, and
+            # fed into select_backend's VMEM-budget check below.
+            self._lane_block = cfg.resolve_lane_block(
+                self.num_particles, tuned=self._tuned
+            )
             # Walk-kernel backend (ops/walk_pallas.py): the config half
             # of the decision (resolve_kernel — combo validation, env
             # override) and the workload half (select_backend — packed
@@ -190,6 +213,8 @@ class PumiTally:
                     n_groups=cfg.n_groups,
                     dtype=cfg.dtype,
                     packed=getattr(mesh, "geo20", None) is not None,
+                    lane_block=self._lane_block,
+                    tuned=self._tuned,
                 )
             self._stager = staging.HostStager(
                 depth=2 if self._io == "overlap" else 1
@@ -282,6 +307,10 @@ class PumiTally:
         (OMEGA_H_CHECK_PRINTF, cpp:605-608, 618-629) fire as Python
         exceptions."""
         kwargs.setdefault("kernel", self._kernel)
+        if kwargs.get("kernel") == "pallas" and self._lane_block:
+            # The resolved block width rides only the Mosaic path — the
+            # XLA jit cache never sees the (no-op there) static key.
+            kwargs.setdefault("lane_block", self._lane_block)
         if kwargs.pop("_packed", False):
             return trace_packed(*args, **kwargs)
         if self.config.checkify_invariants:
@@ -1263,8 +1292,10 @@ class PumiTally:
         # body: a config-explicit kernel='pallas' is rejected here at
         # the same resolve point, while kernel='auto' — and an
         # env-forced 'pallas' (the PUMI_TPU_KERNEL sweep) — lands on
-        # the XLA megastep silently (the auto fallback policy).
-        K = cfg.resolve_megastep()
+        # the XLA megastep silently (the auto fallback policy). The
+        # tuning database's K applies only when neither the env nor
+        # the config pinned one (bitwise identical for any K).
+        K = cfg.resolve_megastep(tuned=self._tuned)
         if self._kernel_policy == "pallas" and cfg.kernel == "pallas":
             raise NotImplementedError(
                 "run_source_moves fuses source sampling + walk + "

@@ -114,6 +114,21 @@ class StreamingTallyPipeline:
         cfg = self.config
         n = np.asarray(origin).shape[0]
         dt = cfg.dtype
+        # The tuning database is consulted per submit() because the
+        # shape class depends on the BATCH size (same reason the
+        # workload half of the kernel resolve re-runs here); the
+        # parsed database is cached, so this is a dict lookup.
+        from ..tuning import resolve_tuned
+
+        tuned = resolve_tuned(
+            cfg,
+            ntet=self.mesh.ntet,
+            n_particles=n,
+            n_groups=cfg.n_groups,
+            dtype=dt,
+            packed=getattr(self.mesh, "geo20", None) is not None,
+        )
+        lane_block = cfg.resolve_lane_block(n, tuned=tuned)
         if self._kernel_policy == "xla":
             kern = "xla"
         else:
@@ -126,6 +141,8 @@ class StreamingTallyPipeline:
                 n_groups=cfg.n_groups,
                 dtype=dt,
                 packed=getattr(self.mesh, "geo20", None) is not None,
+                lane_block=lane_block,
+                tuned=tuned,
             )
         result = trace(
             self.mesh,
@@ -175,6 +192,11 @@ class StreamingTallyPipeline:
             record_xpoints=cfg.record_xpoints,
             n_groups=cfg.n_groups,
             kernel=kern,
+            **(
+                {"lane_block": lane_block}
+                if kern == "pallas" and lane_block
+                else {}
+            ),
         )
         # The flux chain threads through every batch (donated each step);
         # per-batch outputs wait in the in-flight queue.

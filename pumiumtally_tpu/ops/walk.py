@@ -486,6 +486,7 @@ def trace_impl(
     rel_err_target: float = 0.05,
     batch_moves: int = 1,
     kernel: str = "xla",
+    lane_block: int | None = None,
 ) -> TraceResult:
     """Advance all particles from origin to dest through the mesh.
 
@@ -626,6 +627,13 @@ def trace_impl(
         resolve TallyConfig(kernel=...)/PUMI_TPU_KERNEL to a concrete
         backend at construction (walk_pallas.select_backend) — "auto"
         never reaches here.
+      lane_block: the Mosaic kernel's one-hot block width B (first-class
+        knob: TallyConfig(pallas_lane_block=...) /
+        PUMI_TPU_PALLAS_LANE_BLOCK / the tuning database; every ladder
+        rung is bitwise identical, so this is pure scheduling).  None =
+        the kernel default (walk_pallas.DEFAULT_LANE_BLOCK).  Ignored
+        by the XLA body — the facades only thread it on the Pallas
+        path, so the XLA jit cache is not fragmented by a no-op key.
     """
     if kernel == "pallas":
         # The Mosaic path takes trace_impl's exact contract, so the
@@ -657,6 +665,7 @@ def trace_impl(
             conv_state=conv_state,
             rel_err_target=rel_err_target,
             batch_moves=batch_moves,
+            lane_block=lane_block,
         )
     if kernel != "xla":
         raise ValueError(
@@ -664,6 +673,7 @@ def trace_impl(
             " ('auto' is resolved by the facades via "
             "walk_pallas.select_backend before dispatch)"
         )
+    del lane_block  # a Mosaic block width; no meaning for the XLA body
     dtype = origin.dtype
     ntet = mesh.tet2tet.shape[0]
     n = origin.shape[0]
@@ -1365,6 +1375,7 @@ _trace_jit = jax.jit(
         "rel_err_target",
         "batch_moves",
         "kernel",
+        "lane_block",
     ),
     # conv_state's batch accumulators are carried exactly like the flux:
     # donated in, fresh buffers out (None → no leaves, no donation).
@@ -1454,6 +1465,7 @@ _trace_packed_jit = jax.jit(
         "rel_err_target",
         "batch_moves",
         "kernel",
+        "lane_block",
     ),
     # The flux carry is donated exactly like the unpacked trace — a
     # supervisor retry re-sees its original inputs because the facade

@@ -132,6 +132,8 @@ def select_backend(
     packed: bool,
     platform: str | None = None,
     strict: bool = True,
+    lane_block: int | None = None,
+    tuned_kernel: str | None = None,
 ) -> str:
     """Resolve the (already env-resolved, combo-validated) kernel knob
     against a concrete workload → ``"xla"`` or ``"pallas"``.
@@ -148,7 +150,20 @@ def select_backend(
     mode off TPU is fine — the CI sweep's whole point) and silently
     falls back to the XLA walk where it structurally can't, so one env
     var can blanket a whole suite the way ``PUMI_TPU_IO_PIPELINE``
-    does."""
+    does.
+
+    ``lane_block`` is the RESOLVED one-hot block width (TallyConfig
+    ``resolve_lane_block``; None = the kernel default) — the VMEM
+    budget is checked against the block that will actually run, so a
+    wide explicit block counts against ``PUMI_TPU_PALLAS_VMEM_MB``
+    instead of the hardcoded default.  ``tuned_kernel`` is the tuning
+    database's winner for this shape class (tuning/db.py) and steers
+    ONLY the "auto" policy: a database "xla" pins the XLA walk where
+    the heuristic would have picked Pallas, a database "pallas" picks
+    the kernel wherever it is structurally able to run — and the
+    structural gates (packed table, VMEM budget, platform/interpret)
+    still apply, so a stale database can never force an infeasible
+    kernel.  Explicit "xla"/"pallas" never consult it."""
     if kernel == "xla":
         return "xla"
     if kernel not in ("pallas", "auto"):
@@ -156,7 +171,10 @@ def select_backend(
             f"kernel must be 'xla', 'pallas' or 'auto': {kernel!r}"
         )
     itemsize = jnp.dtype(dtype).itemsize
-    need = kernel_vmem_bytes(ntet, n_particles, n_groups, itemsize)
+    need = kernel_vmem_bytes(
+        ntet, n_particles, n_groups, itemsize,
+        lane_block=lane_block or DEFAULT_LANE_BLOCK,
+    )
     budget = _budget_bytes()
     if kernel == "pallas":
         if not packed:
@@ -186,6 +204,10 @@ def select_backend(
     interpret_ok = os.environ.get("PUMI_TPU_PALLAS_INTERPRET") == "1"
     if not packed or need > budget:
         return "xla"
+    if tuned_kernel == "xla":
+        # The database measured the XLA walk faster for this shape
+        # class — it overrides the in-regime heuristic, not the gates.
+        return "xla"
     if platform != "tpu" and not interpret_ok:
         return "xla"
     return "pallas"
@@ -200,6 +222,8 @@ def resolve_config_kernel(
     dtype,
     packed: bool,
     platform: str | None = None,
+    lane_block: int | None = None,
+    tuned=None,
 ) -> str:
     """The ONE facade-side kernel resolve: config half
     (``TallyConfig.resolve_kernel`` — combo validation, env override),
@@ -208,7 +232,13 @@ def resolve_config_kernel(
     strictness derived from whether "pallas" is written INTO the config
     (an env-forced "pallas" degrades gracefully).  PumiTally and
     StreamingTallyPipeline both call this, so the downgrade list cannot
-    drift between facades."""
+    drift between facades.
+
+    ``lane_block`` is the resolved block width (feeds the VMEM budget
+    check); ``tuned`` is the construction-time tuning decision
+    (tuning.TunedDecision or None) whose ``kernel`` winner steers the
+    "auto" policy only — an explicit config/env kernel always beats the
+    database."""
     kern = cfg.resolve_kernel()
     if kern == "xla":
         return "xla"
@@ -225,6 +255,10 @@ def resolve_config_kernel(
         packed=packed,
         platform=platform,
         strict=cfg.kernel == "pallas",
+        lane_block=lane_block,
+        tuned_kernel=(
+            tuned.kernel if tuned is not None and tuned.hit else None
+        ),
     )
 
 

@@ -203,6 +203,28 @@ class PartitionedTally:
         # config-explicit kernel="pallas" is rejected NOW, at
         # construction, with the single-chip alternative named — never
         # mid-dispatch.
+        # Autotuning database (tuning/): the partitioned walk never
+        # rides the Mosaic kernel (no geo20 packing in the halo
+        # layout, hence packed=False in the shape class), so the only
+        # knob the database can steer here is megastep K — consulted
+        # once, at construction, and only when neither the env nor the
+        # config pinned one. Explicit knobs beat the database; a miss
+        # changes nothing. NOTE: scripts/tune.py's current specs all
+        # tune single-chip packed workloads, so unpacked entries only
+        # exist when written deliberately (tests do; a partitioned
+        # tuner rung is future work alongside the ROADMAP pod-scale
+        # item) — until then this consult is armed plumbing that
+        # resolves to a miss.
+        from ..tuning import resolve_tuned
+
+        self._tuned = resolve_tuned(
+            self.config,
+            ntet=mesh.ntet,
+            n_particles=self.num_particles,
+            n_groups=self.config.n_groups,
+            dtype=self.config.dtype,
+            packed=False,
+        )
         self._kernel_policy = self.config.resolve_kernel()
         if self._kernel_policy == "pallas" and self.config.kernel == "pallas":
             raise ValueError(
@@ -1140,8 +1162,10 @@ class PartitionedTally:
         cfg = self.config
         # Feature combos the fused program cannot carry fail at RESOLVE
         # time (utils/config.resolve_megastep: record_xpoints /
-        # checkify_invariants), before any staging or dispatch.
-        K = cfg.resolve_megastep()
+        # checkify_invariants), before any staging or dispatch. The
+        # tuning database's K applies only when neither the env nor
+        # the config pinned one (bitwise identical for any K).
+        K = cfg.resolve_megastep(tuned=self._tuned)
         from ..ops import staging
         from ..ops.source import SourceParams, phys_to_dict
 

@@ -228,7 +228,13 @@ class ResilientRunner:
         rebuilds. ``weights``/``groups``/``alive`` re-stage on the
         FIRST chunk only; later chunks continue from device state,
         exactly like the facade's own internal chunking."""
-        k = self.tally.config.resolve_megastep()
+        # The same tuned K the facade will use (the tally consulted the
+        # tuning database at construction) — keeps the supervisor's
+        # checkpoint-between-dispatches chunking aligned with the
+        # facade's own fused-dispatch size.
+        k = self.tally.config.resolve_megastep(
+            tuned=getattr(self.tally, "_tuned", None)
+        )
         totals = {
             "moves": 0, "segments": 0, "collisions": 0, "escaped": 0,
             "rouletted": 0, "absorbed_weight": 0.0, "alive": 0,

@@ -1,0 +1,26 @@
+"""Shape-class kernel autotuning (ROADMAP item 1).
+
+The move loop's performance knobs — walk-kernel backend (xla/pallas),
+the Pallas one-hot ``lane_block``, megastep K — are searched per padded
+(ntet, n_particles, n_groups, dtype, packed) shape class by
+``tuning/search.py`` (driven by ``scripts/tune.py``), parity-gated
+bitwise against the reference XLA walk, and persisted into an
+environment-keyed ``TUNING.json`` (``tuning/db.py``) that the facades
+consult once at construction via :func:`resolve_tuned`.  Explicit
+config knobs and env overrides always beat the database; a miss falls
+back to today's defaults.
+"""
+from .db import (  # noqa: F401
+    TUNING_FILE,
+    TUNING_SCHEMA,
+    TunedDecision,
+    TuningDB,
+    empty_db,
+    env_key,
+    environment,
+    load_tuning,
+    lookup_tuned,
+    resolve_tuned,
+    write_tuning,
+)
+from .shapes import PAD_FLOOR, ShapeClass, bucket, classify  # noqa: F401

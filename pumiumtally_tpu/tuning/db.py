@@ -1,0 +1,247 @@
+"""The persisted tuning database (``TUNING.json``).
+
+One committed JSON file holds the autotuner's winners per shape class
+(tuning/shapes.py), grouped into *environment sections* exactly like
+the contract captures: a decision measured on a TPU backend means
+nothing on CPU, so every section is keyed by the pinned
+``{backend, x64, n_devices}`` environment (analysis/contracts
+``environment()``) and consumption REFUSES a database that has no
+section for the current environment — the same cross-environment
+refusal ``CONTRACTS.json`` / ``PERF_CONTRACTS.json`` enforce on their
+diffs.  A schema-version mismatch is refused the same way (the file
+outlives the code that wrote it).
+
+Layout::
+
+  {
+    "schema": 1,
+    "environments": {
+      "cpu-x64off-d1": {
+        "environment": {"backend": "cpu", "x64": false, "n_devices": 1},
+        "mode": "rehearsal" | "hardware",
+        "entries": {
+          "<shape key>": {
+            "kernel": "xla" | "pallas",
+            "lane_block": 128 | null,
+            "megastep": 16,
+            "candidates": [... every measured candidate, parity verdicts
+                           and median timings included ...],
+            "calibration": {"flops_per_s": ..., "bytes_per_s": ..., ...}
+          }
+        }
+      }
+    }
+  }
+
+Consumption happens once, at facade construction
+(``tuning.resolve_tuned``): a hit hands the construction-time resolves
+(``resolve_config_kernel`` / ``select_backend`` /
+``TallyConfig.resolve_megastep`` / ``resolve_lane_block``) the
+database's winners; a miss — no entry for the shape class — falls back
+to today's defaults, so behavior without a database is byte-identical
+to a build without this module.  Explicit config knobs and env
+overrides always beat the database (utils/config.py documents the full
+precedence).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+
+TUNING_SCHEMA = 1
+TUNING_FILE = "TUNING.json"
+
+
+def environment() -> dict:
+    """The pinned consumption environment — same contract as the
+    analysis layers' captures."""
+    from ..analysis.contracts import environment as _env
+
+    return _env()
+
+
+def env_key(env: dict) -> str:
+    """Canonical section key, e.g. ``cpu-x64off-d1`` / ``tpu-x64off-d4``."""
+    return (
+        f"{env['backend']}-x64{'on' if env['x64'] else 'off'}"
+        f"-d{env['n_devices']}"
+    )
+
+
+def empty_db() -> dict:
+    return {"schema": TUNING_SCHEMA, "environments": {}}
+
+
+class TuningDB:
+    """Parsed database + the section matching one environment."""
+
+    def __init__(self, data: dict, path: str | None = None):
+        if not isinstance(data, dict) or "schema" not in data:
+            raise ValueError(
+                f"tuning database {path or '<dict>'} has no schema "
+                "field — not a TUNING.json capture"
+            )
+        if data["schema"] != TUNING_SCHEMA:
+            raise ValueError(
+                f"tuning database {path or '<dict>'} has schema "
+                f"{data['schema']!r}, this build consumes schema "
+                f"{TUNING_SCHEMA} — regenerate it with scripts/tune.py"
+            )
+        self.data = data
+        self.path = path
+
+    @property
+    def environments(self) -> dict:
+        return self.data.get("environments", {})
+
+    def section(self, env: dict | None = None, *, strict: bool = True):
+        """The section for ``env`` (default: the current environment).
+
+        ``strict`` raises on a cross-environment database — a file that
+        has sections but none for this environment; an EMPTY database
+        (no sections at all) is not an error, it is all-miss."""
+        env = env or environment()
+        sec = self.environments.get(env_key(env))
+        if sec is not None:
+            if sec.get("environment") != env:
+                raise ValueError(
+                    f"tuning database {self.path or '<dict>'} section "
+                    f"{env_key(env)!r} records environment "
+                    f"{sec.get('environment')} but the current "
+                    f"environment is {env} — the section key and its "
+                    "pinned environment drifted; regenerate with "
+                    "scripts/tune.py"
+                )
+            return sec
+        if strict and self.environments:
+            raise ValueError(
+                f"tuning database {self.path or '<dict>'} has no "
+                f"section for the current environment {env} "
+                f"(sections: {sorted(self.environments)}) — tuning "
+                "decisions do not transfer across backends; re-tune "
+                "with scripts/tune.py or set PUMI_TPU_TUNING=off"
+            )
+        return None
+
+    def lookup(self, shape, env: dict | None = None) -> dict | None:
+        """The entry for one shape class (None = miss).  ``shape`` is a
+        tuning.shapes.ShapeClass or its ``key()`` string."""
+        sec = self.section(env)
+        if sec is None:
+            return None
+        key = shape if isinstance(shape, str) else shape.key()
+        return sec.get("entries", {}).get(key)
+
+
+def load_tuning(path: str) -> TuningDB:
+    with open(path) as fh:
+        return TuningDB(json.load(fh), path=path)
+
+
+def write_tuning(path: str, data: dict) -> None:
+    with open(path, "w") as fh:
+        json.dump(data, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+
+
+# Facades construct often (every test builds a tally); re-parsing the
+# database each time would put file I/O on the construction path.  The
+# cache is keyed by (path, mtime) so an in-place regeneration by
+# scripts/tune.py is picked up.
+_cache: dict = {}
+_cache_lock = threading.Lock()
+
+
+def cached_tuning(path: str) -> TuningDB:
+    key = (os.path.abspath(path), os.stat(path).st_mtime_ns)
+    with _cache_lock:
+        db = _cache.get(key)
+        if db is None:
+            db = load_tuning(path)
+            # One live generation per path: drop only stale mtimes of
+            # THIS path, so two databases used alternately (a tuned db
+            # and a smoke db in one test process) keep their entries.
+            for stale in [k for k in _cache if k[0] == key[0]]:
+                del _cache[stale]
+            _cache[key] = db
+        return db
+
+
+@dataclasses.dataclass(frozen=True)
+class TunedDecision:
+    """What the database said for one concrete workload — all-None
+    fields mean "no opinion, use the defaults"."""
+
+    path: str | None = None  # database consulted (None: tuning off)
+    key: str | None = None   # shape-class key looked up
+    hit: bool = False
+    kernel: str | None = None      # "xla" | "pallas"
+    lane_block: int | None = None
+    megastep: int | None = None
+
+
+TUNING_OFF = TunedDecision()
+
+
+def resolve_tuned(
+    cfg,
+    *,
+    ntet: int,
+    n_particles: int,
+    n_groups: int,
+    dtype,
+    packed: bool,
+) -> TunedDecision:
+    """The ONE construction-time database consult shared by every
+    facade: resolve the knob (``TallyConfig.resolve_tuning`` — env
+    ``PUMI_TPU_TUNING`` beats the config field, "off"/unset means no
+    database), load + schema/environment-check the file, classify the
+    workload, and return the entry's winners (or an explicit miss).
+
+    Raises on an unreadable/cross-schema/cross-environment database —
+    pointing ``PUMI_TPU_TUNING`` at a file is an explicit request, and
+    silently ignoring it would let a stale TPU database "work" on CPU.
+    """
+    path = cfg.resolve_tuning()
+    if path is None:
+        return TUNING_OFF
+    return lookup_tuned(
+        path,
+        ntet=ntet,
+        n_particles=n_particles,
+        n_groups=n_groups,
+        dtype=dtype,
+        packed=packed,
+    )
+
+
+def lookup_tuned(
+    path: str,
+    *,
+    ntet: int,
+    n_particles: int,
+    n_groups: int,
+    dtype,
+    packed: bool,
+) -> TunedDecision:
+    """``resolve_tuned`` with the database path already resolved
+    (bench.py consults the same way without a TallyConfig)."""
+    from .shapes import classify
+
+    db = cached_tuning(path)
+    shape = classify(ntet, n_particles, n_groups, dtype, packed)
+    entry = db.lookup(shape)
+    if entry is None:
+        return TunedDecision(path=path, key=shape.key(), hit=False)
+    lane = entry.get("lane_block")
+    mega = entry.get("megastep")
+    return TunedDecision(
+        path=path,
+        key=shape.key(),
+        hit=True,
+        kernel=entry.get("kernel"),
+        lane_block=int(lane) if lane else None,
+        megastep=int(mega) if mega else None,
+    )

@@ -1,0 +1,83 @@
+"""Shape-class bucketing for the kernel autotuner.
+
+A tuning decision (walk-kernel backend, Pallas ``lane_block``, megastep
+K) is a property of the *workload shape*, not of one exact particle
+count: the compiled programs themselves are shape-specialized, and the
+performance landscape moves smoothly enough that one measurement per
+padded bucket covers every concrete workload inside it.  This module
+defines the bucketing: concrete ``(ntet, n_particles, n_groups, dtype,
+packed)`` workloads collapse onto a padded power-of-two ladder in the
+two large axes (``ntet``, ``n_particles``) and stay exact in the small
+ones (``n_groups``, dtype, packedness — each changes the program
+structurally, so they never share a bucket).
+
+The same ladder is the natural shape key for the ROADMAP item-3 AOT
+program bank: a request scheduler that buckets jobs by padded shape
+class reuses ``classify``/``ShapeClass.key()`` unchanged.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+# Floor of the padded ladder: everything at-or-below the floor shares
+# one rung (tiny workloads are all dispatch-bound; distinguishing a
+# 12-tet mesh from a 48-tet mesh buys nothing).
+PAD_FLOOR = 64
+
+
+def pow2_ceil(x: int) -> int:
+    """Smallest power of two >= x (>= 1)."""
+    n = 1
+    while n < x:
+        n <<= 1
+    return n
+
+
+def bucket(x: int) -> int:
+    """Pad one ladder axis: power-of-two ceiling, floored at PAD_FLOOR."""
+    return max(PAD_FLOOR, pow2_ceil(max(int(x), 1)))
+
+
+@dataclasses.dataclass(frozen=True)
+class ShapeClass:
+    """One rung of the padded workload ladder.
+
+    ``ntet`` / ``n_particles`` are the PADDED bucket values (power-of-two
+    ceilings), not the concrete workload sizes; ``dtype`` is the
+    canonical numpy name ("float32"/"float64"); ``packed`` records
+    whether the mesh carries the geo20 packed walk table (the Pallas
+    kernel's structural precondition — packed and unpacked workloads
+    can never share a tuning entry)."""
+
+    ntet: int
+    n_particles: int
+    n_groups: int
+    dtype: str
+    packed: bool
+
+    def key(self) -> str:
+        """Stable database key, e.g. ``ntet4096.n8192.g2.float32.packed``."""
+        p = "packed" if self.packed else "unpacked"
+        return (
+            f"ntet{self.ntet}.n{self.n_particles}"
+            f".g{self.n_groups}.{self.dtype}.{p}"
+        )
+
+
+def classify(
+    ntet: int,
+    n_particles: int,
+    n_groups: int,
+    dtype,
+    packed: bool,
+) -> ShapeClass:
+    """Bucket one concrete workload onto the padded ladder."""
+    import numpy as np
+
+    return ShapeClass(
+        ntet=bucket(ntet),
+        n_particles=bucket(n_particles),
+        n_groups=int(n_groups),
+        dtype=np.dtype(dtype).name,
+        packed=bool(packed),
+    )

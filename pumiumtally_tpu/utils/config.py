@@ -273,6 +273,39 @@ class TallyConfig:
         packing to put in VMEM) and rejects an explicit "pallas" at
         construction.
 
+    pallas_lane_block: the Mosaic kernel's one-hot block width B
+        (ops/walk_pallas.py — the [B, ntet] blocked gather / [ntet, B]
+        outer-product tally tile granularity; previously only reachable
+        through the private ``lane_block=`` kwarg on the kernel entry).
+        Validated at resolve time (``resolve_lane_block``): must be a
+        positive power of two; clamped to the batch size; counted into
+        the ``kernel_vmem_bytes`` working set that gates the VMEM
+        budget (a larger block can push a mesh out of the Pallas
+        regime).  Every rung of the ladder is BITWISE identical — the
+        one-hot contraction is exact and the peel order is per-block
+        ascending-lane (tests/test_tuning.py pins the parity) — so the
+        knob is pure scheduling.  Env ``PUMI_TPU_PALLAS_LANE_BLOCK``
+        beats the field.  None (default): the tuning database's winner
+        for the shape class when one is active, else the kernel default
+        (walk_pallas.DEFAULT_LANE_BLOCK = 128).  Ignored by the XLA
+        walk.
+
+    tuning: the autotuning database (tuning/db.py TUNING.json) the
+        facades consult ONCE at construction for the knobs left at
+        their defer values — kernel="auto"'s backend pick, the Pallas
+        lane_block, megastep K.  A path enables it; None (default) and
+        "off" disable it.  Env ``PUMI_TPU_TUNING=off|<path>`` beats the
+        field.  Precedence per knob: an explicitly set knob (env
+        override first, then the config field) always beats the
+        database, and a database miss — no entry for the workload's
+        shape class, or no database at all — falls back to today's
+        defaults, so behavior without a database is byte-identical to
+        a build without the tuning subsystem (every database winner is
+        bitwise parity-gated by scripts/tune.py anyway).  A database
+        captured under a different environment (backend / x64 / device
+        count) or schema version is REFUSED at construction, exactly
+        like CONTRACTS.json refuses cross-environment compares.
+
     megastep: moves fused per dispatch on the DEVICE-SOURCED move loop
         (``run_source_moves`` on both facades; ops/walk.py ``megastep``
         / ops/walk_partitioned.py ``make_partitioned_megastep``).  Each
@@ -340,6 +373,8 @@ class TallyConfig:
     converged_fraction: float = 0.95
     megastep: int | None = None
     kernel: str = "xla"
+    pallas_lane_block: int | None = None
+    tuning: str | None = None
 
     def resolve_kernel(self) -> str:
         """Validate and return the walk-kernel knob ("xla" | "pallas" |
@@ -394,11 +429,60 @@ class TallyConfig:
                 raise ValueError(conflict)
         return kernel
 
-    def resolve_megastep(self) -> int:
+    def resolve_tuning(self) -> str | None:
+        """The effective autotuning-database path (None = tuning off).
+        Env ``PUMI_TPU_TUNING`` beats the field; ``"off"`` (either
+        spelling) disables explicitly.  Pure knob resolution — loading,
+        schema/environment validation and the shape-class lookup live
+        in tuning/db.py ``resolve_tuned``."""
+        env = os.environ.get("PUMI_TPU_TUNING")
+        val = env if env else self.tuning
+        if val in (None, "", "off"):
+            return None
+        return val
+
+    def resolve_lane_block(
+        self, n_particles: int | None = None, *, tuned=None
+    ) -> int | None:
+        """Validate and return the Pallas one-hot block width, or None
+        for "kernel default" (walk_pallas.DEFAULT_LANE_BLOCK).
+
+        Precedence: env ``PUMI_TPU_PALLAS_LANE_BLOCK`` > the
+        ``pallas_lane_block`` field > the tuning database's winner for
+        this shape class (``tuned``, a tuning.TunedDecision) > None.
+        The value must be a positive power of two and is clamped to the
+        batch size when ``n_particles`` is known (the kernel never runs
+        a block wider than the batch); the caller feeds the result into
+        ``select_backend``'s VMEM-budget check, so an oversized block
+        is counted against ``PUMI_TPU_PALLAS_VMEM_MB`` rather than
+        silently spilling."""
+        env = os.environ.get("PUMI_TPU_PALLAS_LANE_BLOCK")
+        if env:
+            lb = int(env)
+        elif self.pallas_lane_block is not None:
+            lb = int(self.pallas_lane_block)
+        elif tuned is not None and tuned.lane_block:
+            lb = int(tuned.lane_block)
+        else:
+            return None
+        if lb < 1 or (lb & (lb - 1)) != 0:
+            raise ValueError(
+                f"pallas_lane_block must be a positive power of two "
+                f"(the one-hot block tiles the MXU): {lb}"
+            )
+        if n_particles is not None:
+            lb = min(lb, max(int(n_particles), 1))
+        return lb
+
+    def resolve_megastep(self, *, tuned=None) -> int:
         """Effective moves-per-dispatch K for the device-sourced move
         loop (``run_source_moves``): the ``PUMI_TPU_MEGASTEP`` env
-        override beats the field; unset means 1 (one dispatch per
-        move).
+        override beats the field, the field beats the tuning database's
+        winner (``tuned``, a tuning.TunedDecision consulted by the
+        facades at construction), and with nothing set K is 1 (one
+        dispatch per move).  Any K is bitwise identical to K=1 — RNG
+        streams are keyed by (seed, move, particle id) — so a database
+        K changes dispatch granularity, never results.
 
         Every ``run_source_moves`` entry point resolves the knob FIRST,
         so feature combos the fused megastep program cannot carry fail
@@ -411,6 +495,8 @@ class TallyConfig:
             k = int(env)
         elif self.megastep is not None:
             k = int(self.megastep)
+        elif tuned is not None and tuned.megastep:
+            k = int(tuned.megastep)
         else:
             k = 1
         if k < 1:

@@ -1,17 +1,24 @@
 #!/usr/bin/env python
-"""Pretty-print the delta between two PERF_CONTRACTS.json captures.
+"""Pretty-print the delta between two PERF_CONTRACTS.json captures,
+or a TUNING.json tuned-vs-default table.
 
   python scripts/perfdiff.py OLD.json NEW.json
   python scripts/perfdiff.py --all OLD.json NEW.json   # unchanged rows too
   git show main:PERF_CONTRACTS.json > /tmp/old.json && \\
       python scripts/perfdiff.py /tmp/old.json PERF_CONTRACTS.json
+  python scripts/perfdiff.py --tuning TUNING.json      # autotuner table
 
 One row per (family, metric): old -> new with the % change, plus the
 scaling-exponent and normalized-cost deltas — paste the table into the
 PR description whenever a PR regenerates PERF_CONTRACTS.json with
 ``scripts/lint.py --write-perf-contracts`` so reviewers see exactly
-which resource moved and by how much.  Purely textual: no jax import,
-no compile, safe anywhere.
+which resource moved and by how much.  ``--tuning`` renders the
+autotuner database instead: per (environment, shape class, axis) the
+DEFAULT candidate (xla walk / megastep 1) against the tuned winner with
+the measured speedup and the fitted calibration coefficients — the
+tune-and-commit capture workflow pastes this table into the PR that
+regenerates TUNING.json.  Purely textual: no jax import, no compile,
+safe anywhere.
 """
 import argparse
 import json
@@ -60,13 +67,124 @@ def _pct(old, new):
     return f"{100.0 * (new - old) / abs(old):+.1f}%"
 
 
+def _print_table(headers, table):
+    widths = [
+        max(len(headers[i]), *(len(r[i]) for r in table))
+        for i in range(len(headers))
+    ]
+    print("  ".join(h.ljust(w) for h, w in zip(headers, widths)))
+    print("  ".join("-" * w for w in widths))
+    for r in table:
+        print("  ".join(c.ljust(w) for c, w in zip(r, widths)))
+
+
+def _candidate_name(c):
+    if c["kind"] == "kernel":
+        lb = c.get("lane_block")
+        return c["kernel"] + (f"@{lb}" if lb else "")
+    return f"K={c['megastep']}"
+
+
+def tuning_table(path) -> int:
+    """The tuned-vs-default table: per (env section, shape class, axis)
+    what the default candidate measured, what the winner measured, and
+    the speedup — plus parity-failure and calibration summaries."""
+    with open(path) as fh:
+        db = json.load(fh)
+    rows = []
+    failed = []
+    for ekey, sec in sorted(db.get("environments", {}).items()):
+        for skey, entry in sorted(sec.get("entries", {}).items()):
+            cands = entry.get("candidates", [])
+            for axis, default_of, win_name in (
+                ("kernel",
+                 lambda c: c["kind"] == "kernel" and c["kernel"] == "xla",
+                 entry.get("kernel", "xla")
+                 + (f"@{entry['lane_block']}" if entry.get("lane_block")
+                    else "")),
+                ("megastep",
+                 lambda c: c["kind"] == "megastep" and c["megastep"] == 1,
+                 f"K={entry.get('megastep', 1)}"),
+            ):
+                axis_cands = [c for c in cands if c["kind"] == axis]
+                if not axis_cands:
+                    continue
+                default = next(
+                    (c for c in axis_cands if default_of(c)), None
+                )
+                winner = next(
+                    (c for c in axis_cands
+                     if _candidate_name(c) == win_name), None
+                )
+                d_s = default and default.get("median_s_per_move")
+                w_s = winner and winner.get("median_s_per_move")
+                speed = (
+                    f"{d_s / w_s:.2f}x" if d_s and w_s else "-"
+                )
+                rows.append((
+                    ekey, skey, axis,
+                    _candidate_name(default) if default else "-",
+                    _fmt(d_s), win_name, _fmt(w_s), speed,
+                ))
+            failed += [
+                (ekey, skey, _candidate_name(c))
+                for c in cands if c.get("parity") != "bitwise"
+            ]
+    if not rows:
+        print(f"{path}: no tuning entries")
+        return 0
+    _print_table(
+        ("env", "shape class", "axis", "default", "default s/move",
+         "tuned", "tuned s/move", "speedup"),
+        [tuple(map(str, r)) for r in rows],
+    )
+    mode = {
+        ekey: sec.get("mode", "?")
+        for ekey, sec in db.get("environments", {}).items()
+    }
+    print(f"\nsection modes: {mode} (rehearsal timings are CPU/"
+          "interpret rehearsals — machinery proof, not hardware "
+          "numbers)")
+    if failed:
+        print(f"{len(failed)} candidate(s) FAILED the bitwise parity "
+              "gate (excluded from winning):")
+        for ekey, skey, name in failed:
+            print(f"  {ekey} {skey}: {name}")
+    cal = [
+        (ekey, skey,
+         entry.get("calibration") or {})
+        for ekey, sec in sorted(db.get("environments", {}).items())
+        for skey, entry in sorted(sec.get("entries", {}).items())
+    ]
+    print("\ncalibration (fitted effective coefficients per shape "
+          "class):")
+    for ekey, skey, c in cal:
+        f = c.get("flops_per_s")
+        b = c.get("bytes_per_s")
+        print(
+            f"  {ekey} {skey}: "
+            f"flops_per_s={f and f'{f:.3g}'} "
+            f"bytes_per_s={b and f'{b:.3g}'} "
+            f"rmse_s={_fmt(c.get('rmse_s'))} over "
+            f"{c.get('points', 0)} point(s)"
+        )
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("old")
-    ap.add_argument("new")
+    ap.add_argument("old", nargs="?")
+    ap.add_argument("new", nargs="?")
     ap.add_argument("--all", action="store_true",
                     help="print unchanged rows too")
+    ap.add_argument("--tuning", metavar="TUNING_JSON",
+                    help="render the autotuner tuned-vs-default table "
+                         "instead of a capture diff")
     args = ap.parse_args()
+    if args.tuning:
+        return tuning_table(args.tuning)
+    if not args.old or not args.new:
+        ap.error("need OLD.json NEW.json (or --tuning TUNING.json)")
     with open(args.old) as fh:
         old = json.load(fh)
     with open(args.new) as fh:
@@ -89,20 +207,13 @@ def main() -> int:
     if not rows:
         print("no per-family deltas")
         return 0
-    headers = ("family", "metric", "old", "new", "delta")
-    table = [
-        (fam, metric, _fmt(vo), _fmt(vn), _pct(vo, vn))
-        for fam, metric, vo, vn in rows
-    ]
-    widths = [
-        max(len(headers[i]), *(len(r[i]) for r in table))
-        for i in range(5)
-    ]
-    line = "  ".join(h.ljust(w) for h, w in zip(headers, widths))
-    print(line)
-    print("  ".join("-" * w for w in widths))
-    for r in table:
-        print("  ".join(c.ljust(w) for c, w in zip(r, widths)))
+    _print_table(
+        ("family", "metric", "old", "new", "delta"),
+        [
+            (fam, metric, _fmt(vo), _fmt(vn), _pct(vo, vn))
+            for fam, metric, vo, vn in rows
+        ],
+    )
     changed = sum(1 for _, _, vo, vn in rows if vo != vn)
     print(f"\n{changed} changed value(s) across "
           f"{len({r[0] for r in rows})} family(ies)")

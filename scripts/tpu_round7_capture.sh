@@ -1,0 +1,96 @@
+#!/bin/bash
+# Round-7 TPU capture: ONE COMMAND = tune-and-commit + tuned re-measure.
+# The round-6 rows (megastep headline + pallas-vs-xla A/B) are still
+# unmeasured on hardware; this window FIRST runs the shape-class
+# autotuner on the headline shape classes and persists the winners
+# (TUNING.json — commit the diff), THEN re-runs the round-6 headline
+# and the kernel A/B under the tuned database, so the capture both
+# regenerates the database and prices its decisions in the same window.
+#
+#   1. Autotune: scripts/tune.py on {smoke1, smoke2, ab12, ab14,
+#      headline} — kernel backend x lane_block ladder x megastep K per
+#      shape class, every candidate bitwise-parity-gated, winners +
+#      measured timings + fitted calibration coefficients merged into
+#      TUNING.json under THIS environment's section (the committed CPU
+#      smoke section is preserved; commit the diff).
+#   2. Render the tuned-vs-default table (scripts/perfdiff.py
+#      --tuning) for the PR description.
+#   3. Headline + megastep/event rows under the tuned database
+#      (PUMI_TPU_TUNING=TUNING.json, BENCH_KERNEL=auto so the
+#      database's kernel winner steers the backend) — paired with an
+#      UNTUNED control row (tuning off, today's defaults), same
+#      workload, so the tuned-vs-default delta is measured in-window.
+#   4. Round-6 pallas-vs-xla A/B rungs re-run under the tuned
+#      database's lane_block (BENCH_KERNEL still pinned per row — the
+#      kernel axis stays one-delta; the database contributes the block
+#      width).
+#
+# Runs end-to-end on CPU too (CAPTURE_CPU_REHEARSAL=1): the tuner runs
+# in --rehearsal mode (interpret-mode Pallas, deterministic
+# model-ranked winners) and the bench rows come back tagged
+# backend="cpu" — the whole tune-and-commit pipeline is armed and
+# verified before a device window ever opens.
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p bench_out
+export JAX_COMPILATION_CACHE_DIR="$PWD/.jax_cache"
+
+run() {
+  name="$1"; shift
+  for attempt in 1 2; do
+    echo "=== $name (attempt $attempt): $* ==="
+    timeout "${CAPTURE_TIMEOUT:-2400}" "$@" \
+      >"bench_out/$name.out" 2>"bench_out/$name.err"
+    rc=$?
+    echo "rc=$rc ($name)"
+    tail -3 "bench_out/$name.out" 2>/dev/null
+    [ "$rc" -eq 0 ] && break
+  done
+}
+
+if [ "${CAPTURE_CPU_REHEARSAL:-0}" = "1" ]; then
+  export PUMI_FORCE_CPU=1 BENCH_PROBE=0
+  export PUMI_TPU_PALLAS_INTERPRET=1
+  TUNE_ARGS="--rehearsal --shapes smoke1,smoke2 --moves 2 --reps 2 --mega-moves 4"
+  HEAD_ARGS="BENCH_CELLS=12 BENCH_PARTICLES=16384 BENCH_STEPS=3"
+  AB_SMALL="BENCH_CELLS=6 BENCH_PARTICLES=512 BENCH_STEPS=2"
+  EVENT="BENCH_EVENT=1 BENCH_EVENT_PARTICLES=4096 BENCH_EVENT_MOVES=2 BENCH_MEGASTEP=2"
+else
+  # Hardware: tune the A/B rungs + the headline class on measured
+  # medians (the tuner's VMEM clamp drops lane_block rungs the budget
+  # cannot hold; ab14 needs the round-6 12 MiB budget to have any
+  # Pallas candidates at all).
+  export PUMI_TPU_PALLAS_VMEM_MB="${PUMI_TPU_PALLAS_VMEM_MB:-12}"
+  TUNE_ARGS="--shapes smoke1,smoke2,ab12,ab14,headline"
+  HEAD_ARGS="BENCH_CELLS=55 BENCH_PARTICLES=1048576 BENCH_STEPS=10"
+  AB_SMALL="BENCH_CELLS=12 BENCH_PARTICLES=8192 BENCH_STEPS=10"
+  EVENT="BENCH_EVENT=1 BENCH_EVENT_MOVES=8 BENCH_MEGASTEP=8"
+fi
+
+# 1: tune-and-commit — the window's first act. TUNING.json gains (or
+# refreshes) this environment's section; `git diff TUNING.json` is the
+# commit-ready artifact.
+CAPTURE_TIMEOUT=7200 run tune_r7 env python scripts/tune.py $TUNE_ARGS --out TUNING.json
+
+# 2: the PR-description table.
+run tuning_table_r7 python scripts/perfdiff.py --tuning TUNING.json
+cp bench_out/tuning_table_r7.out bench_out/TUNING_TABLE_r07.txt 2>/dev/null
+
+# 3: headline under the tuned database vs the untuned control (one
+# knob delta: PUMI_TPU_TUNING).
+run bench_r7_headline_tuned env $HEAD_ARGS $EVENT BENCH_REPEAT=2 \
+    PUMI_TPU_TUNING=TUNING.json BENCH_KERNEL=auto python bench.py
+run bench_r7_headline_control env $HEAD_ARGS $EVENT BENCH_REPEAT=2 \
+    PUMI_TPU_TUNING=off python bench.py
+
+# 4: the round-6 kernel A/B re-run under the tuned database — the
+# kernel axis stays pinned per row (one delta), the database supplies
+# the tuned lane_block to the pallas row.
+run bench_r7_ab_xla env $AB_SMALL BENCH_EVENT=0 BENCH_REPEAT=2 \
+    BENCH_GROUPS=2 BENCH_KERNEL=xla PUMI_TPU_TUNING=TUNING.json \
+    python bench.py
+run bench_r7_ab_pallas env $AB_SMALL BENCH_EVENT=0 BENCH_REPEAT=2 \
+    BENCH_GROUPS=2 BENCH_KERNEL=pallas PUMI_TPU_TUNING=TUNING.json \
+    python bench.py
+
+echo "=== round-7 rows complete; commit the TUNING.json diff ==="

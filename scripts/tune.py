@@ -1,0 +1,199 @@
+#!/usr/bin/env python
+"""Shape-class kernel autotuner: search, persist, check (ROADMAP item 1).
+
+  python scripts/tune.py                          # default shape set
+  python scripts/tune.py --shapes smoke1,smoke2   # named shape classes
+  python scripts/tune.py --rehearsal              # CPU/interpret mode:
+                                                  # deterministic model
+                                                  # ranking, no hardware
+  python scripts/tune.py --out TUNING.json        # where to persist
+  python scripts/tune.py --rehearsal --shapes smoke1,smoke2 \\
+      --check TUNING.json                         # CI drift gate: tune,
+                                                  # compare winners +
+                                                  # schema + env section
+                                                  # against the committed
+                                                  # database, exit 1 on
+                                                  # drift, write nothing
+
+Per shape class (pumiumtally_tpu/tuning/shapes.py) the driver times the
+real jitted programs across the candidate grid — kernel backend
+{xla, pallas}, Pallas lane_block ladder {64, 128, 256, 512} clamped by
+the kernel_vmem_bytes VMEM budget, megastep K {1, 4, 16, 64} — with
+warmup/median-of-N discipline, gates every candidate on BITWISE parity
+against the reference XLA walk, fits per-shape-class effective
+throughput/bandwidth coefficients from the measured timings
+(analysis/costmodel.calibrate_points), and merges the winners into the
+environment-keyed TUNING.json the facades consume at construction
+(tuning/db.py).  Entries for shape classes not tuned in this run are
+preserved; other environments' sections are never touched — a TPU
+window adds a tpu section next to the committed CPU smoke section.
+
+On hardware, winners are the measured medians (with a small tie band
+broken toward today's defaults).  ``--rehearsal`` pins the CPU backend
++ Pallas interpret mode and ranks by the PR 9 cost model's predicted
+seconds instead — interpret-mode wall clock says nothing about TPU —
+which is what makes the rehearsal winners deterministic across fresh
+processes (the CI gate depends on it).  Timings are still measured and
+recorded either way (the calibration join needs them).
+"""
+import argparse
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "--shapes", default="smoke1,smoke2",
+        help="comma-separated shape-class names (tuning/search.py "
+             "SPECS) or name=cells:n_particles:n_groups overrides",
+    )
+    ap.add_argument("--out", default=os.path.join(ROOT, "TUNING.json"))
+    ap.add_argument(
+        "--check", metavar="DB",
+        help="tune, then compare winners/schema/environment against "
+             "this committed database and exit 1 on drift (writes "
+             "nothing)",
+    )
+    ap.add_argument(
+        "--rehearsal", action="store_true",
+        help="CPU/interpret rehearsal: pin JAX_PLATFORMS=cpu + "
+             "PUMI_TPU_PALLAS_INTERPRET=1 and rank candidates by the "
+             "cost model's predicted seconds (deterministic winners)",
+    )
+    ap.add_argument("--reps", type=int, default=None,
+                    help="timed repetitions per candidate "
+                         "(median-of-N; default 5, rehearsal 2)")
+    ap.add_argument("--moves", type=int, default=None,
+                    help="moves per kernel-candidate chain (default 4, "
+                         "rehearsal 2)")
+    ap.add_argument("--mega-moves", type=int, default=None,
+                    help="device-sourced moves for the megastep "
+                         "parity/timing runs; clamps the K ladder "
+                         "(default 64, rehearsal 4)")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    if args.rehearsal:
+        # Pin BEFORE jax import: the canonical rehearsal environment is
+        # cpu / x64-off / interpret-mode Pallas — the committed smoke
+        # database's section key.
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ.pop("JAX_ENABLE_X64", None)
+        os.environ["PUMI_TPU_PALLAS_INTERPRET"] = "1"
+    # Knob env overrides must not steer the programs being tuned: with
+    # PUMI_TPU_MEGASTEP=4 exported (the established sweep idiom) every
+    # megastep candidate would silently run at K=4 and the committed
+    # winner would be meaningless; same for the kernel/lane_block
+    # sweeps and a stale tuning database.
+    for var in ("PUMI_TPU_TUNING", "PUMI_TPU_MEGASTEP",
+                "PUMI_TPU_KERNEL", "PUMI_TPU_PALLAS_LANE_BLOCK"):
+        os.environ.pop(var, None)
+
+    from pumiumtally_tpu.tuning import search
+    from pumiumtally_tpu.tuning.db import load_tuning, write_tuning
+    from pumiumtally_tpu.tuning.search import SPECS, tune, winners
+
+    mode = "rehearsal" if args.rehearsal else "hardware"
+    reps = args.reps if args.reps is not None else (2 if args.rehearsal else 5)
+    moves = args.moves if args.moves is not None else (2 if args.rehearsal else 4)
+    mega = args.mega_moves if args.mega_moves is not None else (
+        4 if args.rehearsal else 64
+    )
+
+    specs = {}
+    for tok in args.shapes.split(","):
+        tok = tok.strip()
+        if not tok:
+            continue
+        if "=" in tok:
+            name, rest = tok.split("=", 1)
+            cells, n, g = (int(x) for x in rest.split(":"))
+            specs[name] = dict(cells=cells, n_particles=n, n_groups=g)
+        elif tok in SPECS:
+            specs[tok] = SPECS[tok]
+        else:
+            ap.error(
+                f"unknown shape class {tok!r}; known: "
+                f"{sorted(SPECS)} (or name=cells:n:groups)"
+            )
+
+    base = None
+    if os.path.exists(args.out) and not args.check:
+        base = load_tuning(args.out).data
+
+    def progress(msg):
+        print(f"[tune] {msg}", file=sys.stderr)
+
+    data = tune(
+        specs, mode=mode, reps=reps, moves=moves, mega_moves=mega,
+        seed=args.seed, base=base, progress=progress,
+    )
+
+    if args.check:
+        fresh = winners(data)
+        drift = []
+        committed = None
+        try:
+            # Schema-checked on load; a bumped schema is DRIFT (report
+            # + exit 1 with the regeneration command), not a crash.
+            committed = load_tuning(args.check)
+        except (ValueError, OSError, json.JSONDecodeError) as e:
+            drift.append(f"unusable database: {e}")
+        if committed is not None:
+            try:
+                if committed.section(strict=True) is None:
+                    drift.append(
+                        "no section for the current environment "
+                        f"{search.environment()} (empty database)"
+                    )
+            except ValueError as e:
+                # Cross-environment database: also drift, not a crash.
+                drift.append(str(e))
+            # Only the keys this run tuned are compared — the committed
+            # database may carry more shape classes (and other envs).
+            old = {
+                k: v for k, v in winners(committed.data).items()
+                if k in fresh
+            }
+            for k in sorted(fresh):
+                if k not in old:
+                    drift.append(f"{k}: missing from {args.check}")
+                elif old[k] != fresh[k]:
+                    drift.append(
+                        f"{k}: committed winners {old[k]} != fresh "
+                        f"{fresh[k]}"
+                    )
+        if drift:
+            print(f"tuning drift against {args.check}:")
+            for d in drift:
+                print(f"  {d}")
+            print(
+                "regenerate with: python scripts/tune.py"
+                + (" --rehearsal" if args.rehearsal else "")
+                + f" --shapes {args.shapes} --out {args.check}"
+            )
+            return 1
+        print(
+            f"tuning check clean: {len(fresh)} shape class(es) match "
+            f"{args.check}"
+        )
+        return 0
+
+    write_tuning(args.out, data)
+    for key, win in sorted(winners(data).items()):
+        print(f"{key}: kernel={win[0]} lane_block={win[1]} megastep={win[2]}")
+    print(f"wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except (ValueError, json.JSONDecodeError) as e:
+        print(f"tune error: {e}", file=sys.stderr)
+        sys.exit(2)

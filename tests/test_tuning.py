@@ -1,0 +1,582 @@
+"""Shape-class autotuner contracts (pumiumtally_tpu/tuning/, the
+round-7 tentpole).
+
+Contracts pinned here:
+
+  * DATABASE — round-trip, schema-version refusal, environment-keyed
+    sections with cross-environment refusal (exactly CONTRACTS.json's
+    rule), miss semantics.
+  * CONSUMPTION — facade construction consumes a synthetic database
+    (kernel="auto" picks the winner, lane_block and megastep K follow),
+    explicit config knobs and env overrides always beat it, and a miss
+    (or an empty database) leaves every resolve at today's defaults.
+  * BYTE-IDENTITY — with no database / an empty database the facade's
+    outputs are bitwise identical to a tuned run (every winner is
+    parity-gated, and the knobs are pure scheduling), pinned on real
+    multi-move facade runs.
+  * PARITY GATE — a deliberately corrupted candidate (one-ULP flux
+    perturbation through the PUMI_TPU_TUNE_FAULT hook) is recorded
+    with parity="failed" and can never win.
+  * DETERMINISM — scripts/tune.py --rehearsal reproduces identical
+    winners across two fresh processes (the model-ranked rehearsal
+    mode), proven through the CLI's --check gate; a tampered winner is
+    drift (exit 1).
+  * LANE_BLOCK LADDER — every block width is bitwise identical to
+    DEFAULT_LANE_BLOCK (the knob is scheduling, never results).
+  * CALIBRATION — costmodel.calibrate_points recovers known
+    coefficients and predict_seconds composes with them.
+
+Compile budget: the fast core (-m 'not slow') keeps only the
+no-compile database/resolve tests; everything that compiles or
+subprocesses is marked slow and runs in the dedicated CI tuning step.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from pumiumtally_tpu import PumiTally, TallyConfig, build_box
+from pumiumtally_tpu.analysis.costmodel import (
+    NOMINAL_COEFFS,
+    calibrate_points,
+    predict_seconds,
+)
+from pumiumtally_tpu.tuning import (
+    TUNING_SCHEMA,
+    ShapeClass,
+    TunedDecision,
+    bucket,
+    classify,
+    empty_db,
+    env_key,
+    environment,
+    load_tuning,
+    lookup_tuned,
+    write_tuning,
+)
+from pumiumtally_tpu.tuning import search
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _synthetic_db(path, entries, env=None, mode="rehearsal"):
+    env = env or environment()
+    data = empty_db()
+    data["environments"][env_key(env)] = {
+        "environment": env,
+        "mode": mode,
+        "entries": entries,
+    }
+    write_tuning(str(path), data)
+    return str(path)
+
+
+def _mesh(cells=2, dtype=jnp.float32):
+    return build_box(1.0, 1.0, 1.0, cells, cells, cells, dtype=dtype)
+
+
+def _seeded(mesh, n, seed=3):
+    rng = np.random.default_rng(seed)
+    elem = rng.integers(0, mesh.ntet, n).astype(np.int32)
+    pos0 = np.asarray(mesh.centroids())[elem].astype(np.float64)
+    return pos0
+
+
+def _run_moves(mesh, n, cfg, moves=3, seed=11):
+    t = PumiTally(mesh, n, cfg)
+    t.initialize_particle_location(_seeded(mesh, n).reshape(-1).copy())
+    prev = _seeded(mesh, n)
+    for i in range(moves):
+        rng = np.random.default_rng(seed + i)
+        d = rng.normal(0, 1, (n, 3))
+        d /= np.linalg.norm(d, axis=1, keepdims=True)
+        dest = np.clip(prev + d * 0.1, 0.01, 0.99)
+        buf = dest.reshape(-1).copy()
+        t.move_to_next_location(
+            buf, np.ones(n, np.int8), np.ones(n),
+            np.zeros(n, np.int32), np.full(n, -1, np.int32),
+        )
+        prev = buf.reshape(n, 3)
+    return np.asarray(t.flux)
+
+
+# --------------------------------------------------------------------- #
+# Shape classes
+# --------------------------------------------------------------------- #
+def test_shape_class_bucketing():
+    assert bucket(1) == 64 and bucket(64) == 64 and bucket(65) == 128
+    sc = classify(48, 1000, 2, jnp.float32, True)
+    assert sc == ShapeClass(64, 1024, 2, "float32", True)
+    assert sc.key() == "ntet64.n1024.g2.float32.packed"
+    # dtype/packedness never share a bucket
+    assert classify(48, 1000, 2, jnp.float64, True) != sc
+    assert classify(48, 1000, 2, jnp.float32, False) != sc
+
+
+# --------------------------------------------------------------------- #
+# Database round-trip + refusals
+# --------------------------------------------------------------------- #
+def test_db_roundtrip(tmp_path):
+    sc = classify(48, 256, 2, jnp.float32, True)
+    path = _synthetic_db(
+        tmp_path / "t.json",
+        {sc.key(): {"kernel": "pallas", "lane_block": 64, "megastep": 4}},
+    )
+    db = load_tuning(path)
+    entry = db.lookup(sc)
+    assert entry["kernel"] == "pallas" and entry["lane_block"] == 64
+    assert db.lookup(classify(9999, 256, 2, jnp.float32, True)) is None
+
+
+def test_db_schema_refusal(tmp_path):
+    p = tmp_path / "bad.json"
+    p.write_text(json.dumps({"schema": TUNING_SCHEMA + 1,
+                             "environments": {}}))
+    with pytest.raises(ValueError, match="schema"):
+        load_tuning(str(p))
+    p2 = tmp_path / "worse.json"
+    p2.write_text(json.dumps({"entries": {}}))
+    with pytest.raises(ValueError, match="schema"):
+        load_tuning(str(p2))
+
+
+def test_db_cross_environment_refusal(tmp_path):
+    other = {"backend": "tpu", "x64": False, "n_devices": 4}
+    path = _synthetic_db(tmp_path / "tpu.json", {}, env=other)
+    db = load_tuning(path)
+    with pytest.raises(ValueError, match="no section for the current"):
+        db.section(strict=True)
+    # ... and through the facade's construction-time consult.
+    cfg = TallyConfig(tuning=path)
+    with pytest.raises(ValueError, match="no section for the current"):
+        PumiTally(_mesh(), 64, cfg)
+
+
+def test_db_section_env_drift_refused(tmp_path):
+    # A section whose key matches but whose pinned environment doesn't
+    # (hand-edited file) is refused, not silently consumed.
+    env = environment()
+    data = empty_db()
+    data["environments"][env_key(env)] = {
+        "environment": dict(env, x64=not env["x64"]),
+        "entries": {},
+    }
+    p = tmp_path / "drift.json"
+    write_tuning(str(p), data)
+    with pytest.raises(ValueError, match="drifted"):
+        load_tuning(str(p)).section()
+
+
+def test_empty_db_is_all_miss(tmp_path):
+    p = tmp_path / "empty.json"
+    write_tuning(str(p), empty_db())
+    dec = lookup_tuned(
+        str(p), ntet=48, n_particles=64, n_groups=2,
+        dtype=jnp.float32, packed=True,
+    )
+    assert not dec.hit and dec.kernel is None
+
+
+# --------------------------------------------------------------------- #
+# Knob resolution (no compiles)
+# --------------------------------------------------------------------- #
+def test_resolve_tuning_env_beats_field(monkeypatch):
+    cfg = TallyConfig(tuning="/cfg/path.json")
+    assert cfg.resolve_tuning() == "/cfg/path.json"
+    monkeypatch.setenv("PUMI_TPU_TUNING", "off")
+    assert cfg.resolve_tuning() is None
+    monkeypatch.setenv("PUMI_TPU_TUNING", "/env/path.json")
+    assert cfg.resolve_tuning() == "/env/path.json"
+    monkeypatch.delenv("PUMI_TPU_TUNING")
+    assert TallyConfig().resolve_tuning() is None
+
+
+def test_resolve_lane_block_validation(monkeypatch):
+    assert TallyConfig().resolve_lane_block(256) is None
+    assert TallyConfig(pallas_lane_block=64).resolve_lane_block(256) == 64
+    # clamped to the batch
+    assert TallyConfig(pallas_lane_block=512).resolve_lane_block(80) == 80
+    with pytest.raises(ValueError, match="power of two"):
+        TallyConfig(pallas_lane_block=100).resolve_lane_block(256)
+    with pytest.raises(ValueError, match="power of two"):
+        TallyConfig(pallas_lane_block=-8).resolve_lane_block(256)
+    # env beats field
+    monkeypatch.setenv("PUMI_TPU_PALLAS_LANE_BLOCK", "32")
+    assert TallyConfig(pallas_lane_block=64).resolve_lane_block(256) == 32
+
+
+def test_resolve_knobs_precedence_over_db(monkeypatch):
+    tuned = TunedDecision(
+        path="x", key="k", hit=True, kernel="pallas", lane_block=32,
+        megastep=4,
+    )
+    # db fills the defer values...
+    assert TallyConfig().resolve_lane_block(256, tuned=tuned) == 32
+    assert TallyConfig().resolve_megastep(tuned=tuned) == 4
+    # ...config fields beat it...
+    assert TallyConfig(pallas_lane_block=16).resolve_lane_block(
+        256, tuned=tuned
+    ) == 16
+    assert TallyConfig(megastep=2).resolve_megastep(tuned=tuned) == 2
+    # ...and env overrides beat both.
+    monkeypatch.setenv("PUMI_TPU_PALLAS_LANE_BLOCK", "8")
+    monkeypatch.setenv("PUMI_TPU_MEGASTEP", "16")
+    assert TallyConfig(pallas_lane_block=16).resolve_lane_block(
+        256, tuned=tuned
+    ) == 8
+    assert TallyConfig(megastep=2).resolve_megastep(tuned=tuned) == 16
+
+
+# --------------------------------------------------------------------- #
+# Facade consumption at construction
+# --------------------------------------------------------------------- #
+def test_construction_consumes_db(tmp_path, monkeypatch):
+    monkeypatch.setenv("PUMI_TPU_PALLAS_INTERPRET", "1")
+    mesh = _mesh()
+    n = 64
+    sc = classify(mesh.ntet, n, 2, jnp.float32, True)
+    path = _synthetic_db(
+        tmp_path / "t.json",
+        {sc.key(): {"kernel": "pallas", "lane_block": 32, "megastep": 4}},
+    )
+    t = PumiTally(mesh, n, TallyConfig(kernel="auto", tuning=path))
+    assert t._kernel == "pallas"
+    assert t._lane_block == 32
+    assert t._tuned.hit and t._tuned.key == sc.key()
+    assert t.config.resolve_megastep(tuned=t._tuned) == 4
+
+
+def test_db_kernel_xla_pins_auto(tmp_path, monkeypatch):
+    # A database that measured XLA faster overrides the in-regime
+    # "auto" heuristic that would have picked Pallas.
+    monkeypatch.setenv("PUMI_TPU_PALLAS_INTERPRET", "1")
+    mesh = _mesh()
+    sc = classify(mesh.ntet, 64, 2, jnp.float32, True)
+    path = _synthetic_db(
+        tmp_path / "t.json", {sc.key(): {"kernel": "xla", "megastep": 1}}
+    )
+    t = PumiTally(mesh, 64, TallyConfig(kernel="auto", tuning=path))
+    assert t._kernel == "xla"
+    # without the database the same construction picks Pallas
+    t2 = PumiTally(mesh, 64, TallyConfig(kernel="auto"))
+    assert t2._kernel == "pallas"
+
+
+def test_explicit_config_beats_db(tmp_path, monkeypatch):
+    monkeypatch.setenv("PUMI_TPU_PALLAS_INTERPRET", "1")
+    mesh = _mesh()
+    sc = classify(mesh.ntet, 64, 2, jnp.float32, True)
+    path = _synthetic_db(
+        tmp_path / "t.json",
+        {sc.key(): {"kernel": "pallas", "lane_block": 32, "megastep": 4}},
+    )
+    # explicit kernel="xla" (the default) never flips to the db winner
+    t = PumiTally(mesh, 64, TallyConfig(tuning=path))
+    assert t._kernel == "xla"
+    # explicit lane_block beats the db's 32
+    t2 = PumiTally(
+        mesh, 64,
+        TallyConfig(kernel="auto", tuning=path, pallas_lane_block=16),
+    )
+    assert t2._kernel == "pallas" and t2._lane_block == 16
+    # explicit megastep beats the db's 4
+    assert t2.config.resolve_megastep(tuned=t2._tuned) == 4
+    t3 = PumiTally(
+        mesh, 64, TallyConfig(tuning=path, megastep=2)
+    )
+    assert t3.config.resolve_megastep(tuned=t3._tuned) == 2
+
+
+def test_db_miss_falls_back_to_defaults(tmp_path, monkeypatch):
+    monkeypatch.setenv("PUMI_TPU_PALLAS_INTERPRET", "1")
+    mesh = _mesh()
+    other = classify(99999, 64, 2, jnp.float32, True)  # not this mesh
+    path = _synthetic_db(
+        tmp_path / "t.json",
+        {other.key(): {"kernel": "pallas", "lane_block": 32,
+                       "megastep": 64}},
+    )
+    t = PumiTally(mesh, 64, TallyConfig(kernel="auto", tuning=path))
+    assert t._tuned is not None and not t._tuned.hit
+    assert t._kernel == "pallas"  # today's auto policy, unchanged
+    assert t._lane_block is None  # kernel default
+    assert t.config.resolve_megastep(tuned=t._tuned) == 1
+
+
+def test_partitioned_consumes_megastep_only(tmp_path):
+    from pumiumtally_tpu.parallel.partitioned_api import PartitionedTally
+
+    mesh = _mesh(3)
+    n = 64
+    sc = classify(mesh.ntet, n, 2, jnp.float32, packed=False)
+    path = _synthetic_db(
+        tmp_path / "t.json",
+        {sc.key(): {"kernel": "pallas", "lane_block": 64, "megastep": 4}},
+    )
+    t = PartitionedTally(
+        mesh, n, n_parts=4, config=TallyConfig(tuning=path)
+    )
+    assert t._tuned.hit
+    assert t._kernel == "xla"  # the partitioned walk never rides Mosaic
+    assert t.config.resolve_megastep(tuned=t._tuned) == 4
+
+
+# --------------------------------------------------------------------- #
+# Byte-identity (real facade runs — compiles)
+# --------------------------------------------------------------------- #
+@pytest.mark.slow
+def test_db_miss_and_empty_db_byte_identity(tmp_path):
+    mesh = _mesh()
+    n = 64
+    f_plain = _run_moves(mesh, n, TallyConfig())
+    p_empty = tmp_path / "empty.json"
+    write_tuning(str(p_empty), empty_db())
+    f_empty = _run_moves(mesh, n, TallyConfig(tuning=str(p_empty)))
+    other = classify(99999, n, 2, jnp.float32, True)
+    p_miss = _synthetic_db(
+        tmp_path / "miss.json",
+        {other.key(): {"kernel": "pallas", "lane_block": 32}},
+    )
+    f_miss = _run_moves(mesh, n, TallyConfig(tuning=p_miss))
+    assert f_plain.tobytes() == f_empty.tobytes() == f_miss.tobytes()
+
+
+@pytest.mark.slow
+def test_tuned_run_bitwise_identical_to_default(tmp_path, monkeypatch):
+    # The whole point of the parity gate: a database steering the
+    # kernel to Pallas at a non-default lane_block changes NOTHING in
+    # the outputs, bit for bit.
+    monkeypatch.setenv("PUMI_TPU_PALLAS_INTERPRET", "1")
+    mesh = _mesh()
+    n = 64
+    sc = classify(mesh.ntet, n, 2, jnp.float32, True)
+    path = _synthetic_db(
+        tmp_path / "t.json",
+        {sc.key(): {"kernel": "pallas", "lane_block": 32, "megastep": 2}},
+    )
+    f_default = _run_moves(mesh, n, TallyConfig())
+    f_tuned = _run_moves(
+        mesh, n, TallyConfig(kernel="auto", tuning=path)
+    )
+    assert f_default.tobytes() == f_tuned.tobytes()
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("lane_block", [8, 16, 32])
+def test_lane_block_ladder_bitwise_parity(lane_block):
+    # Every rung of the block-width ladder is bitwise identical to the
+    # kernel default: the one-hot contraction is exact and collisions
+    # peel in ascending-lane order within any block split.
+    from pumiumtally_tpu.ops.walk import trace_impl
+
+    mesh = _mesh(2)
+    n = 48
+    rng = np.random.default_rng(5)
+    elem = jnp.asarray(rng.integers(0, mesh.ntet, n).astype(np.int32))
+    origin = jnp.asarray(
+        np.asarray(mesh.centroids())[np.asarray(elem)], jnp.float32
+    )
+    dest = jnp.asarray(rng.uniform(0.05, 0.95, (n, 3)), jnp.float32)
+    fly = jnp.ones(n, bool)
+    w = jnp.asarray(rng.uniform(0.5, 2.0, n), jnp.float32)
+    g = jnp.asarray(rng.integers(0, 2, n), jnp.int32)
+    mat = jnp.full(n, -1, jnp.int32)
+
+    def run(lb):
+        flux = jnp.zeros((mesh.ntet, 2, 2), jnp.float32)
+        r = trace_impl(
+            mesh, origin, dest, elem, fly, w, g, mat, flux,
+            initial=False, max_crossings=mesh.ntet + 64,
+            tolerance=1e-6, kernel="pallas", lane_block=lb,
+        )
+        return (
+            np.asarray(r.flux), np.asarray(r.position),
+            np.asarray(r.elem), np.asarray(r.done),
+        )
+
+    ref = run(None)  # DEFAULT_LANE_BLOCK (clamped to the batch)
+    out = run(lane_block)
+    for a, b in zip(ref, out):
+        assert a.tobytes() == b.tobytes()
+
+
+# --------------------------------------------------------------------- #
+# The search driver: parity gate + winners
+# --------------------------------------------------------------------- #
+@pytest.mark.slow
+def test_parity_gate_rejects_corrupted_candidate(monkeypatch):
+    monkeypatch.setenv("PUMI_TPU_PALLAS_INTERPRET", "1")
+    spec = dict(cells=2, n_particles=32, n_groups=2)
+    # Corrupt the (single, clamped-to-batch) Pallas candidate by one
+    # ULP: the bitwise gate must reject it and the winner must fall
+    # back to a clean candidate.
+    monkeypatch.setenv("PUMI_TPU_TUNE_FAULT", "kernel:pallas:32")
+    _, entry = search.tune_shape_class(
+        spec, mode="rehearsal", reps=1, moves=1, mega_moves=1,
+    )
+    pallas = [
+        c for c in entry["candidates"]
+        if c["kind"] == "kernel" and c["kernel"] == "pallas"
+    ]
+    assert pallas and all(c["parity"] == "failed" for c in pallas)
+    assert entry["kernel"] == "xla"  # the corrupted candidate never wins
+    # ...and without the fault the same candidate passes.
+    monkeypatch.delenv("PUMI_TPU_TUNE_FAULT")
+    _, clean = search.tune_shape_class(
+        spec, mode="rehearsal", reps=1, moves=1, mega_moves=1,
+    )
+    assert all(
+        c["parity"] == "bitwise" for c in clean["candidates"]
+    )
+
+
+@pytest.mark.slow
+def test_megastep_parity_gate_rejects_corruption(monkeypatch):
+    monkeypatch.setenv("PUMI_TPU_PALLAS_INTERPRET", "1")
+    monkeypatch.setenv("PUMI_TPU_TUNE_FAULT", "megastep:4")
+    spec = dict(cells=2, n_particles=32, n_groups=2)
+    _, entry = search.tune_shape_class(
+        spec, mode="rehearsal", reps=1, moves=1, mega_moves=4,
+    )
+    k4 = [
+        c for c in entry["candidates"]
+        if c["kind"] == "megastep" and c["megastep"] == 4
+    ]
+    assert k4 and k4[0]["parity"] == "failed"
+    assert entry["megastep"] == 1
+
+
+# --------------------------------------------------------------------- #
+# The CLI: determinism across fresh processes + the drift gate
+# --------------------------------------------------------------------- #
+def _tune_cli(args, timeout=420):
+    env = dict(os.environ)
+    env.pop("JAX_ENABLE_X64", None)
+    env.pop("PUMI_TPU_TUNING", None)
+    return subprocess.run(
+        [sys.executable, os.path.join(ROOT, "scripts", "tune.py"),
+         "--rehearsal", "--shapes", "t=2:64:2", "--moves", "1",
+         "--reps", "1", "--mega-moves", "4", *args],
+        capture_output=True, text=True, timeout=timeout, env=env,
+        cwd=ROOT,
+    )
+
+
+@pytest.mark.slow
+def test_tuner_deterministic_across_processes_and_check_gate(tmp_path):
+    out = str(tmp_path / "t.json")
+    r1 = _tune_cli(["--out", out])
+    assert r1.returncode == 0, r1.stderr
+    # A SECOND fresh process re-tunes and compares winners against the
+    # first through --check: exit 0 == identical winners, which is the
+    # determinism contract (rehearsal mode ranks on the deterministic
+    # cost model, not interpret-mode wall clock).
+    r2 = _tune_cli(["--check", out])
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+    assert "tuning check clean" in r2.stdout
+    # Tampering with a committed winner is drift: exit 1, named key.
+    data = json.load(open(out))
+    sec = next(iter(data["environments"].values()))
+    key, entry = next(iter(sec["entries"].items()))
+    entry["megastep"] = 999
+    json.dump(data, open(out, "w"))
+    r3 = _tune_cli(["--check", out])
+    assert r3.returncode == 1
+    assert "tuning drift" in r3.stdout and key in r3.stdout
+
+
+# --------------------------------------------------------------------- #
+# Calibration (analysis/costmodel.py)
+# --------------------------------------------------------------------- #
+def test_calibrate_points_recovers_coefficients():
+    F, B = 1e12, 2e11  # planted effective throughput / bandwidth
+    pts = [
+        dict(flops=f, bytes_accessed=b, seconds=f / F + b / B)
+        for f, b in [(1e9, 2e8), (5e9, 4e8), (2e10, 8e9), (1e8, 6e9)]
+    ]
+    cal = calibrate_points(pts)
+    assert cal["points"] == 4
+    assert abs(cal["flops_per_s"] - F) / F < 1e-6
+    assert abs(cal["bytes_per_s"] - B) / B < 1e-6
+    assert cal["rmse_s"] < 1e-9
+    # predict_seconds closes the loop
+    m = dict(flops=3e9, bytes_accessed=5e8)
+    assert abs(
+        predict_seconds(m, cal) - (3e9 / F + 5e8 / B)
+    ) < 1e-9
+
+
+def test_calibrate_points_degenerate_falls_back():
+    # Identical signatures (singular system) → single-term fit, not a
+    # crash or a negative coefficient.
+    pts = [
+        dict(flops=1e9, bytes_accessed=2e8, seconds=s)
+        for s in (0.01, 0.011, 0.009)
+    ]
+    cal = calibrate_points(pts)
+    assert cal is not None
+    assert (cal["flops_per_s"] is None) != (cal["bytes_per_s"] is None)
+    # predict_seconds tolerates the explicit None fallback (the
+    # persisted degenerate calibration must not crash its consumers)
+    t = predict_seconds(dict(flops=1e9, bytes_accessed=2e8), cal)
+    assert t > 0
+    assert calibrate_points([]) is None
+
+
+def test_nominal_predict_orders_dispatch_amortization():
+    m = dict(flops=1e9, bytes_accessed=1e8)
+    t1 = predict_seconds(m, NOMINAL_COEFFS, dispatches=1.0)
+    t16 = predict_seconds(m, NOMINAL_COEFFS, dispatches=1.0 / 16)
+    assert t16 < t1  # fused dispatches amortize the launch overhead
+
+
+# --------------------------------------------------------------------- #
+# Satellites: committed smoke db, perfdiff table, astlint coverage
+# --------------------------------------------------------------------- #
+def test_committed_tuning_db_schema():
+    # The committed smoke database parses under the current schema and
+    # carries the CPU rehearsal section with parity-clean winners.
+    db = load_tuning(os.path.join(ROOT, "TUNING.json"))
+    sec = db.environments.get("cpu-x64off-d1")
+    assert sec is not None and sec["mode"] == "rehearsal"
+    assert sec["entries"], "smoke database must carry entries"
+    for entry in sec["entries"].values():
+        winners = [
+            c for c in entry["candidates"]
+            if c["parity"] == "bitwise"
+        ]
+        assert winners, "every entry needs parity-clean candidates"
+        assert entry["calibration"] is not None
+
+
+def test_perfdiff_tuning_table():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "scripts", "perfdiff.py"),
+         "--tuning", os.path.join(ROOT, "TUNING.json")],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "speedup" in proc.stdout
+    assert "calibration" in proc.stdout
+
+
+def test_astlint_covers_tuner_scripts():
+    # The scripts/*.py value-safety subset picks the tuner up
+    # automatically — pin that it stays clean under it (PUMI001/003/
+    # 004/005: host syncs, use-after-donate, nondeterminism, f64).
+    from pumiumtally_tpu.analysis.astlint import lint_sources
+
+    src = {}
+    for rel in ("scripts/tune.py", "pumiumtally_tpu/tuning/search.py",
+                "pumiumtally_tpu/tuning/db.py",
+                "pumiumtally_tpu/tuning/shapes.py"):
+        src[rel] = open(os.path.join(ROOT, rel)).read()
+    findings = lint_sources(src)
+    assert findings == [], [f.render() for f in findings]
