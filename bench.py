@@ -22,7 +22,11 @@ latency; BENCH_FUSED=0 launches one program per step (the gap between
 the modes is the dispatch overhead). BENCH_REPEAT (default 2) times
 that many measurement windows on the compiled program and reports the
 best (shared-tunnel interference is one-sided; every window lands in
-detail.windows). Prints exactly ONE JSON line on stdout.
+detail.windows). BENCH_FAULTS=<PUMI_TPU_FAULTS spec> additionally runs
+a small supervised fault-mode probe and records the MTTR axes
+(detail.recovery_seconds / detail.lost_moves, tagged with
+detail.fault_spec — the BENCHMARKS.md recovery-overhead trajectory).
+Prints exactly ONE JSON line on stdout.
 """
 from __future__ import annotations
 
@@ -430,6 +434,13 @@ def run(
             lane_block=lane_block_explicit,
         )
 
+    # ---- fault-recovery benchmark (MTTR axes, BENCH_FAULTS=<spec>) -----
+    fault = {}
+    if os.environ.get("BENCH_FAULTS"):
+        fault = run_fault_recovery(
+            os.environ["BENCH_FAULTS"], n_groups=n_groups, seed=seed
+        )
+
     per_chip_baseline = 1e9 / 64.0
     return {
         "metric": "particle_segments_per_sec_per_chip",
@@ -506,7 +517,97 @@ def run(
             ),
             "last_step_crossing_iters": int(np.asarray(ncross)),
             **event,
+            **fault,
         },
+    }
+
+
+def run_fault_recovery(spec: str, n_groups: int, seed: int) -> dict:
+    """Supervised fault-mode probe: drive a small ResilientRunner run
+    under ``BENCH_FAULTS=<spec>`` (PUMI_TPU_FAULTS grammar) and record
+    the MTTR axes the BENCHMARKS.md recovery-overhead trajectory
+    tracks — ``recovery_seconds`` (wall-clock spent inside coordinated
+    rollback / reshard / backoff) and ``lost_moves`` (moves the fault
+    cost that a resume would replay) — tagged with the active spec.
+    Runs the partitioned facade when the spec loses a chip and the
+    backend has a mesh to shrink (the elastic path IS the measured
+    recovery); knobs BENCH_FAULT_CELLS/PARTICLES/MOVES keep it small
+    — this prices recovery, not throughput."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    from pumiumtally_tpu import PumiTally, TallyConfig, build_box
+    from pumiumtally_tpu.resilience import (
+        ChipLostError,
+        FaultInjector,
+        InjectedFault,
+        ResilientRunner,
+        parse_faults,
+    )
+
+    cells = int(os.environ.get("BENCH_FAULT_CELLS", "4"))
+    n = int(os.environ.get("BENCH_FAULT_PARTICLES", "64"))
+    moves = int(os.environ.get("BENCH_FAULT_MOVES", "6"))
+    plan = parse_faults(spec)
+    mesh = build_box(1.0, 1.0, 1.0, cells, cells, cells)
+    cfg = TallyConfig(n_groups=n_groups, tolerance=1e-6)
+    n_dev = jax.local_device_count()
+    partitioned = plan.chip_down_at_move is not None and n_dev >= 2
+    if partitioned:
+        from pumiumtally_tpu.parallel.partitioned_api import (
+            PartitionedTally,
+        )
+
+        tally = PartitionedTally(mesh, n, cfg, n_parts=min(8, n_dev))
+    else:
+        tally = PumiTally(mesh, n, cfg)
+    ckdir = tempfile.mkdtemp(prefix="bench_faults_")
+    # backoff_base=0: recovery_seconds prices the real recovery work
+    # (classify + probe + rollback + reshard/recompile), not the
+    # injected exponential-backoff sleep a production run would add.
+    runner = ResilientRunner(
+        tally, ckdir, every_moves=2, handle_signals=False,
+        backoff_base=0.0, faults=FaultInjector(plan),
+    )
+    rng = np.random.default_rng(seed)
+    outcome = "completed"
+    t0 = time.perf_counter()
+    try:
+        runner.initialize_particle_location(
+            rng.uniform(0.1, 0.9, (n, 3)).ravel()
+        )
+        for i in range(1, moves + 1):
+            r = np.random.default_rng(seed + i)
+            runner.move_to_next_location(
+                r.uniform(0.05, 0.95, (n, 3)).ravel(),
+                np.ones(n, np.int8),
+                r.uniform(0.5, 2.0, n),
+                r.integers(0, n_groups, n).astype(np.int32),
+                np.full(n, -1, np.int32),
+            )
+    except (InjectedFault, ChipLostError) as e:
+        # Kill/preemption specs end the probe run by design, and so
+        # does a chip loss with nothing to shrink onto (single-device
+        # backend); the record reports what the eviction cost.
+        outcome = type(e).__name__
+    elapsed = time.perf_counter() - t0
+    st = runner.recovery_stats
+    completed = int(runner.tally.iter_count)
+    runner.close(final_checkpoint=False)
+    shutil.rmtree(ckdir, ignore_errors=True)
+    return {
+        "fault_spec": spec,
+        "fault_outcome": outcome,
+        "fault_facade": "partitioned" if partitioned else "single",
+        "fault_n_parts": int(getattr(runner.tally, "n_parts", 1)),
+        "fault_moves_completed": completed,
+        "recovery_seconds": round(st["recovery_seconds"], 4),
+        "lost_moves": int(st["lost_moves"] + max(0, moves - completed)),
+        "fault_rollbacks": int(st["rollbacks"]),
+        "fault_reshards": int(st["reshards"]),
+        "fault_elapsed_s": round(elapsed, 4),
     }
 
 

@@ -84,6 +84,10 @@ APPROVED_TRANSFER_MODULES = frozenset(
         f"{PACKAGE}/parallel/particle_sharding.py",
         f"{PACKAGE}/utils/checkpoint.py",
         f"{PACKAGE}/models/pipeline.py",
+        # The per-chip health probe stages a tiny round-trip array on
+        # every device by design (resilience taxonomy: a dead chip
+        # fails the put) — a deliberate, accounted transfer edge.
+        f"{PACKAGE}/resilience/coordinator.py",
     }
 )
 

@@ -1628,15 +1628,19 @@ class PumiTally:
             self._exporter = None
 
     # ------------------------------------------------------------------ #
-    def save_checkpoint(self, filename: str) -> None:
+    def save_checkpoint(
+        self, filename: str, n_shards: int | None = None
+    ) -> None:
         """Persist the resumable tally state (flux accumulator + particle
         state + iteration counter) — see utils/checkpoint.py. The reference
         has no checkpointing (SURVEY.md §5); its additive tally state makes
-        this a natural extension."""
+        this a natural extension. A ``.shards`` filename writes the
+        sharded two-phase layout with ``n_shards`` splits (default 1
+        on this facade)."""
         from .utils.checkpoint import save_checkpoint
 
         self._drain_pending()
-        save_checkpoint(filename, self)
+        save_checkpoint(filename, self, n_shards=n_shards)
 
     def restore_checkpoint(self, filename: str) -> None:
         """Resume from a checkpoint written against the same mesh/config."""

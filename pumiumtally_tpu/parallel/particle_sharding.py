@@ -63,6 +63,18 @@ def make_device_mesh(n_devices: int | None = None) -> Mesh:
     return Mesh(np.asarray(devices), (PARTICLE_AXIS,))
 
 
+def mesh_from_devices(devices) -> Mesh:
+    """1-D particle-axis mesh over an EXPLICIT device list — the
+    elastic-recovery entry point (resilience/elastic.py): after a chip
+    loss the surviving devices are not a prefix of ``jax.devices()``,
+    so ``make_device_mesh``'s count-based slicing cannot express the
+    shrunken fleet."""
+    devices = list(devices)
+    if not devices:
+        raise ValueError("mesh_from_devices needs at least one device")
+    return Mesh(np.asarray(devices), (PARTICLE_AXIS,))
+
+
 def n_shards(device_mesh: Mesh) -> int:
     return device_mesh.shape[PARTICLE_AXIS]
 

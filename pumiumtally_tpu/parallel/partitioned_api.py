@@ -117,14 +117,23 @@ class PartitionedTally:
         cap: int | None = None,
         exchange_size: int | None = None,
         max_rounds: int | None = None,
+        telemetry: TallyTelemetry | None = None,
     ):
         self.mesh = mesh
         self.num_particles = int(num_particles)
         self.config = config if config is not None else TallyConfig()
         # Telemetry + phase times: the PumiTally observability surface
         # (tally.telemetry(), TallyTimes) over the partitioned walk.
+        # An elastic mesh-shrink rebuild (resilience/elastic.py) passes
+        # the predecessor's telemetry in so counters, flight records
+        # and the scrape registry stay one continuous history across
+        # the re-partition.
         self.tally_times = TallyTimes()
-        self._telemetry = TallyTelemetry("PartitionedTally")
+        self._telemetry = (
+            telemetry
+            if telemetry is not None
+            else TallyTelemetry("PartitionedTally")
+        )
         if self.config.compact_stages == "adaptive":
             raise NotImplementedError(
                 "compact_stages='adaptive' replans via PumiTally's "
@@ -1467,14 +1476,18 @@ class PartitionedTally:
             )
         return self._last_xpoints
 
-    def save_checkpoint(self, filename: str) -> None:
+    def save_checkpoint(
+        self, filename: str, n_shards: int | None = None
+    ) -> None:
         """Persist flux (assembled — partition-layout independent) +
         particle state + counters; resumable under a different part
-        count or halo depth (utils/checkpoint.py)."""
+        count or halo depth (utils/checkpoint.py). A ``.shards``
+        filename writes the sharded two-phase layout — ``n_shards``
+        splits, default one per mesh part."""
         from ..utils.checkpoint import save_partitioned_checkpoint
 
         self._drain_pending()
-        save_partitioned_checkpoint(filename, self)
+        save_partitioned_checkpoint(filename, self, n_shards=n_shards)
 
     def restore_checkpoint(self, filename: str) -> None:
         """Inverse of save_checkpoint; validates the mesh fingerprint and

@@ -17,19 +17,34 @@ as first-class):
     instead of raising or poisoning the additive flux;
   * ``faultinject`` — the ``PUMI_TPU_FAULTS`` harness that proves each
     failure mode recovers (NaN sources, kill-at-move, transient device
-    errors, checkpoint corruption).
+    errors, checkpoint corruption, chip loss, mid-move preemption,
+    torn shard generations) plus the seeded ``ChaosPlan``/
+    ``ChaosInjector`` multi-fault scheduler driving the chaos
+    campaigns (scripts/chaos.py, scripts/soak_walk.py --chaos);
+  * ``coordinator`` — ``ResilienceCoordinator``: the failure taxonomy
+    ({transient, chip-lost, preempted}) and the per-chip health probe
+    behind the ``pumi_chip_health`` gauge;
+  * ``elastic`` — mesh-shrink recovery: rebuild the partitioned facade
+    on the surviving device set from the layout-independent
+    checkpoint state and continue the run.
 
 Truncated-walk escalation (re-walk only the truncated lanes with a
 doubled crossing budget before declaring them lost) lives with the
 kernels — ``ops/walk.py rewalk_truncated`` — and is switched by
 ``TallyConfig(truncation_retries=N)``.
 """
+from .coordinator import VERDICTS, ResilienceCoordinator
 from .faultinject import (
+    ChaosInjector,
+    ChaosPlan,
+    ChipLostError,
     FaultInjector,
     FaultPlan,
     InjectedFault,
     InjectedKill,
+    InjectedPreemption,
     InjectedTransientFault,
+    chaos_plan,
     parse_faults,
     plan_from_env,
 )
@@ -44,11 +59,18 @@ from .store import CheckpointStore
 __all__ = [
     "CheckpointStore",
     "ResilientRunner",
+    "ResilienceCoordinator",
     "RETRYABLE",
+    "VERDICTS",
+    "ChaosInjector",
+    "ChaosPlan",
+    "chaos_plan",
+    "ChipLostError",
     "FaultInjector",
     "FaultPlan",
     "InjectedFault",
     "InjectedKill",
+    "InjectedPreemption",
     "InjectedTransientFault",
     "parse_faults",
     "plan_from_env",

@@ -1,0 +1,168 @@
+"""ResilienceCoordinator: failure taxonomy + per-chip health probing.
+
+The ``ResilientRunner`` sees one exception per failed dispatch; what it
+should DO depends on what actually happened on the fleet. This module
+owns that verdict — the failure taxonomy the elastic recovery layer
+dispatches on:
+
+  * ``"transient"`` — a one-shot device/runtime error (injected
+    transients, retryable JAX runtime errors, a watchdog timeout with
+    every chip still answering its probe). Recovery: roll every part
+    back to the last good state and replay BITWISE (same layout).
+  * ``"chip-lost"`` — a device dropped out of the mesh (injected
+    ``chip_down_at_move``, or a runtime error/timeout behind which the
+    health probe finds a dead chip). An in-place replay would
+    re-dispatch onto the dead chip; recovery is coordinated rollback
+    of EVERY part to the same generation plus an elastic mesh-shrink
+    re-partition onto the survivors (resilience/elastic.py).
+  * ``"preempted"`` — an eviction notice (``InjectedPreemption``, or a
+    real SIGTERM/SIGINT through the runner's handlers). Recovery: one
+    final flush of the LAST-GOOD generation, then die; the next
+    process auto-resumes.
+
+The health probe stages a tiny round-trip computation on every chip of
+the tally's mesh (a dead TPU fails the put or returns garbage) and
+also checks the ``downed_devices`` set the runner feeds via
+``note_down`` on every ``ChipLostError`` — by device identity, never
+by index, since an elastic shrink re-indexes the mesh. On the
+single-process CPU test mesh, where devices cannot actually die,
+injected chip losses flow through exactly that path, so the chaos
+suite exercises the production classify→probe→shrink pipeline. Results
+are exported per chip through the ``pumi_chip_health`` gauge on the
+tally's registry (the PR 5 Prometheus endpoint serves it), alongside
+``pumi_rollbacks_total{cause=...}`` and
+``pumi_elastic_reshards_total`` which the runner feeds as it acts on
+the verdicts.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..integrity.watchdog import DispatchTimeoutError
+from .faultinject import (
+    ChipLostError,
+    FaultInjector,
+    InjectedPreemption,
+    InjectedTransientFault,
+)
+
+try:  # pragma: no cover - depends on installed jax
+    from jax.errors import JaxRuntimeError as _JaxRuntimeError
+except ImportError:  # pragma: no cover
+    class _JaxRuntimeError(Exception):
+        """Placeholder when jax.errors lacks JaxRuntimeError."""
+
+
+#: The classifier's verdicts, in escalation order.
+VERDICTS = ("transient", "chip-lost", "preempted")
+
+
+class ResilienceCoordinator:
+    def __init__(self, tally, faults: FaultInjector | None = None):
+        self.tally = tally
+        self.faults = faults if faults is not None else FaultInjector()
+        r = tally.metrics
+        self.c_rollbacks = r.counter(
+            "pumi_rollbacks_total",
+            "coordinated rollbacks to the last good generation "
+            "(labeled by cause: transient, chip-lost, preempted, "
+            "integrity)",
+        )
+        self.c_reshards = r.counter(
+            "pumi_elastic_reshards_total",
+            "elastic mesh-shrink recoveries (re-partition onto the "
+            "surviving device set)",
+        )
+        self._g_health = r.gauge(
+            "pumi_chip_health",
+            "per-chip health probe result (1 = answering, 0 = lost)",
+        )
+        # Dead chips by DEVICE IDENTITY, not index: after an elastic
+        # shrink the mesh re-indexes, so a stored index would point at
+        # a healthy survivor (note_down resolves index -> device at
+        # failure time, while the failing mesh is still current).
+        self.downed_devices: set = set()
+        self._last_probe: dict[int, bool] | None = None
+
+    def rebind(self, tally) -> None:
+        """Point at the post-reshard tally (the registry travels with
+        the telemetry transplant, so the counters keep counting)."""
+        self.tally = tally
+
+    # ------------------------------------------------------------------ #
+    def devices(self) -> list:
+        """The tally's device set, mesh order: the partitioned facade's
+        device mesh, or the single device the plain facade's arrays
+        live on."""
+        dm = getattr(self.tally, "device_mesh", None)
+        if dm is not None:
+            return list(dm.devices.flat)
+        import jax
+
+        return [jax.devices()[0]]
+
+    def note_down(self, chip_index: int) -> None:
+        """Record a failed chip by DEVICE while the mesh it indexed is
+        still current (the runner calls this on every
+        ``ChipLostError``, before any reshard re-indexes the fleet)."""
+        devs = self.devices()
+        self.downed_devices.add(devs[chip_index % len(devs)])
+
+    def consume_last_probe(self) -> dict[int, bool] | None:
+        """Hand the recovery path the probe ``classify`` already ran
+        for this failure (None when the verdict needed no probe) —
+        probing a dead chip blocks until its own timeout, so one
+        incident should pay for it once."""
+        probe, self._last_probe = self._last_probe, None
+        return probe
+
+    def probe_chips(self) -> dict[int, bool]:
+        """Per-chip liveness: stage a tiny array onto each chip and
+        read it back (mutation-free — no tally state is touched).
+        Known-dead devices (``note_down``; on the CPU test mesh the
+        stand-in for a chip that stopped answering) report dead
+        without a dispatch. Updates the ``pumi_chip_health`` gauge per
+        chip."""
+        import jax
+
+        health: dict[int, bool] = {}
+        for i, dev in enumerate(self.devices()):
+            if dev in self.downed_devices:
+                ok = False
+            else:
+                try:
+                    probe = jax.device_put(
+                        np.ones(2, np.float32), dev
+                    )
+                    ok = float(np.asarray(probe).sum()) == 2.0
+                except Exception:
+                    ok = False
+            health[i] = ok
+            self._g_health.set(1.0 if ok else 0.0, chip=str(i))
+        return health
+
+    # ------------------------------------------------------------------ #
+    def classify(self, exc: BaseException) -> str:
+        """Name the failure (module docstring taxonomy). Ambiguous
+        runtime errors — a hung dispatch, a JAX runtime error — are
+        resolved by PROBING: a dead chip behind them upgrades the
+        verdict to chip-lost; all chips answering means transient."""
+        # A probe is retained ONLY for a chip-lost verdict it just
+        # produced (consumed by the recovery that follows); anything
+        # older is stale — a later failure must probe afresh, or a
+        # bygone all-healthy map would make the recovery skip the
+        # shrink and re-dispatch onto the dead chip.
+        self._last_probe = None
+        if isinstance(exc, InjectedPreemption):
+            return "preempted"
+        if isinstance(exc, ChipLostError):
+            return "chip-lost"
+        if isinstance(exc, (DispatchTimeoutError, _JaxRuntimeError)):
+            health = self.probe_chips()
+            if not all(health.values()):
+                self._last_probe = health
+                return "chip-lost"
+            return "transient"
+        if isinstance(exc, InjectedTransientFault):
+            return "transient"
+        return "transient"

@@ -1,0 +1,59 @@
+"""Elastic mesh-shrink recovery: continue the run on the survivors.
+
+The reference stack (PUMI-Tally / Omega_h over MPI) loses the whole job
+when one rank dies. Here a lost chip costs one rollback: the
+partitioned checkpoint payload is LAYOUT-INDEPENDENT (the flux is
+stored assembled in global element order and the particle state in pid
+order — PR 2 pinned resume across part counts), and
+``parallel/mesh_partition.partition_mesh`` accepts any part count, so
+the coordinated-rollback state restores cleanly onto a FRESH
+``PartitionedTally`` built over the surviving device set. The
+rebuilt facade recompiles its step for the new layout (with fresh
+watchdog compile amnesty — the first dispatch per kind is always
+un-deadlined) and the run continues: physics-equal to an uninterrupted
+run at the shrunk part count (the layout-independence oracle;
+same-layout rollback stays bitwise).
+
+This module is pure construction glue — the verdicts come from
+``resilience/coordinator.py``, the orchestration (when to shrink, what
+generation to roll to) lives in ``ResilientRunner``.
+"""
+from __future__ import annotations
+
+
+def surviving_devices(tally, health: dict[int, bool]) -> list:
+    """The subset of the tally's mesh devices a probe found alive,
+    mesh order preserved."""
+    devs = list(tally.device_mesh.devices.flat)
+    return [d for i, d in enumerate(devs) if health.get(i, True)]
+
+
+def rebuild_on_devices(tally, devices: list):
+    """Construct a fresh ``PartitionedTally`` over ``devices`` with the
+    source tally's mesh, config, halo depth, per-chip capacity and
+    migration bounds — re-partitioning the SAME global mesh onto the
+    new part count. Telemetry (registry + flight recorder) transplants
+    from the source so counters, the scrape endpoint's registry and
+    the supervisor's metrics keep one continuous history across the
+    shrink. The caller restores state into the result
+    (``utils.checkpoint.restore_state`` handles the cross-layout
+    re-slab; megastep slot state re-distributes on the next dispatch).
+    """
+    if not devices:
+        raise ValueError(
+            "elastic recovery needs at least one surviving device"
+        )
+    from ..parallel.particle_sharding import mesh_from_devices
+    from ..parallel.partitioned_api import PartitionedTally
+
+    return PartitionedTally(
+        tally.mesh,
+        tally.num_particles,
+        tally.config,
+        device_mesh=mesh_from_devices(devices),
+        halo_layers=tally.partition.halo_layers,
+        cap=tally.cap,
+        exchange_size=tally._step_kwargs["exchange_size"],
+        max_rounds=tally._step_kwargs["max_rounds"],
+        telemetry=tally._telemetry,
+    )

@@ -41,6 +41,26 @@ Grammar: comma-separated ``name[:value]`` clauses —
   ``hang_seconds:S``      how long the injected hang sleeps (default
                           5.0; tests use fractions of a second so the
                           abandoned watchdog thread dies quickly);
+  ``chip_down_at_move:K`` the K-th move raises ``ChipLostError`` once,
+                          and the chip stays DOWN for every subsequent
+                          health probe (``downed``) — the coordinator
+                          must classify it chip-lost and the elastic
+                          layer must re-partition onto the survivors
+                          (resilience/coordinator.py, elastic.py);
+  ``chip:C``              which chip ``chip_down_at_move`` kills
+                          (default -1 = the last chip of the mesh);
+  ``preempt_at_move:K``   the K-th move raises ``InjectedPreemption``
+                          MID-MOVE (inside the supervised dispatch) —
+                          the runner must flush the LAST-GOOD
+                          generation, never the in-flight state, then
+                          let it propagate like a real SIGTERM;
+  ``torn_shard:G``        the G-th checkpoint generation the
+                          supervisor writes is TORN right after the
+                          commit: one shard file is truncated
+                          mid-payload (single-file generations get the
+                          corrupt_ckpt byte-flip), so its manifest
+                          digest fails and find_latest must reject the
+                          WHOLE generation atomically;
   ``seed:S``              rng seed for nan_src lane choice (default 0).
 
 The PR 2 modes (nan_src/die/transient/corrupt_ckpt) are driven by the
@@ -75,6 +95,26 @@ class InjectedTransientFault(InjectedFault):
     supervisor's backoff path must absorb it."""
 
 
+class InjectedPreemption(InjectedKill):
+    """Simulated preemption notice landing MID-MOVE: the supervisor
+    flushes the last-GOOD generation (never the in-flight state) and
+    then lets it propagate — the process is being evicted; recovery is
+    the next process's auto-resume."""
+
+
+class ChipLostError(RuntimeError):
+    """A device dropped out of the mesh. Raised by the injector
+    (``chip_down_at_move``) and by the coordinator when a health probe
+    finds a dead chip behind a runtime error. NOT plain-retryable: an
+    in-place replay would re-dispatch onto the dead chip — recovery is
+    the coordinated rollback + elastic mesh-shrink path
+    (resilience/coordinator.py, elastic.py)."""
+
+    def __init__(self, message: str, chip: int = -1):
+        super().__init__(message)
+        self.chip = int(chip)
+
+
 @dataclasses.dataclass(frozen=True)
 class FaultPlan:
     nan_src: float = 0.0
@@ -85,6 +125,10 @@ class FaultPlan:
     sdc_walk: int | None = None
     hang_at_move: int | None = None
     hang_seconds: float = 5.0
+    chip_down_at_move: int | None = None
+    chip: int = -1
+    preempt_at_move: int | None = None
+    torn_shard: int | None = None
     seed: int = 0
 
     def any(self) -> bool:
@@ -96,6 +140,9 @@ class FaultPlan:
             or self.bitflip_flux is not None
             or self.sdc_walk is not None
             or self.hang_at_move is not None
+            or self.chip_down_at_move is not None
+            or self.preempt_at_move is not None
+            or self.torn_shard is not None
         )
 
 
@@ -132,6 +179,18 @@ def parse_faults(spec: str) -> FaultPlan:
                 raise ValueError(
                     f"hang_seconds must be positive: {value!r}"
                 )
+        elif name == "chip_down_at_move":
+            fields["chip_down_at_move"] = int(value)
+        elif name == "chip":
+            fields["chip"] = int(value)
+        elif name == "preempt_at_move":
+            fields["preempt_at_move"] = int(value)
+        elif name == "torn_shard":
+            fields["torn_shard"] = int(value)
+            if fields["torn_shard"] < 1:
+                raise ValueError(
+                    f"torn_shard counts generations from 1: {value!r}"
+                )
         elif name == "seed":
             fields["seed"] = int(value)
         else:
@@ -139,7 +198,8 @@ def parse_faults(spec: str) -> FaultPlan:
                 f"unknown fault {name!r} in PUMI_TPU_FAULTS "
                 f"(known: nan_src, die_at_move, transient_at_move, "
                 f"corrupt_ckpt, bitflip_flux, sdc_walk, hang_at_move, "
-                f"hang_seconds, seed)"
+                f"hang_seconds, chip_down_at_move, chip, "
+                f"preempt_at_move, torn_shard, seed)"
             )
     return FaultPlan(**fields)
 
@@ -163,6 +223,15 @@ class FaultInjector:
         self._bitflip_fired = False
         self._sdc_fired = False
         self._hang_fired = False
+        self._preempt_fired = False
+        #: Chip indices this injector has killed (the once-only guard;
+        #: the runner forwards each raise to
+        #: ``ResilienceCoordinator.note_down``, which pins the DEVICE
+        #: so later probes keep it dead across reshards — the CPU test
+        #: mesh has no way to actually lose a device).
+        self.downed: set[int] = set()
+        self._ckpt_writes = 0
+        self._torn_fired = False
 
     # ------------------------------------------------------------------ #
     def maybe_die(self, move: int) -> None:
@@ -187,6 +256,37 @@ class FaultInjector:
             raise InjectedTransientFault(
                 f"injected transient device error at move {move} "
                 f"(PUMI_TPU_FAULTS transient_at_move)"
+            )
+
+    def maybe_chip_down(self, move: int) -> None:
+        """``chip_down_at_move``: lose a chip at the matching move —
+        raises ``ChipLostError`` once and marks the chip permanently
+        down for the health probe."""
+        if (
+            self.plan.chip_down_at_move is not None
+            and move == self.plan.chip_down_at_move
+            and self.plan.chip not in self.downed
+        ):
+            self.downed.add(self.plan.chip)
+            raise ChipLostError(
+                f"injected chip loss at move {move} "
+                f"(PUMI_TPU_FAULTS chip_down_at_move, chip "
+                f"{self.plan.chip})",
+                chip=self.plan.chip,
+            )
+
+    def maybe_preempt(self, move: int) -> None:
+        """``preempt_at_move``: a preemption notice landing mid-move
+        (inside the supervised dispatch), once."""
+        if (
+            self.plan.preempt_at_move is not None
+            and move == self.plan.preempt_at_move
+            and not self._preempt_fired
+        ):
+            self._preempt_fired = True
+            raise InjectedPreemption(
+                f"injected preemption at move {move} "
+                f"(PUMI_TPU_FAULTS preempt_at_move)"
             )
 
     def bitflip_at(self, move: int) -> bool:
@@ -254,16 +354,194 @@ class FaultInjector:
         d[bad] = np.nan
         return int(bad.sum())
 
-    def corrupt_file(self, path: str) -> bool:
-        """``corrupt_ckpt``: flip bytes in the middle of the file (past
-        the zip header, inside a compressed member) so the container
-        still opens but the payload fails its digest/CRC."""
-        if not self.plan.corrupt_ckpt:
-            return False
+    @staticmethod
+    def _flip_bytes(path: str) -> None:
         size = os.path.getsize(path)
         with open(path, "r+b") as f:
             f.seek(size // 2)
             chunk = f.read(16)
             f.seek(size // 2)
             f.write(bytes(b ^ 0xFF for b in chunk))
+
+    @staticmethod
+    def _shard_files(dirname: str) -> list[str]:
+        return sorted(
+            os.path.join(dirname, n)
+            for n in os.listdir(dirname)
+            if n.startswith("shard-") and n.endswith(".npz")
+        )
+
+    def corrupt_file(self, path: str) -> bool:
+        """``corrupt_ckpt``: flip bytes in the middle of the file (past
+        the zip header, inside a compressed member) so the container
+        still opens but the payload fails its digest/CRC. Sharded
+        generations (directories) get one shard flipped — the manifest
+        digest check must then reject the whole generation."""
+        if not self.plan.corrupt_ckpt:
+            return False
+        if os.path.isdir(path):
+            path = self._shard_files(path)[0]
+        self._flip_bytes(path)
         return True
+
+    def maybe_tear(self, path: str) -> bool:
+        """``torn_shard:G``: tear the G-th generation this injector
+        sees written — truncate one shard file mid-payload (a torn
+        concurrent multi-shard write surfacing AFTER the manifest
+        commit), or byte-flip a single-file generation. The store's
+        digest checks must reject the whole generation atomically."""
+        if self.plan.torn_shard is None:
+            return False
+        self._ckpt_writes += 1
+        if self._ckpt_writes != self.plan.torn_shard or self._torn_fired:
+            return False
+        self._torn_fired = True
+        if os.path.isdir(path):
+            target = self._shard_files(path)[-1]
+            with open(target, "r+b") as f:
+                f.truncate(os.path.getsize(target) // 2)
+        else:
+            self._flip_bytes(path)
+        return True
+
+
+# --------------------------------------------------------------------- #
+# Chaos campaigns: a randomized-but-seeded multi-fault schedule
+# --------------------------------------------------------------------- #
+@dataclasses.dataclass(frozen=True)
+class ChaosPlan:
+    """A concrete multi-fault schedule drawn deterministically from a
+    seed (``chaos_plan``) — the campaign driver's unit
+    (scripts/chaos.py, scripts/soak_walk.py --chaos)."""
+
+    transient_moves: tuple = ()
+    chip_down_move: int | None = None
+    chip: int = -1
+    preempt_move: int | None = None
+    torn_generation: int | None = None
+    seed: int = 0
+
+    def describe(self) -> str:
+        bits = [f"seed:{self.seed}"]
+        if self.transient_moves:
+            bits.append(
+                "transients@" + ",".join(map(str, self.transient_moves))
+            )
+        if self.chip_down_move is not None:
+            bits.append(f"chip_down@{self.chip_down_move}(chip {self.chip})")
+        if self.preempt_move is not None:
+            bits.append(f"preempt@{self.preempt_move}")
+        if self.torn_generation is not None:
+            bits.append(f"torn_shard@gen{self.torn_generation}")
+        return " ".join(bits)
+
+
+def chaos_plan(spec: str, n_moves: int) -> ChaosPlan:
+    """Draw a concrete schedule from a chaos spec. Grammar
+    (comma-separated ``name[:value]``):
+
+      ``transients:N``  N transient device errors at distinct random
+                        moves;
+      ``chip_down:1``   one chip loss at a random move (value 0 = off);
+      ``chip:C``        which chip it kills (default -1 = last);
+      ``preempt:1``     one mid-move preemption at a random move AFTER
+                        every other fault (so recovery is exercised
+                        before the eviction);
+      ``torn:G``        tear the G-th checkpoint generation written;
+      ``seed:S``        the schedule seed (default 0).
+
+    Same spec + seed + n_moves → the same schedule, so a chaos soak
+    failure reproduces exactly."""
+    counts = {"transients": 0, "chip_down": 0, "preempt": 0}
+    chip, torn, seed = -1, None, 0
+    for clause in filter(None, (c.strip() for c in spec.split(","))):
+        name, _, value = clause.partition(":")
+        if name in counts:
+            counts[name] = int(value or "1")
+        elif name == "chip":
+            chip = int(value)
+        elif name == "torn":
+            torn = int(value)
+        elif name == "seed":
+            seed = int(value)
+        else:
+            raise ValueError(
+                f"unknown chaos clause {name!r} (known: transients, "
+                "chip_down, chip, preempt, torn, seed)"
+            )
+    rng = np.random.default_rng([987654321, seed])
+    # Faults land in [2, n_moves-1]: move 1 establishes a good state
+    # first and the final move proves post-recovery steady state.
+    lo, hi = 2, max(2, int(n_moves) - 1)
+    candidates = np.arange(lo, hi + 1)
+    n_t = min(counts["transients"], candidates.size)
+    transients = tuple(
+        sorted(
+            int(m)
+            for m in rng.choice(candidates, size=n_t, replace=False)
+        )
+    )
+    chip_down = (
+        int(rng.choice(candidates)) if counts["chip_down"] else None
+    )
+    preempt = None
+    if counts["preempt"]:
+        floor = max([lo, *transients, chip_down or lo])
+        preempt = int(rng.integers(floor, hi + 1))
+    return ChaosPlan(
+        transient_moves=transients,
+        chip_down_move=chip_down,
+        chip=chip,
+        preempt_move=preempt,
+        torn_generation=torn,
+        seed=seed,
+    )
+
+
+class ChaosInjector(FaultInjector):
+    """A FaultInjector driven by a ChaosPlan schedule: transients can
+    fire at SEVERAL moves (fault storms), a chip loss and a preemption
+    can ride the same run (fault-during-recovery compositions), and a
+    generation tear composes with all of them. Each scheduled fault
+    fires once."""
+
+    def __init__(self, plan: ChaosPlan):
+        super().__init__(FaultPlan(torn_shard=plan.torn_generation))
+        self.chaos = plan
+        self._fired_transients: set[int] = set()
+
+    def maybe_transient(self, move: int) -> None:
+        if (
+            move in self.chaos.transient_moves
+            and move not in self._fired_transients
+        ):
+            self._fired_transients.add(move)
+            raise InjectedTransientFault(
+                f"chaos transient at move {move} "
+                f"({self.chaos.describe()})"
+            )
+
+    def maybe_chip_down(self, move: int) -> None:
+        if (
+            self.chaos.chip_down_move is not None
+            and move == self.chaos.chip_down_move
+            and self.chaos.chip not in self.downed
+        ):
+            self.downed.add(self.chaos.chip)
+            raise ChipLostError(
+                f"chaos chip loss at move {move} "
+                f"({self.chaos.describe()})",
+                chip=self.chaos.chip,
+            )
+
+    def maybe_preempt(self, move: int) -> None:
+        if (
+            self.chaos.preempt_move is not None
+            and move == self.chaos.preempt_move
+            and not self._preempt_fired
+        ):
+            self._preempt_fired = True
+            raise InjectedPreemption(
+                f"chaos preemption at move {move} "
+                f"({self.chaos.describe()})"
+            )

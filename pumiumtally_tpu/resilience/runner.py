@@ -18,6 +18,20 @@ adds the fault-tolerance loop production preemptible fleets need
     transients, JAX runtime errors) rolls the tally back to the last
     good in-memory snapshot and retries with exponential backoff,
     bounded by ``max_retries``;
+  * **coordinated rollback + elastic mesh-shrink** (the failure
+    taxonomy lives in ``resilience/coordinator.py``): every failure is
+    CLASSIFIED — ``transient`` replays bitwise on the same layout;
+    ``chip-lost`` (a health probe finds a dead chip) rolls EVERY part
+    back to the same last-good generation, re-partitions the mesh onto
+    the surviving devices (``resilience/elastic.py``), re-arms the
+    compiled step with fresh watchdog compile amnesty, and continues —
+    physics-equal to an uninterrupted run at the shrunk part count;
+    ``preempted`` flushes the last-GOOD generation (never in-flight
+    state) and propagates;
+  * **sharded generations**: partitioned tallies checkpoint as one npz
+    per mesh part plus a manifest committed last (two-phase commit,
+    ``CheckpointStore(shards="auto")``) — a torn multi-shard write can
+    never resume as a Frankenstein mix of vintages;
   * **fault injection**: every hook of ``faultinject.py`` threads
     through here, so the tests can prove each failure mode recovers.
 
@@ -45,8 +59,11 @@ from ..integrity.policy import (
 from ..integrity.watchdog import DispatchTimeoutError
 from ..utils.checkpoint import restore_state, snapshot_state
 from ..utils.log import log_info, log_warn
+from .coordinator import ResilienceCoordinator
 from .faultinject import (
+    ChipLostError,
     FaultInjector,
+    InjectedPreemption,
     InjectedTransientFault,
 )
 from .store import CheckpointStore
@@ -65,7 +82,11 @@ except ImportError:  # pragma: no cover
 #: integrity="retry" violations (a one-shot SDC does not recur on
 #: replay; a deterministic kernel bug exhausts the bounded retries and
 #: propagates). Anything else — including InjectedKill and
-#: integrity="halt" violations — propagates.
+#: integrity="halt" violations — propagates. ``ChipLostError`` is NOT
+#: here: an in-place replay would re-dispatch onto the dead chip; the
+#: coordinator routes it to the elastic mesh-shrink path instead (and
+#: the members listed here can still be UPGRADED to chip-lost when the
+#: health probe finds a dead chip behind them).
 RETRYABLE = (
     InjectedTransientFault,
     DispatchTimeoutError,
@@ -89,6 +110,7 @@ class ResilientRunner:
         resume: bool = True,
         handle_signals: bool = True,
         retry_snapshots: bool = True,
+        elastic: bool = True,
         faults: FaultInjector | None = None,
         sleep=time.sleep,
     ):
@@ -109,11 +131,37 @@ class ResilientRunner:
         # generation can turn it off — transient errors then propagate
         # like any other (the next process auto-resumes).
         self.retry_snapshots = bool(retry_snapshots)
+        # Elastic mesh-shrink recovery for partitioned tallies: a
+        # chip-lost verdict re-partitions onto the survivors instead
+        # of propagating. Off → chip loss flushes last-good and raises
+        # (declared graceful degradation).
+        self.elastic = bool(elastic)
         self.faults = faults if faults is not None else FaultInjector()
         self._sleep = sleep
         self._prev_handlers: dict = {}
         self._in_move = False
         self._pending_signal: int | None = None
+        # True while a dispatch may have half-mutated tally state (set
+        # around every supervised body() call): the preemption flush
+        # consults it so a signal surfacing on an ERROR path writes the
+        # LAST-GOOD generation, never the in-flight rolled-back state.
+        self._dirty = False
+        #: MTTR accounting for bench.py's fault-mode axes: rollbacks /
+        #: reshards performed, moves lost to rollback rewinds, and
+        #: wall-clock seconds spent inside recovery (classify + probe +
+        #: rollback + re-partition + backoff).
+        self.recovery_stats = {
+            "rollbacks": 0,
+            "reshards": 0,
+            "lost_moves": 0,
+            "recovery_seconds": 0.0,
+        }
+        # Failure taxonomy + per-chip health probe; registers the
+        # pumi_rollbacks_total / pumi_elastic_reshards_total /
+        # pumi_chip_health families on the tally's registry.
+        self.coordinator = ResilienceCoordinator(
+            tally, faults=self.faults
+        )
         r = tally.metrics
         self._c_ckpt = r.counter(
             "pumi_checkpoints_total",
@@ -130,6 +178,11 @@ class ResilientRunner:
         self._c_fault = r.counter(
             "pumi_injected_faults_total",
             "faults injected through PUMI_TPU_FAULTS (labeled by kind)",
+        )
+        self._c_shards = r.counter(
+            "pumi_checkpoint_shards_written_total",
+            "shard files written by sharded (two-phase manifest) "
+            "checkpoint generations",
         )
 
         # Live scrape endpoint (obs/exporter.py): the facades start one
@@ -271,38 +324,55 @@ class ResilientRunner:
                 self._on_signal(sig, None)
 
     def _retry_loop(self, what: str, body, rearm=None):
-        """Shared escalation skeleton for one supervised dispatch: a
-        fatal integrity halt flushes the last GOOD generation before
-        propagating, and RETRYABLE failures roll back to the last good
-        snapshot and replay with bounded exponential backoff. ``rearm``
-        re-seeds caller-owned inputs the dispatch may have mutated
-        before failing. The per-move and megastep paths share this so
-        the two resilience contracts cannot drift apart."""
+        """Shared escalation skeleton for one supervised dispatch. A
+        fatal integrity halt and a preemption notice flush the last
+        GOOD generation before propagating; every other failure is
+        CLASSIFIED by the coordinator: ``transient`` rolls back to the
+        last good snapshot and replays with bounded exponential
+        backoff (single-state rearm), ``chip-lost`` rolls EVERY part
+        back to the same generation and re-partitions onto the
+        surviving devices (fleet rearm, ``_recover_chip_loss``).
+        ``rearm`` re-seeds caller-owned inputs the dispatch may have
+        mutated before failing. The per-move and megastep paths share
+        this so the two resilience contracts cannot drift apart."""
         attempt = 0
         while True:
+            self._dirty = True
             try:
-                return body()
+                out = body()
+                self._dirty = False
+                return out
             except FatalIntegrityViolation:
                 # integrity="halt": flush the last GOOD generation —
                 # never the suspect post-violation state — so the
                 # campaign can be resumed from verified data, then let
                 # the halt propagate.
-                if self._good is not None:
-                    restore_state(self.tally, self._good)
-                    try:
-                        path = self.checkpoint()
-                        log_warn(
-                            f"integrity halt in {what}: flushed "
-                            f"last-good checkpoint {path} before "
-                            f"raising"
-                        )
-                    except Exception as e:  # pragma: no cover
-                        log_warn(f"integrity-halt flush failed: {e}")
+                self._flush_last_good("integrity", what)
                 raise
-            except RETRYABLE as e:
+            except InjectedPreemption:
+                # A preemption notice mid-move: same flush discipline
+                # as a real SIGTERM on an error path — the generation
+                # on disk must be the last GOOD state, never the
+                # in-flight one.
+                self._flush_last_good("preempted", what)
+                raise
+            except (ChipLostError,) + RETRYABLE as e:
                 attempt += 1
                 if isinstance(e, InjectedTransientFault):
                     self._c_fault.inc(kind="transient")
+                if isinstance(e, ChipLostError):
+                    self._c_fault.inc(kind="chip_down")
+                    # Pin the dead DEVICE while the mesh it indexed is
+                    # still current (a reshard re-indexes the fleet).
+                    self.coordinator.note_down(e.chip)
+                verdict = self.coordinator.classify(e)
+                if verdict == "chip-lost" and not self._can_reshard():
+                    # Nothing to shrink onto (single-chip facade, a
+                    # 1-part mesh, elastic off, or no anchor):
+                    # declared graceful degradation — flush the last
+                    # good generation and propagate.
+                    self._flush_last_good("chip-lost", what)
+                    raise
                 if attempt > self.max_retries or self._good is None:
                     # No anchor to roll back to (retry_snapshots off,
                     # or nothing completed yet): an in-place retry
@@ -311,25 +381,129 @@ class ResilientRunner:
                     # process's auto-resume is the recovery path.
                     raise
                 self._c_retry.inc()
-                delay = min(
-                    self.backoff_base * 2 ** (attempt - 1),
-                    self.backoff_max,
+                t0 = time.monotonic()
+                iter_before = self.tally.iter_count
+                if verdict == "chip-lost":
+                    self._recover_chip_loss(e, what)
+                    if rearm is not None:
+                        rearm()
+                else:
+                    delay = min(
+                        self.backoff_base * 2 ** (attempt - 1),
+                        self.backoff_max,
+                    )
+                    log_warn(
+                        f"{what} failed transiently ({e}); restoring "
+                        f"last good state and retrying in {delay:.2f}s "
+                        f"(attempt {attempt}/{self.max_retries})"
+                    )
+                    restore_state(self.tally, self._good)
+                    self._dirty = False
+                    self.coordinator.c_rollbacks.inc(cause="transient")
+                    self.recovery_stats["rollbacks"] += 1
+                    if rearm is not None:
+                        rearm()
+                    self._sleep(delay)
+                self.recovery_stats["lost_moves"] += max(
+                    0, iter_before - self.tally.iter_count
                 )
-                log_warn(
-                    f"{what} failed transiently ({e}); restoring "
-                    f"last good state and retrying in {delay:.2f}s "
-                    f"(attempt {attempt}/{self.max_retries})"
+                self.recovery_stats["recovery_seconds"] += (
+                    time.monotonic() - t0
                 )
-                restore_state(self.tally, self._good)
-                if rearm is not None:
-                    rearm()
-                self._sleep(delay)
+
+    def _flush_last_good(self, cause: str, what: str) -> None:
+        """Roll back to the last good snapshot (when the in-flight
+        state may be inconsistent) and flush one generation, so the
+        failure about to propagate leaves verified data on disk."""
+        if self._good is None:
+            return
+        restore_state(self.tally, self._good)
+        self._dirty = False
+        self.coordinator.c_rollbacks.inc(cause=cause)
+        self.recovery_stats["rollbacks"] += 1
+        try:
+            path = self.checkpoint()
+            log_warn(
+                f"{cause} in {what}: flushed last-good checkpoint "
+                f"{path} before raising"
+            )
+        except Exception as e:  # pragma: no cover - flush best-effort
+            log_warn(f"{cause} flush failed: {e}")
+
+    def _can_reshard(self) -> bool:
+        return (
+            self.elastic
+            and self._good is not None
+            and hasattr(self.tally, "flux_slabs")
+            and getattr(self.tally, "n_parts", 1) > 1
+        )
+
+    def _recover_chip_loss(self, exc, what: str) -> None:
+        """Fleet rearm: probe the mesh, roll EVERY part back to the
+        same last-good generation, and — when chips are actually gone
+        — rebuild the partitioned facade on the survivors
+        (resilience/elastic.py) with the layout-independent state
+        re-slabbed onto the new partition. The rebuilt facade
+        recompiles its step for the new layout with fresh watchdog
+        compile amnesty; a fresh generation is flushed immediately so
+        the next resume sees the shrunken fleet's layout."""
+        from .elastic import rebuild_on_devices, surviving_devices
+
+        old = self.tally
+        # Reuse the probe classify() just ran for this failure (an
+        # injected ChipLostError needed none — probe once here).
+        health = self.coordinator.consume_last_probe()
+        if health is None:
+            health = self.coordinator.probe_chips()
+        survivors = surviving_devices(old, health)
+        if not survivors:
+            # Fleet-wide loss: same declared degradation as the
+            # unshrinkable cases — leave verified last-good data on
+            # disk before propagating. Best-effort: with every chip
+            # gone even the rollback's device staging can fail, and
+            # that must not mask the original loss.
+            try:
+                self._flush_last_good("chip-lost", what)
+            except Exception as e:  # pragma: no cover - best-effort
+                log_warn(f"fleet-loss flush failed: {e}")
+            raise exc
+        if len(survivors) == old.n_parts:
+            # The probe found the fleet whole (a mis-attributed
+            # timeout): same-layout coordinated rollback — the replay
+            # is bitwise.
+            restore_state(old, self._good)
+            self._dirty = False
+            self.coordinator.c_rollbacks.inc(cause="chip-lost")
+            self.recovery_stats["rollbacks"] += 1
+            return
+        log_warn(
+            f"chip loss in {what} ({exc}); rolling every part back to "
+            f"the last good generation and re-partitioning "
+            f"{old.n_parts} -> {len(survivors)} parts"
+        )
+        old.close()
+        new = rebuild_on_devices(old, survivors)
+        restore_state(new, self._good)
+        self.tally = new
+        self._dirty = False
+        self.coordinator.rebind(new)
+        self.coordinator.c_rollbacks.inc(cause="chip-lost")
+        self.coordinator.c_reshards.inc()
+        self.recovery_stats["rollbacks"] += 1
+        self.recovery_stats["reshards"] += 1
+        self._good = snapshot_state(new)
+        # Flush now so a crash right after the shrink still resumes
+        # (a no-op when this iteration's generation already exists —
+        # its layout-independent payload restores onto any fleet).
+        self.checkpoint()
 
     def _source_chunk_with_retry(
         self, move, chunk, source, kwargs
     ) -> dict:
         def body():
             self.faults.maybe_transient(move)
+            self.faults.maybe_chip_down(move)
+            self.faults.maybe_preempt(move)
             return self.tally.run_source_moves(chunk, source, **kwargs)
 
         # No out-params to re-arm: the megastep's inputs are
@@ -355,6 +529,8 @@ class ResilientRunner:
 
         def body():
             self.faults.maybe_transient(move)
+            self.faults.maybe_chip_down(move)
+            self.faults.maybe_preempt(move)
             self.tally.move_to_next_location(
                 particle_destinations, flying, weights, groups,
                 material_ids, size,
@@ -380,10 +556,27 @@ class ResilientRunner:
     # Checkpointing
     # ------------------------------------------------------------------ #
     def checkpoint(self) -> str:
-        """Write one generation now (cadence-independent)."""
+        """Write one generation now (cadence-independent). Partitioned
+        tallies write the sharded two-phase layout (store default);
+        the shard count feeds pumi_checkpoint_shards_written_total.
+        Re-flushing an iteration that already has a VALID generation
+        (a rollback flush landing on a cadence write's iteration) is
+        a no-op: the runner is its store's single writer and the
+        iteration keys the trajectory, so the bytes are already safe
+        — and rewriting a sharded generation in place would un-commit
+        it first, risking the one copy a crash must preserve."""
+        existing = self.store.valid_path_for(self.tally.iter_count)
+        if existing is not None:
+            self._last_ckpt_iter = self.tally.iter_count
+            self._last_ckpt_time = time.monotonic()
+            return existing
         path = self.store.save(self.tally)
         if self.faults.corrupt_file(path):
             self._c_fault.inc(kind="corrupt_ckpt")
+        if self.faults.maybe_tear(path):
+            self._c_fault.inc(kind="torn_shard")
+        if self.store.last_shards:
+            self._c_shards.inc(self.store.last_shards)
         self._c_ckpt.inc()
         self._last_ckpt_iter = self.tally.iter_count
         self._last_ckpt_time = time.monotonic()
@@ -429,10 +622,22 @@ class ResilientRunner:
     def _on_signal(self, signum, frame) -> None:
         """Preemption flush: one final checkpoint, then die the way the
         process would have died without us. Mid-move delivery defers to
-        the move boundary so the flushed generation is consistent."""
+        the move boundary so the flushed generation is consistent; if
+        that boundary was reached by an ERROR path (retries exhausted
+        mid-flight — the dirty flag is still up), the tally is first
+        rolled back to the last good snapshot so the flush writes the
+        last-GOOD generation, never the in-flight state."""
         if self._in_move:
             self._pending_signal = signum
             return
+        if self._dirty and self._good is not None:
+            try:
+                restore_state(self.tally, self._good)
+                self._dirty = False
+                self.coordinator.c_rollbacks.inc(cause="preempted")
+                self.recovery_stats["rollbacks"] += 1
+            except Exception as e:  # pragma: no cover - best-effort
+                log_warn(f"preemption rollback failed: {e}")
         try:
             path = self.checkpoint()
             log_info(

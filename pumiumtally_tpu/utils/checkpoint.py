@@ -21,6 +21,21 @@ Durability contract (the resilience subsystem's foundation,
     CASTING — an f64 checkpoint into an f32 tally would lose the
     precision contract) a different run.
 
+Sharded generations (two-phase commit; the elastic-recovery layer's
+foundation, ``resilience/coordinator.py``/``elastic.py``): a generation
+named ``<name>.shards`` is a DIRECTORY of per-mesh-part ``shard-*.npz``
+payload splits (each an atomic, digest-carrying npz like the single
+file, written concurrently) plus a ``MANIFEST.json`` committed LAST.
+The manifest names every shard with its whole-file sha256, so the
+generation is valid only once the commit record exists and every named
+shard hashes clean — a torn multi-shard write (crash before the
+manifest, or a shard corrupted after it) can never produce a
+Frankenstein restore: the whole generation is rejected atomically and
+the resilience layer falls back to an older one. Single-file ``.npz``
+generations remain fully supported (backward compatible): every
+``save_*``/``restore_*``/``verify_checkpoint`` entry point dispatches
+on the ``.shards`` suffix / directory form.
+
 ``snapshot_state``/``restore_state`` expose the same payload as
 in-memory host copies — the ``ResilientRunner``'s retry anchor, no
 serialization.
@@ -38,6 +53,13 @@ import tempfile
 import numpy as np
 
 FORMAT_VERSION = 1
+
+#: Suffix marking a sharded (directory) generation; everything else is
+#: the single-file ``.npz`` layout.
+SHARD_SUFFIX = ".shards"
+
+#: The two-phase-commit record of a sharded generation, written LAST.
+MANIFEST_NAME = "MANIFEST.json"
 
 
 class CheckpointIntegrityError(ValueError):
@@ -75,6 +97,13 @@ def _normalize(filename: str) -> str:
     # np.savez_compressed silently appends ".npz"; normalize on both the
     # save and load side so any filename round-trips.
     return filename if filename.endswith(".npz") else filename + ".npz"
+
+
+def is_sharded(path: str) -> bool:
+    """True when ``path`` names a sharded (directory) generation —
+    either by the ``.shards`` suffix (save side, may not exist yet) or
+    by being a directory on disk (restore side)."""
+    return path.endswith(SHARD_SUFFIX) or os.path.isdir(path)
 
 
 def fsync_dir(directory: str) -> None:
@@ -164,7 +193,10 @@ def verify_checkpoint(filename: str) -> dict:
     """Standalone integrity check: load the meta block and re-hash every
     array. Returns the meta dict on success; raises
     ``CheckpointIntegrityError`` (or the container's own zip/OS errors)
-    on corruption. Does not touch any tally."""
+    on corruption. Does not touch any tally. Sharded generations
+    (directories) route through the manifest check."""
+    if is_sharded(filename):
+        return verify_sharded_checkpoint(filename)
     filename = _normalize(filename)
     with np.load(filename) as z:
         meta = json.loads(bytes(z["meta"].tobytes()).decode())
@@ -182,8 +214,219 @@ def verify_checkpoint(filename: str) -> dict:
 
 
 def load_meta(filename: str) -> dict:
+    if is_sharded(filename):
+        return _read_manifest(filename)["meta"]
     with np.load(_normalize(filename)) as z:
         return json.loads(bytes(z["meta"].tobytes()).decode())
+
+
+# --------------------------------------------------------------------- #
+# Sharded generations: per-part payload splits + two-phase manifest
+# --------------------------------------------------------------------- #
+def _file_digest(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _atomic_write_bytes(filename: str, data: bytes) -> None:
+    """The ``atomic_savez`` durability contract for a small opaque blob
+    (the manifest): tmp + fsync + rename + directory fsync."""
+    directory = os.path.dirname(os.path.abspath(filename)) or "."
+    fd, tmp = tempfile.mkstemp(
+        dir=directory, prefix=os.path.basename(filename) + ".tmp-"
+    )
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, filename)
+        fsync_dir(directory)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def shard_name(index: int) -> str:
+    return f"shard-{int(index):03d}.npz"
+
+
+def save_sharded_checkpoint(
+    dirname: str, tally, n_shards: int | None = None
+) -> int:
+    """Write one SHARDED generation with two-phase commit semantics.
+
+    Phase 1 splits the facade payload into ``n_shards`` leading-axis
+    chunks (one per mesh part by default — every payload array is
+    per-particle, per-element, or per-slot, so a first-axis split is
+    layout-independent and reassembly is a concatenation) and writes
+    one digest-carrying npz per shard CONCURRENTLY through the
+    existing atomic tmp+fsync+rename path. Phase 2 commits
+    ``MANIFEST.json`` — the facade meta plus every shard's whole-file
+    sha256 — atomically, LAST. A pre-existing manifest is removed
+    BEFORE any shard is touched (un-commit), so a crash mid-rewrite
+    leaves an invalid (manifest-less) directory — detected and
+    skipped, never a manifest naming half-overwritten shards. NOTE:
+    that means rewriting an existing generation IN PLACE sacrifices
+    the old copy for the duration of the write; callers that must
+    never lose the previous generation write to a fresh path (the
+    ``CheckpointStore``'s per-iteration naming, plus the runner's
+    skip of re-flushes onto valid generations, guarantee this).
+    Returns the shard count written."""
+    if hasattr(tally, "flux_slabs"):
+        meta, arrays = _partitioned_payload(tally)
+    else:
+        meta, arrays = _plain_payload(tally)
+    if n_shards is None:
+        n_shards = int(getattr(tally, "n_parts", 1))
+    n_shards = max(1, int(n_shards))
+    os.makedirs(dirname, exist_ok=True)
+    manifest_path = os.path.join(dirname, MANIFEST_NAME)
+    if os.path.exists(manifest_path):
+        os.unlink(manifest_path)
+        fsync_dir(dirname)
+    chunks = {
+        name: np.array_split(np.asarray(a), n_shards)
+        for name, a in arrays.items()
+    }
+
+    def _write(i: int) -> str:
+        shard_meta = {
+            "format_version": FORMAT_VERSION,
+            "shard": int(i),
+            "n_shards": int(n_shards),
+        }
+        shard_arrays = {
+            name: np.ascontiguousarray(chunks[name][i]) for name in arrays
+        }
+        return _write_checkpoint(
+            os.path.join(dirname, shard_name(i)), shard_meta, shard_arrays
+        )
+
+    from concurrent.futures import ThreadPoolExecutor
+
+    with ThreadPoolExecutor(max_workers=min(n_shards, 8)) as ex:
+        paths = list(ex.map(_write, range(n_shards)))
+    manifest = {
+        "format_version": FORMAT_VERSION,
+        "meta": meta,
+        "n_shards": int(n_shards),
+        "shards": {os.path.basename(p): _file_digest(p) for p in paths},
+    }
+    _atomic_write_bytes(
+        manifest_path, json.dumps(manifest, indent=1).encode()
+    )
+    return n_shards
+
+
+def _read_manifest(dirname: str) -> dict:
+    """Load the commit record; its ABSENCE (torn multi-shard write:
+    the crash came before phase 2) is corruption by definition — the
+    resilience layer must skip the whole generation."""
+    manifest_path = os.path.join(dirname, MANIFEST_NAME)
+    if not os.path.exists(manifest_path):
+        raise CheckpointIntegrityError(
+            f"sharded checkpoint {dirname}: {MANIFEST_NAME} missing — "
+            "the generation was never committed (torn multi-shard "
+            "write); falling back to an older generation is the "
+            "resilience layer's job (CheckpointStore)"
+        )
+    try:
+        with open(manifest_path, "rb") as f:
+            manifest = json.loads(f.read().decode())
+    except (OSError, ValueError) as e:
+        raise CheckpointIntegrityError(
+            f"sharded checkpoint {dirname}: unreadable manifest ({e})"
+        ) from e
+    if manifest.get("format_version") != FORMAT_VERSION:
+        raise ValueError(
+            f"sharded checkpoint {dirname}: format "
+            f"{manifest.get('format_version')} != {FORMAT_VERSION}"
+        )
+    return manifest
+
+
+def _verify_shard_files(dirname: str, manifest: dict) -> list[str]:
+    """Every shard the manifest names must exist and hash clean; any
+    miss rejects the WHOLE generation (atomic torn-write semantics).
+    Returns shard paths in shard order."""
+    shards = manifest.get("shards", {})
+    if len(shards) != int(manifest.get("n_shards", -1)):
+        raise CheckpointIntegrityError(
+            f"sharded checkpoint {dirname}: manifest names "
+            f"{len(shards)} shard(s) but declares "
+            f"n_shards={manifest.get('n_shards')}"
+        )
+    def _index(name: str) -> int:
+        # Numeric shard order, NOT lexicographic: %03d padding stops
+        # helping past 999 shards ('shard-1000' < 'shard-101'
+        # lexically), and a wrong order would concatenate the restore
+        # silently scrambled — the exact Frankenstein class the
+        # manifest exists to prevent.
+        digits = "".join(c for c in name if c.isdigit())
+        return int(digits) if digits else -1
+
+    paths = []
+    for name in sorted(shards, key=_index):
+        path = os.path.join(dirname, name)
+        if not os.path.exists(path):
+            raise CheckpointIntegrityError(
+                f"sharded checkpoint {dirname}: shard {name!r} missing"
+            )
+        got = _file_digest(path)
+        if got != shards[name]:
+            raise CheckpointIntegrityError(
+                f"sharded checkpoint {dirname}: shard {name!r} sha256 "
+                f"mismatch (manifest {shards[name][:12]}…, recomputed "
+                f"{got[:12]}…) — torn or bit-rotted shard; the whole "
+                "generation is rejected"
+            )
+        paths.append(path)
+    return paths
+
+
+def _load_sharded_arrays(dirname: str, manifest: dict) -> dict:
+    """Digest-verify every shard file, then load and concatenate the
+    per-shard chunks back into the full payload arrays (all BEFORE any
+    tally state is overwritten)."""
+    parts = []
+    for path in _verify_shard_files(dirname, manifest):
+        with np.load(path) as z:
+            smeta = json.loads(bytes(z["meta"].tobytes()).decode())
+            arrays = {k: z[k] for k in z.files if k != "meta"}
+            _verify_integrity(arrays, smeta, path)
+            parts.append(arrays)
+    return {
+        name: np.concatenate([p[name] for p in parts], axis=0)
+        for name in parts[0]
+    }
+
+
+def verify_sharded_checkpoint(dirname: str) -> dict:
+    """Standalone integrity check of a sharded generation: manifest
+    present + every named shard exists and hashes clean. Returns the
+    facade meta on success; ``CheckpointIntegrityError`` on any torn/
+    corrupt condition (the whole generation is invalid)."""
+    manifest = _read_manifest(dirname)
+    _verify_shard_files(dirname, manifest)
+    return manifest["meta"]
+
+
+def _restore_sharded(dirname: str, tally, expected_kind) -> None:
+    manifest = _read_manifest(dirname)
+    meta = manifest["meta"]
+    _validate_meta(meta, tally, expected_kind=expected_kind)
+    arrays = _load_sharded_arrays(dirname, manifest)
+    if expected_kind == "partitioned":
+        _apply_partitioned(tally, meta, arrays)
+    else:
+        _apply_plain(tally, meta, arrays)
 
 
 def _validate_meta(meta: dict, tally, expected_kind: str | None) -> None:
@@ -349,9 +592,13 @@ def _apply_plain(tally, meta: dict, arrays: dict) -> None:
         tally._prev_even = tally.flux[0::2]
 
 
-def save_checkpoint(filename: str, tally) -> None:
+def save_checkpoint(filename: str, tally, n_shards: int | None = None) -> None:
     """Serialize a PumiTally's resumable state (atomic write + per-array
-    digests, see module docstring)."""
+    digests, see module docstring). A ``.shards`` filename writes the
+    sharded two-phase layout instead (``n_shards`` splits)."""
+    if is_sharded(filename):
+        save_sharded_checkpoint(filename, tally, n_shards=n_shards)
+        return
     meta, arrays = _plain_payload(tally)
     _write_checkpoint(_normalize(filename), meta, arrays)
 
@@ -360,6 +607,9 @@ def restore_checkpoint(filename: str, tally) -> None:
     """Restore state saved by save_checkpoint into a PumiTally constructed
     with the same mesh and config. Raises on any mismatch or integrity
     failure BEFORE overwriting any tally state."""
+    if is_sharded(filename):
+        _restore_sharded(filename, tally, expected_kind=None)
+        return
     with np.load(_normalize(filename)) as z:
         meta = json.loads(bytes(z["meta"].tobytes()).decode())
         _validate_meta(meta, tally, expected_kind=None)
@@ -493,15 +743,22 @@ def _apply_partitioned(tally, meta: dict, arrays: dict) -> None:
         tally._reset_convergence()
 
 
-def save_partitioned_checkpoint(filename: str, tally) -> None:
+def save_partitioned_checkpoint(
+    filename: str, tally, n_shards: int | None = None
+) -> None:
     """Serialize a PartitionedTally's resumable state.
 
     The flux is stored ASSEMBLED (global element order), so a checkpoint
     is partition-layout independent: it can resume under a different
     part count or halo depth (the owned-slab layout is derived state).
     Particle state is the facade's host-side arrays. Atomic write +
-    per-array digests like the plain facade.
+    per-array digests like the plain facade. A ``.shards`` filename
+    writes the sharded two-phase layout (one npz per mesh part by
+    default + manifest committed last) instead.
     """
+    if is_sharded(filename):
+        save_sharded_checkpoint(filename, tally, n_shards=n_shards)
+        return
     meta, arrays = _partitioned_payload(tally)
     _write_checkpoint(_normalize(filename), meta, arrays)
 
@@ -510,6 +767,9 @@ def restore_partitioned_checkpoint(filename: str, tally) -> None:
     """Restore state saved by save_partitioned_checkpoint into a
     PartitionedTally on the same mesh (any partition layout). Validation
     and integrity checks run BEFORE any state is overwritten."""
+    if is_sharded(filename):
+        _restore_sharded(filename, tally, expected_kind="partitioned")
+        return
     with np.load(_normalize(filename)) as z:
         meta = json.loads(bytes(z["meta"].tobytes()).decode())
         _validate_meta(meta, tally, expected_kind="partitioned")

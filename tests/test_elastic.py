@@ -1,0 +1,620 @@
+"""Elastic fault tolerance (ISSUE 12): sharded two-phase checkpoint
+generations, coordinated rollback under the failure taxonomy, and
+mesh-shrink recovery for the partitioned facade.
+
+Acceptance contract: a ``chip_down_at_move:K`` injected into a
+partitioned run triggers automatic rollback + re-partition onto the
+surviving devices and the completed run's flux matches a fault-free
+run at the shrunk part count (bitwise for same-layout rollback,
+physics-equal via the layout-independence oracle for the shrink);
+torn-shard generations are rejected ATOMICALLY (manifest missing or
+any shard digest bad → the whole generation is skipped and an older
+one restored).
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from pumiumtally_tpu import TallyConfig
+from pumiumtally_tpu.mesh.box import build_box_arrays
+from pumiumtally_tpu.mesh.core import TetMesh
+from pumiumtally_tpu.parallel.partitioned_api import PartitionedTally
+from pumiumtally_tpu.resilience import (
+    ChaosInjector,
+    CheckpointStore,
+    ChipLostError,
+    FaultInjector,
+    FaultPlan,
+    InjectedPreemption,
+    ResilientRunner,
+    chaos_plan,
+    parse_faults,
+)
+from pumiumtally_tpu.utils.checkpoint import (
+    MANIFEST_NAME,
+    CheckpointIntegrityError,
+    verify_checkpoint,
+)
+
+N = 16
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    coords, t2v = build_box_arrays(1.0, 1.0, 1.0, 4, 4, 4)
+    cen = coords[t2v].mean(axis=1)
+    cls = np.where(cen[:, 0] < 0.5, 1, 2).astype(np.int32)
+    return TetMesh.from_numpy(coords, t2v, class_id=cls, dtype=jnp.float64)
+
+
+CFG = dict(n_groups=2, dtype=jnp.float64, tolerance=1e-8)
+
+
+def _inputs(i):
+    """Deterministic per-move inputs (replayable across processes and
+    layouts — pid order, not slot order)."""
+    rng = np.random.default_rng(100 + i)
+    return (
+        rng.uniform(0.05, 0.95, (N, 3)).ravel().copy(),
+        np.ones(N, np.int8),
+        rng.uniform(0.5, 2.0, N),
+        rng.integers(0, 2, N).astype(np.int32),
+        np.full(N, -1, np.int32),
+    )
+
+
+def _pos():
+    return np.random.default_rng(42).uniform(0.1, 0.9, (N, 3)).ravel()
+
+
+def _reference(mesh, n_parts, moves):
+    t = PartitionedTally(mesh, N, TallyConfig(**CFG), n_parts=n_parts)
+    t.initialize_particle_location(_pos())
+    for i in range(1, moves + 1):
+        t.move_to_next_location(*_inputs(i))
+    return t
+
+
+# ===================================================================== #
+# Sharded two-phase generations
+# ===================================================================== #
+def test_sharded_generation_layout_and_roundtrip(mesh, tmp_path):
+    """A partitioned store generation is a directory of one npz per
+    mesh part plus a MANIFEST.json naming every shard's digest; the
+    restore is exact, under the SAME or a DIFFERENT layout (the
+    payload split is layout-independent)."""
+    t = _reference(mesh, 8, 2)
+    store = CheckpointStore(str(tmp_path / "cks"))
+    path = store.save(t)
+    assert path.endswith(".shards") and os.path.isdir(path)
+    assert store.last_shards == 8
+    shards = sorted(
+        n for n in os.listdir(path) if n.startswith("shard-")
+    )
+    assert len(shards) == 8
+    manifest = json.loads(
+        (tmp_path / "cks" / os.path.basename(path) / MANIFEST_NAME)
+        .read_text()
+    )
+    assert set(manifest["shards"]) == set(shards)
+    assert manifest["meta"]["iter_count"] == 2
+    assert verify_checkpoint(path)["iter_count"] == 2
+
+    # Same-layout restore: exact.
+    b = PartitionedTally(mesh, N, TallyConfig(**CFG), n_parts=8)
+    assert store.restore_latest(b) == 2
+    np.testing.assert_allclose(b.raw_flux, t.raw_flux, rtol=0, atol=0)
+    np.testing.assert_array_equal(b.elem_global, t.elem_global)
+
+    # Cross-layout restore (the elastic lever): exact flux, continued
+    # accumulation physics-equal.
+    c = PartitionedTally(mesh, N, TallyConfig(**CFG), n_parts=4)
+    assert store.restore_latest(c) == 2
+    np.testing.assert_allclose(c.raw_flux, t.raw_flux, rtol=0, atol=0)
+
+
+def test_torn_shard_rejected_atomically(mesh, tmp_path):
+    """Any bad shard digest rejects the WHOLE generation (no
+    Frankenstein restore mixing shard vintages) and falls back to the
+    previous one; a missing manifest (crash between the two commit
+    phases) is equally fatal to the generation."""
+    store = CheckpointStore(str(tmp_path / "cks"), keep=4)
+    t = _reference(mesh, 8, 0)
+    store.save(t)
+    for i in (1, 2):
+        t.move_to_next_location(*_inputs(i))
+        store.save(t)
+    assert store.find_latest()[0] == 2
+
+    # Tear one shard of the newest generation: digest mismatch.
+    newest = store.shard_dir_for(2)
+    target = os.path.join(newest, "shard-003.npz")
+    with open(target, "r+b") as f:
+        f.truncate(os.path.getsize(target) // 2)
+    with pytest.raises(CheckpointIntegrityError, match="sha256"):
+        verify_checkpoint(newest)
+    assert store.find_latest()[0] == 1
+
+    # Un-commit the next generation: manifest missing.
+    os.unlink(os.path.join(store.shard_dir_for(1), MANIFEST_NAME))
+    assert store.find_latest()[0] == 0
+    b = PartitionedTally(mesh, N, TallyConfig(**CFG), n_parts=8)
+    assert store.restore_latest(b) == 0
+    assert b.iter_count == 0
+
+
+def test_torn_shard_fault_through_runner(mesh, tmp_path):
+    """The ``torn_shard:G`` injected mode tears the G-th generation the
+    supervisor writes; resume must skip it and restore the previous
+    generation, then replay to the same final state."""
+    ref = _reference(mesh, 8, 3)
+
+    d = str(tmp_path / "cks")
+    t = PartitionedTally(mesh, N, TallyConfig(**CFG), n_parts=8)
+    run = ResilientRunner(
+        t, d, every_moves=1, handle_signals=False,
+        sleep=lambda s: None,
+        faults=FaultInjector(parse_faults("torn_shard:4")),
+    )
+    run.initialize_particle_location(_pos())
+    for i in range(1, 4):
+        run.move_to_next_location(*_inputs(i))
+    # Generation 4 (= iteration 3) is torn: newest valid is iter 2.
+    assert run.store.find_latest()[0] == 2
+    assert t.metrics.counter(
+        "pumi_injected_faults_total"
+    ).value(kind="torn_shard") == 1
+
+    b = PartitionedTally(mesh, N, TallyConfig(**CFG), n_parts=8)
+    run_b = ResilientRunner(b, d, every_moves=1, handle_signals=False)
+    assert run_b.resumed_from == 2
+    for i in range(1, 4):
+        if b.iter_count >= i:
+            continue
+        run_b.move_to_next_location(*_inputs(i))
+    np.testing.assert_allclose(
+        b.raw_flux, ref.raw_flux, rtol=0, atol=1e-12
+    )
+
+
+def test_single_file_generations_stay_compatible(mesh, tmp_path):
+    """``shards=None`` keeps the pre-sharding single-file layout, and
+    the two layouts interleave in one store history."""
+    t = _reference(mesh, 8, 1)
+    store = CheckpointStore(str(tmp_path / "cks"), shards=None)
+    path = store.save(t)
+    assert path.endswith(".npz") and os.path.isfile(path)
+    assert store.last_shards == 0
+    # A sharded generation lands beside it; both resolve.
+    t.move_to_next_location(*_inputs(2))
+    sharded = CheckpointStore(str(tmp_path / "cks"))  # default auto
+    assert sharded.save(t).endswith(".shards")
+    assert [it for it, _ in sharded.entries()] == [1, 2]
+    assert sharded.find_latest()[0] == 2
+    b = PartitionedTally(mesh, N, TallyConfig(**CFG), n_parts=8)
+    assert sharded.restore_latest(b) == 2
+
+
+def test_uncommitted_shard_dir_swept_on_construction(mesh, tmp_path):
+    d = tmp_path / "cks"
+    d.mkdir()
+    orphan = d / "ckpt-00000005.shards"
+    orphan.mkdir()
+    (orphan / "shard-000.npz").write_bytes(b"half-written")
+    (orphan / "shard-001.npz.tmp-abc").write_bytes(b"tmp litter")
+    CheckpointStore(str(d))
+    assert not orphan.exists()
+
+
+# ===================================================================== #
+# Chip loss: coordinated rollback + elastic mesh-shrink (acceptance)
+# ===================================================================== #
+def test_chip_down_elastic_recovery(mesh, tmp_path):
+    """ISSUE 12 acceptance: chip_down_at_move on the 8-device CPU mesh
+    → automatic rollback + re-partition onto the 7 survivors, and the
+    completed run's flux matches a fault-free run at the shrunk part
+    count (the layout-independence oracle)."""
+    ref = _reference(mesh, 7, 5)
+
+    t = PartitionedTally(mesh, N, TallyConfig(**CFG), n_parts=8)
+    run = ResilientRunner(
+        t, str(tmp_path / "cks"), every_moves=2,
+        handle_signals=False, sleep=lambda s: None,
+        faults=FaultInjector(parse_faults("chip_down_at_move:3")),
+    )
+    run.initialize_particle_location(_pos())
+    for i in range(1, 6):
+        run.move_to_next_location(*_inputs(i))
+
+    assert run.tally.n_parts == 7
+    assert run.tally is not t  # rebuilt facade
+    assert run.recovery_stats["reshards"] == 1
+    assert run.recovery_stats["lost_moves"] == 0  # snapshot rollback
+    np.testing.assert_allclose(
+        np.asarray(run.raw_flux), np.asarray(ref.raw_flux),
+        rtol=0, atol=1e-11,
+    )
+    np.testing.assert_array_equal(run.tally.elem_global, ref.elem_global)
+    # Telemetry continuity across the reshard: the transplanted
+    # registry carries the counters (served by the same exporter).
+    m = t.metrics
+    assert m.counter("pumi_elastic_reshards_total").value() == 1
+    assert m.counter("pumi_rollbacks_total").value(
+        cause="chip-lost"
+    ) == 1
+    assert run.tally.metrics is m
+    # The dead chip reports unhealthy, all survivors healthy.
+    assert m.gauge("pumi_chip_health").value(chip="7") == 0.0
+    assert m.gauge("pumi_chip_health").value(chip="0") == 1.0
+    # The post-reshard generation is sharded at the NEW part count.
+    assert run.store.find_latest() is not None
+    run.checkpoint()
+    assert run.store.last_shards == 7
+    run.close()
+
+
+def test_chip_down_names_the_chip(mesh, tmp_path):
+    """``chip:C`` kills a specific chip; the survivors keep mesh
+    order."""
+    t = PartitionedTally(mesh, N, TallyConfig(**CFG), n_parts=8)
+    devs_before = list(t.device_mesh.devices.flat)
+    run = ResilientRunner(
+        t, str(tmp_path / "cks"), every_moves=100,
+        handle_signals=False, sleep=lambda s: None,
+        faults=FaultInjector(parse_faults("chip_down_at_move:2,chip:3")),
+    )
+    run.initialize_particle_location(_pos())
+    for i in range(1, 3):
+        run.move_to_next_location(*_inputs(i))
+    survivors = list(run.tally.device_mesh.devices.flat)
+    assert survivors == devs_before[:3] + devs_before[4:]
+    # Downed chips are pinned by DEVICE identity, not index: on the
+    # re-indexed 7-part mesh every survivor must probe healthy (an
+    # index-based set would alias onto a living chip and trigger
+    # spurious cascading reshards).
+    health = run.coordinator.probe_chips()
+    assert all(health.values()) and len(health) == 7
+    assert devs_before[3] in run.coordinator.downed_devices
+    run.close()
+
+
+def test_chip_down_megastep_path(mesh, tmp_path):
+    """The device-sourced fused loop recovers through the same
+    coordinated path: slot state is dropped and re-distributed on the
+    shrunken layout, and the automatic recovery is BITWISE equal to a
+    deliberate migration at the same boundary (run K moves on 8
+    parts, checkpoint, restore on 7, continue). That is the honest
+    megastep oracle: the fused loop's device-resident trajectory is
+    layout-sensitive in boundary tie-breaks even fault-free (the
+    per-move facade's whole-run cross-layout oracle is pinned by
+    test_chip_down_elastic_recovery above)."""
+    from pumiumtally_tpu.ops.source import SourceParams
+
+    src = SourceParams(default_sigma_t=4.0, seed=11)
+    cfg = TallyConfig(**CFG, megastep=2)
+
+    # Deliberate migration reference: 2 moves on 8 parts, sharded
+    # checkpoint, restore under 7 parts, 4 more moves.
+    a = PartitionedTally(mesh, N, cfg, n_parts=8)
+    a.initialize_particle_location(_pos())
+    a.run_source_moves(2, src, weights=np.ones(N))
+    a.save_checkpoint(str(tmp_path / "mig.shards"))
+    ref = PartitionedTally(mesh, N, cfg, n_parts=7)
+    ref.restore_checkpoint(str(tmp_path / "mig.shards"))
+    ref.run_source_moves(4, src)
+
+    # Automatic recovery: chip 7 dies at move 3 (the second chunk).
+    t = PartitionedTally(mesh, N, cfg, n_parts=8)
+    with ResilientRunner(
+        t, str(tmp_path / "faulty"), every_moves=2,
+        handle_signals=False, sleep=lambda s: None,
+        faults=FaultInjector(parse_faults("chip_down_at_move:3")),
+    ) as run:
+        run.initialize_particle_location(_pos())
+        run.run_source_moves(6, src, weights=np.ones(N))
+        got, stats = run.tally, run.recovery_stats
+
+    assert got.n_parts == 7 and stats["reshards"] == 1
+    np.testing.assert_allclose(
+        np.asarray(got.raw_flux), np.asarray(ref.raw_flux),
+        rtol=0, atol=0,
+    )
+
+
+def test_same_layout_rollback_stays_bitwise(mesh, tmp_path):
+    """The transient rung of the taxonomy on the partitioned facade:
+    same-layout coordinated rollback replays BITWISE."""
+    ref = _reference(mesh, 8, 3)
+    t = PartitionedTally(mesh, N, TallyConfig(**CFG), n_parts=8)
+    run = ResilientRunner(
+        t, str(tmp_path / "cks"), every_moves=100,
+        handle_signals=False, sleep=lambda s: None,
+        faults=FaultInjector(FaultPlan(transient_at_move=2)),
+    )
+    run.initialize_particle_location(_pos())
+    for i in range(1, 4):
+        run.move_to_next_location(*_inputs(i))
+    assert t.n_parts == 8 and run.tally is t  # no reshard
+    assert run.recovery_stats["rollbacks"] == 1
+    assert run.recovery_stats["reshards"] == 0
+    np.testing.assert_allclose(
+        np.asarray(t.raw_flux), np.asarray(ref.raw_flux),
+        rtol=0, atol=0,
+    )
+    assert t.metrics.counter("pumi_rollbacks_total").value(
+        cause="transient"
+    ) == 1
+
+
+def test_chip_loss_without_elastic_flushes_and_raises(mesh, tmp_path):
+    """elastic=False (or a facade with nothing to shrink onto) is
+    declared graceful degradation: flush the last-good generation,
+    then propagate."""
+    t = PartitionedTally(mesh, N, TallyConfig(**CFG), n_parts=8)
+    run = ResilientRunner(
+        t, str(tmp_path / "cks"), every_moves=100,
+        handle_signals=False, sleep=lambda s: None, elastic=False,
+        faults=FaultInjector(parse_faults("chip_down_at_move:2")),
+    )
+    run.initialize_particle_location(_pos())
+    run.move_to_next_location(*_inputs(1))
+    with pytest.raises(ChipLostError):
+        run.move_to_next_location(*_inputs(2))
+    # The flush wrote the last GOOD iteration (1), not in-flight state.
+    assert run.store.find_latest()[0] == 1
+    assert t.metrics.counter("pumi_rollbacks_total").value(
+        cause="chip-lost"
+    ) == 1
+
+
+def test_chip_loss_plain_facade_degrades_gracefully(tmp_path):
+    """The single-chip facade has no smaller mesh: chip-lost flushes
+    last-good and propagates."""
+    from pumiumtally_tpu import PumiTally, build_box
+
+    mesh32 = build_box(1.0, 1.0, 1.0, 3, 3, 3)
+    t = PumiTally(mesh32, N, TallyConfig(tolerance=1e-6))
+    rng = np.random.default_rng(42)
+    run = ResilientRunner(
+        t, str(tmp_path / "cks"), every_moves=100,
+        handle_signals=False, sleep=lambda s: None,
+        faults=FaultInjector(parse_faults("chip_down_at_move:1")),
+    )
+    run.initialize_particle_location(
+        rng.uniform(0.1, 0.9, (N, 3)).ravel()
+    )
+    dest = rng.uniform(0.05, 0.95, (N, 3)).ravel()
+    with pytest.raises(ChipLostError):
+        run.move_to_next_location(
+            dest, np.ones(N, np.int8), np.ones(N),
+            np.zeros(N, np.int32), np.full(N, -1, np.int32),
+        )
+    assert run.store.find_latest()[0] == 0
+
+
+# ===================================================================== #
+# Preemption mid-move / mid-retry: the flush writes LAST-GOOD
+# ===================================================================== #
+def test_preempt_mid_move_flushes_last_good(mesh, tmp_path):
+    """``preempt_at_move`` lands INSIDE the supervised dispatch: the
+    flushed generation is the last-good one, never in-flight state,
+    and the notice propagates like a real eviction."""
+    t = PartitionedTally(mesh, N, TallyConfig(**CFG), n_parts=8)
+    run = ResilientRunner(
+        t, str(tmp_path / "cks"), every_moves=100,
+        handle_signals=False, sleep=lambda s: None,
+        faults=FaultInjector(parse_faults("preempt_at_move:3")),
+    )
+    run.initialize_particle_location(_pos())
+    for i in (1, 2):
+        run.move_to_next_location(*_inputs(i))
+    with pytest.raises(InjectedPreemption):
+        run.move_to_next_location(*_inputs(3))
+    assert run.store.find_latest()[0] == 2
+    assert t.iter_count == 2  # rolled back to the boundary
+    assert t.metrics.counter("pumi_rollbacks_total").value(
+        cause="preempted"
+    ) == 1
+
+    # Auto-resume completes the campaign bitwise.
+    ref = _reference(mesh, 8, 3)
+    b = PartitionedTally(mesh, N, TallyConfig(**CFG), n_parts=8)
+    run_b = ResilientRunner(b, str(tmp_path / "cks"),
+                            handle_signals=False)
+    assert run_b.resumed_from == 2
+    run_b.move_to_next_location(*_inputs(3))
+    np.testing.assert_allclose(
+        b.raw_flux, ref.raw_flux, rtol=0, atol=0
+    )
+
+
+def test_recovery_stats_surface(mesh, tmp_path):
+    """The MTTR axes bench.py records: recovery_seconds accumulates
+    and lost_moves stays 0 for snapshot rollbacks."""
+    t = PartitionedTally(mesh, N, TallyConfig(**CFG), n_parts=8)
+    run = ResilientRunner(
+        t, str(tmp_path / "cks"), every_moves=100,
+        handle_signals=False, sleep=lambda s: None,
+        faults=FaultInjector(FaultPlan(transient_at_move=2)),
+    )
+    run.initialize_particle_location(_pos())
+    for i in (1, 2):
+        run.move_to_next_location(*_inputs(i))
+    st = run.recovery_stats
+    assert st["rollbacks"] == 1 and st["reshards"] == 0
+    assert st["recovery_seconds"] > 0.0
+    assert st["lost_moves"] == 0
+
+
+# ===================================================================== #
+# Chaos scheduling
+# ===================================================================== #
+def test_chaos_plan_is_seeded_and_deterministic():
+    a = chaos_plan("transients:3,chip_down:1,preempt:1,seed:7", 12)
+    b = chaos_plan("transients:3,chip_down:1,preempt:1,seed:7", 12)
+    assert a == b
+    assert len(a.transient_moves) == 3
+    assert all(2 <= m <= 11 for m in a.transient_moves)
+    assert a.chip_down_move is not None
+    assert a.preempt_move >= max(
+        [*a.transient_moves, a.chip_down_move]
+    )
+    c = chaos_plan("transients:3,chip_down:1,preempt:1,seed:8", 12)
+    assert c != a
+    with pytest.raises(ValueError, match="unknown chaos clause"):
+        chaos_plan("explode:1", 12)
+
+
+def test_chaos_fault_during_recovery_composition(mesh, tmp_path):
+    """A transient striking the SAME move as the chip loss: the replay
+    after the reshard absorbs it (fault-during-recovery), and the run
+    still completes physics-equal to the shrunk-layout reference."""
+    from pumiumtally_tpu.resilience.faultinject import ChaosPlan
+
+    ref = _reference(mesh, 7, 5)
+    plan = ChaosPlan(transient_moves=(3,), chip_down_move=3)
+    t = PartitionedTally(mesh, N, TallyConfig(**CFG), n_parts=8)
+    run = ResilientRunner(
+        t, str(tmp_path / "cks"), every_moves=2,
+        handle_signals=False, sleep=lambda s: None,
+        faults=ChaosInjector(plan),
+    )
+    run.initialize_particle_location(_pos())
+    for i in range(1, 6):
+        run.move_to_next_location(*_inputs(i))
+    assert run.tally.n_parts == 7
+    assert run.recovery_stats["rollbacks"] >= 2  # transient + reshard
+    np.testing.assert_allclose(
+        np.asarray(run.raw_flux), np.asarray(ref.raw_flux),
+        rtol=0, atol=1e-11,
+    )
+
+
+def test_chaos_torn_generation_plus_preempt_resume(mesh, tmp_path):
+    """Corrupt-manifest + eviction composition: the torn generation is
+    skipped at resume, the older one restores, and the replayed
+    campaign ends bitwise-identical to the uninterrupted reference."""
+    from pumiumtally_tpu.resilience.faultinject import ChaosPlan
+
+    ref = _reference(mesh, 8, 4)
+    plan = ChaosPlan(preempt_move=4, torn_generation=3)
+    d = str(tmp_path / "cks")
+    t = PartitionedTally(mesh, N, TallyConfig(**CFG), n_parts=8)
+    run = ResilientRunner(
+        t, d, every_moves=1, handle_signals=False,
+        sleep=lambda s: None, faults=ChaosInjector(plan),
+    )
+    run.initialize_particle_location(_pos())
+    with pytest.raises(InjectedPreemption):
+        for i in range(1, 5):
+            run.move_to_next_location(*_inputs(i))
+    # Writes: init(0), move1, move2(TORN), move3, preempt-flush(3).
+    b = PartitionedTally(mesh, N, TallyConfig(**CFG), n_parts=8)
+    run_b = ResilientRunner(b, d, every_moves=1, handle_signals=False)
+    assert run_b.resumed_from == 3
+    for i in range(1, 5):
+        if b.iter_count >= i:
+            continue
+        run_b.move_to_next_location(*_inputs(i))
+    np.testing.assert_allclose(
+        b.raw_flux, ref.raw_flux, rtol=0, atol=0
+    )
+
+
+# ===================================================================== #
+# SIGTERM arriving mid-retry (subprocess; the preemption flush must
+# write the last-good generation, never in-flight rolled-back state)
+# ===================================================================== #
+_MID_RETRY_CHILD = r"""
+import os, signal, sys
+sys.path.insert(0, sys.argv[2])  # repo root (the package is not installed)
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+from pumiumtally_tpu import PumiTally, TallyConfig, build_box
+from pumiumtally_tpu.resilience import FaultInjector, ResilientRunner
+
+ckdir = sys.argv[1]
+mesh = build_box(1.0, 1.0, 1.0, 3, 3, 3)
+N = 16
+t = PumiTally(mesh, N, TallyConfig(tolerance=1e-6))
+rng = np.random.default_rng(42)
+
+
+def inputs(i):
+    r = np.random.default_rng(100 + i)
+    return (
+        r.uniform(0.05, 0.95, (N, 3)).ravel().copy(),
+        np.ones(N, np.int8),
+        r.uniform(0.5, 2.0, N),
+        r.integers(0, 2, N).astype(np.int32),
+        np.full(N, -1, np.int32),
+    )
+
+
+class AlwaysFailFromMove3(FaultInjector):
+    def maybe_transient(self, move):
+        if move >= 3:
+            # Scribble mid-move state BEFORE failing, so a flush of
+            # in-flight state would be visible as iter_count >= 90.
+            t.iter_count += 90
+            from jax.errors import JaxRuntimeError
+            raise JaxRuntimeError("device flaking forever")
+
+
+def sigterm_mid_retry(seconds):
+    # The backoff sleep runs MID-RETRY (after rollback, before the
+    # replay): a preemption landing here is the satellite's scenario.
+    os.kill(os.getpid(), signal.SIGTERM)
+    for _ in range(200):
+        pass
+
+
+run = ResilientRunner(
+    t, ckdir, every_moves=100, max_retries=2,
+    faults=AlwaysFailFromMove3(), sleep=sigterm_mid_retry,
+)
+run.initialize_particle_location(rng.uniform(0.1, 0.9, (N, 3)).ravel())
+for i in range(1, 4):
+    run.move_to_next_location(*inputs(i))
+"""
+
+
+@pytest.mark.slow
+def test_sigterm_mid_retry_flushes_last_good_subprocess(tmp_path):
+    """SIGTERM delivered while the runner is INSIDE the retry path
+    (between rollback and replay, with later attempts also failing
+    mid-flight): the process must die 128+SIGTERM and the flushed
+    generation must be the last GOOD one (iter 2), never the
+    scribbled in-flight state."""
+    child = tmp_path / "child.py"
+    child.write_text(_MID_RETRY_CHILD)
+    ckdir = tmp_path / "cks"
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        PUMI_TPU_FAULTS="",
+        PUMI_TPU_MEGASTEP="",
+        PUMI_TPU_IO_PIPELINE=os.environ.get("PUMI_TPU_IO_PIPELINE", ""),
+    )
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, str(child), str(ckdir), repo_root],
+        capture_output=True, text=True, env=env, timeout=300,
+    )
+    assert proc.returncode == 128 + 15, proc.stderr
+    store = CheckpointStore(str(ckdir))
+    it, path = store.find_latest()
+    assert it == 2, (it, proc.stderr)
+    meta = verify_checkpoint(path)
+    assert meta["iter_count"] == 2

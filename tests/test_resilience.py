@@ -195,10 +195,17 @@ def test_parse_faults_grammar():
     )
     assert not parse_faults("").any()
     assert parse_faults("transient_at_move:2").transient_at_move == 2
+    # Elastic fault-tolerance modes (ISSUE 12).
+    p = parse_faults("chip_down_at_move:4,chip:2,preempt_at_move:6")
+    assert (p.chip_down_at_move, p.chip, p.preempt_at_move) == (4, 2, 6)
+    assert p.any()
+    assert parse_faults("torn_shard:2").torn_shard == 2
     with pytest.raises(ValueError, match="unknown fault"):
         parse_faults("explode:1")
     with pytest.raises(ValueError, match="probability"):
         parse_faults("nan_src:2.0")
+    with pytest.raises(ValueError, match="torn_shard"):
+        parse_faults("torn_shard:0")
 
 
 def test_plan_from_env(monkeypatch):
