@@ -441,6 +441,13 @@ def run(
             os.environ["BENCH_FAULTS"], n_groups=n_groups, seed=seed
         )
 
+    # ---- serving saturation probe (BENCH_SERVE=<n_jobs>) ---------------
+    serve = {}
+    if os.environ.get("BENCH_SERVE"):
+        serve = run_serve_saturation(
+            int(os.environ["BENCH_SERVE"]), seed=seed
+        )
+
     per_chip_baseline = 1e9 / 64.0
     return {
         "metric": "particle_segments_per_sec_per_chip",
@@ -518,6 +525,7 @@ def run(
             "last_step_crossing_iters": int(np.asarray(ncross)),
             **event,
             **fault,
+            **serve,
         },
     }
 
@@ -608,6 +616,100 @@ def run_fault_recovery(spec: str, n_groups: int, seed: int) -> dict:
         "fault_rollbacks": int(st["rollbacks"]),
         "fault_reshards": int(st["reshards"]),
         "fault_elapsed_s": round(elapsed, 4),
+    }
+
+
+def run_serve_saturation(n_jobs: int, seed: int) -> dict:
+    """Serving saturation probe (``BENCH_SERVE=<n_jobs>``): drive the
+    scripts/serve.py scheduler (serving/TallyScheduler through the
+    shared ``run_saturation`` workload driver) in-process, three
+    passes over the SAME job mix —
+
+      aot=off    no program bank (the jit path; its first pass carries
+                 the jit compiles the bank exists to eliminate),
+      aot=miss   a cold bank (every entry compiled + serialized here —
+                 the one-time population cost),
+      aot=hit    a warm bank on the same directory in a fresh
+                 ProgramBank (every entry deserialized; compile_seconds
+                 must be 0 — the steady-state serving regime),
+
+    — and record ``jobs_per_sec`` + the bank counters per pass, each
+    row tagged with its ``aot`` axis.  The warm pass's flux is checked
+    bitwise against the off pass (the AOT-vs-jit parity contract, also
+    pinned in tests/test_serving.py).  Knobs: BENCH_SERVE_CELLS (4),
+    BENCH_SERVE_CLASSES ("96,192"), BENCH_SERVE_MOVES (8),
+    BENCH_SERVE_QUANTUM (4), BENCH_SERVE_RESIDENT (2),
+    BENCH_SERVE_BANK (default: a throwaway temp dir)."""
+    import shutil
+    import tempfile
+
+    from pumiumtally_tpu import TallyConfig, build_box
+    from pumiumtally_tpu.serving import run_saturation
+
+    cells = int(os.environ.get("BENCH_SERVE_CELLS", "4"))
+    classes = tuple(
+        int(x) for x in os.environ.get(
+            "BENCH_SERVE_CLASSES", "96,192"
+        ).split(",")
+    )
+    moves = int(os.environ.get("BENCH_SERVE_MOVES", "8"))
+    quantum = int(os.environ.get("BENCH_SERVE_QUANTUM", "4"))
+    resident = int(os.environ.get("BENCH_SERVE_RESIDENT", "2"))
+    bank_dir = os.environ.get("BENCH_SERVE_BANK")
+    tmp = None
+    if not bank_dir:
+        tmp = bank_dir = tempfile.mkdtemp(prefix="pumi_bank_")
+    mesh = build_box(1.0, 1.0, 1.0, cells, cells, cells)
+    cfg = TallyConfig(
+        n_groups=int(os.environ.get("BENCH_GROUPS", "2")),
+        tolerance=1e-6,
+    )
+
+    def one_pass(tag, bank):
+        t0 = time.perf_counter()
+        out = run_saturation(
+            mesh, cfg, bank=bank, n_jobs=n_jobs, class_sizes=classes,
+            n_moves=moves, seed=seed, max_resident=resident,
+            quantum_moves=quantum,
+        )
+        aot = out["scheduler"]["aot"] or {}
+        return out, {
+            "aot": tag,
+            "jobs_per_sec": out["jobs_per_sec"],
+            "elapsed_s": out["elapsed_s"],
+            "wall_s": round(time.perf_counter() - t0, 4),
+            "compile_seconds": aot.get("compile_seconds", 0.0),
+            "aot_hits": aot.get("hits", 0),
+            "aot_misses": aot.get("misses", 0),
+            "aot_rewrites": aot.get("rewrites", 0),
+            "outcomes": out["scheduler"]["outcomes"],
+        }
+
+    try:
+        # The bank rides as a path: each pass gets a fresh ProgramBank
+        # on the scheduler's own registry (cold = empty dir → misses,
+        # warm = the populated dir → hits).
+        off_out, off_row = one_pass("off", None)
+        _, cold_row = one_pass("miss", bank_dir)
+        warm_out, warm_row = one_pass("hit", bank_dir)
+        parity = all(
+            warm_out["results"][k].tobytes()
+            == off_out["results"][k].tobytes()
+            for k in off_out["results"]
+        )
+    finally:
+        if tmp is not None:
+            shutil.rmtree(tmp, ignore_errors=True)
+    return {
+        "serve": {
+            "n_jobs": n_jobs,
+            "classes": list(classes),
+            "n_moves": moves,
+            "quantum_moves": quantum,
+            "max_resident": resident,
+            "aot_bitwise_vs_jit": bool(parity),
+            "runs": [off_row, cold_row, warm_row],
+        }
     }
 
 

@@ -135,21 +135,18 @@ def pallas_footprint_bytes(ntet, n_particles, n_groups, itemsize) -> int:
 # --------------------------------------------------------------------- #
 # Metric extraction from one compiled program
 # --------------------------------------------------------------------- #
-def compile_metrics(traced) -> dict:
-    """Compile one ``jax.jit(...).trace(...)`` result on the current
-    backend and extract its resource signature.  Unlike the contracts
-    layer this DOES invoke the backend compiler (still CPU-only, still
-    no execution) — that is where flop counts and the memory plan live.
-
-    The persistent compilation cache is bypassed for the compile: an
-    executable DESERIALIZED from the cache reports an empty aliasing
-    plan (``alias_size_in_bytes == 0``) and slightly different temp
-    sizes, which would fake a dropped donation on warm runs and make
-    the capture depend on cache state.  Unsetting the dir alone is not
-    enough — the cache module keeps serving once initialized — so the
-    cache is also reset; restoring the dir afterwards lets the host
-    process re-initialize it lazily (the on-disk entries survive).
-    """
+def fresh_compile(lowered):
+    """Compile one ``.lower()`` result with the persistent compilation
+    cache bypassed: an executable DESERIALIZED from the cache reports
+    an empty aliasing plan (``alias_size_in_bytes == 0``) and slightly
+    different temp sizes, which would fake a dropped donation on warm
+    runs and make any capture depend on cache state.  Unsetting the dir
+    alone is not enough — the cache module keeps serving once
+    initialized — so the cache is also reset; restoring the dir
+    afterwards lets the host process re-initialize it lazily (the
+    on-disk entries survive).  Shared by :func:`compile_metrics`, the
+    :func:`check_aot` gate, and the serving program bank's compile
+    path (serving/bank.py)."""
     import jax
     from jax.experimental.compilation_cache import (
         compilation_cache as _cc,
@@ -159,9 +156,24 @@ def compile_metrics(traced) -> dict:
     jax.config.update("jax_compilation_cache_dir", None)
     _cc.reset_cache()
     try:
-        compiled = traced.lower().compile()
+        return lowered.compile()
     finally:
         jax.config.update("jax_compilation_cache_dir", prev)
+
+
+def compile_metrics(traced, keep=None, key=None) -> dict:
+    """Compile one ``jax.jit(...).trace(...)`` result on the current
+    backend and extract its resource signature.  Unlike the contracts
+    layer this DOES invoke the backend compiler (still CPU-only, still
+    no execution) — that is where flop counts and the memory plan live.
+    The compile bypasses the persistent compilation cache
+    (:func:`fresh_compile`) so the capture is byte-stable across fresh
+    processes.  ``keep[key]`` retains the compiled executable for a
+    caller that wants to reuse it (the lint runner hands the base-rung
+    compiles to :func:`check_aot` instead of compiling twice)."""
+    compiled = fresh_compile(traced.lower())
+    if keep is not None:
+        keep[key] = compiled
     ca = compiled.cost_analysis()
     props = ca[0] if isinstance(ca, (list, tuple)) else (ca or {})
     mem = compiled.memory_analysis()
@@ -311,14 +323,16 @@ def _base_max_local(dtype=None):
     return partition_mesh(mesh, C._N_PARTS).max_local
 
 
-def capture(families=None, base_traced=None) -> dict:
+def capture(families=None, base_traced=None, keep_compiled=None) -> dict:
     """Compile the requested families over the shape ladder and build
     the full resource capture.
 
     ``base_traced`` reuses the contracts layer's :func:`C.build_traced`
     result for the shared base rung (same (n, cells) — the lint runner
     traces the five programs once for both layers); the ladder's other
-    rungs are traced and compiled here.
+    rungs are traced and compiled here.  ``keep_compiled`` (a dict)
+    retains the BASE-rung executables by family so the lint runner can
+    hand them to :func:`check_aot` without a second compile.
     """
     # The first rung of each axis IS the contracts base shape — the
     # shared-trace reuse and the fitted exponents' size vector both
@@ -332,12 +346,17 @@ def capture(families=None, base_traced=None) -> dict:
 
     # One compile_metrics sweep per rung; the base rung is rung 0 of
     # BOTH axes, so the ladder costs 1 + 2 + 2 compiled rungs total.
-    def rung_metrics(n, cells, traced=None):
+    def rung_metrics(n, cells, traced=None, keep=None):
         traced = traced or C.build_traced(fams, n=n, cells=cells)
-        return {f: compile_metrics(traced[f]) for f in fams}
+        return {
+            f: compile_metrics(traced[f], keep=keep, key=f)
+            for f in fams
+        }
 
     base_n, base_cells = C._N, C._CELLS
-    base_metrics = rung_metrics(base_n, base_cells, traced=base_traced)
+    base_metrics = rung_metrics(
+        base_n, base_cells, traced=base_traced, keep=keep_compiled
+    )
     n_axis = [base_metrics]
     for n in LADDER_N[1:]:
         n_axis.append(rung_metrics(n, base_cells))
@@ -573,6 +592,69 @@ def diff_cost(current: dict, baseline: dict) -> list[Finding]:
                         f"{be.get(metric)} -> {ce.get(metric)} "
                         f"(>±{SCALING_TOL} band)",
                     ))
+    return out
+
+
+# --------------------------------------------------------------------- #
+# AOT round-trip contract (the serving program bank's donation gate)
+# --------------------------------------------------------------------- #
+# The two program families the serving bank (serving/bank.py) persists
+# as serialized executables.  The gate proves the round trip keeps the
+# donation/1+1 contract the jit path compiles with — the resolution of
+# the deserialized-executables-drop-the-aliasing-plan finding that
+# fresh_compile() exists to sidestep for captures.
+AOT_FAMILIES = ("megastep", "trace_packed")
+
+
+def check_aot(traced=None, compiled=None) -> list[Finding]:
+    """``cost.donation.aot``: serialize -> deserialize the base-rung
+    serving families and run the bank's load-time validator
+    (serving/bank.validate_loaded) against the loaded executables.
+    The AOT path a warm server dispatches must be provably as donated
+    (and as host-callback-free) as the jit path; a jax/jaxlib change
+    that loses the aliasing plan in serialization fails HERE, on CPU,
+    before it silently doubles serving memory.  A family that stops
+    serializing at all is the same named finding — the bank would
+    degrade every warm start to full compile cost.
+
+    ``compiled`` (family -> executable) reuses base-rung compiles a
+    :func:`capture` run already paid for (``keep_compiled``); absent
+    families are traced/compiled here."""
+    from jax.experimental.serialize_executable import (
+        deserialize_and_load,
+        serialize,
+    )
+
+    from ..serving.bank import alias_marks, validate_loaded
+
+    compiled = dict(compiled or {})
+    tr = dict(traced or {})
+    missing = [
+        f for f in AOT_FAMILIES if f not in tr and f not in compiled
+    ]
+    if missing:
+        tr.update(C.build_traced(missing))
+    out: list[Finding] = []
+    for fam in AOT_FAMILIES:
+        exe = compiled.get(fam)
+        if exe is None:
+            exe = fresh_compile(tr[fam].lower())
+        expect = alias_marks(exe)
+        try:
+            payload, in_tree, out_tree = serialize(exe)
+        except (ValueError, TypeError) as e:
+            out.append(_finding(
+                "cost.donation.aot",
+                f"{fam} executable does not serialize ({e}) — the "
+                "serving bank cannot persist it and every warm start "
+                "pays full compile cost",
+            ))
+            continue
+        loaded = deserialize_and_load(payload, in_tree, out_tree)
+        for symbol, message in validate_loaded(
+            loaded, fam, expect_alias=expect
+        ):
+            out.append(_finding(symbol, message))
     return out
 
 

@@ -105,6 +105,8 @@ class PumiTally:
         mesh: TetMesh | str,
         num_particles: int,
         config: TallyConfig | None = None,
+        *,
+        program_bank=None,
     ):
         self.config = config or TallyConfig()
         cfg = self.config
@@ -186,6 +188,22 @@ class PumiTally:
                 dtype=cfg.dtype,
                 packed=getattr(mesh, "geo20", None) is not None,
             )
+            # Shape-class key of this workload (tuning/shapes.py) —
+            # the serving scheduler and the AOT bank attribute work to
+            # bank entries by it, and it is useful telemetry on its
+            # own, so it is computed whether or not tuning is on.
+            from .tuning.shapes import classify
+
+            self.shape_key = classify(
+                mesh.ntet, self.num_particles, cfg.n_groups, cfg.dtype,
+                getattr(mesh, "geo20", None) is not None,
+            ).key()
+            # Serving AOT program bank (serving/bank.py): when
+            # attached, the packed-walk and megastep dispatches route
+            # through ahead-of-time compiled executables deserialized
+            # from disk — same programs, zero steady-state compile
+            # cost.  None (the default) is the plain jit path.
+            self._bank = program_bank
             # Pallas one-hot block width: validated here (power of two,
             # clamped to the batch) whatever the kernel resolves to, and
             # fed into select_backend's VMEM-budget check below.
@@ -312,6 +330,14 @@ class PumiTally:
             # XLA jit cache never sees the (no-op there) static key.
             kwargs.setdefault("lane_block", self._lane_block)
         if kwargs.pop("_packed", False):
+            if self._bank is not None:
+                # AOT bank dispatch: the exact (args, kwargs) the jit
+                # wrapper would see, so the bank's entry key matches
+                # where the jit cache would hit.
+                return self._bank.dispatch(
+                    "trace_packed", args, kwargs,
+                    shape_key=self.shape_key,
+                )
             return trace_packed(*args, **kwargs)
         if self.config.checkify_invariants:
             from .ops.walk import checked_trace
@@ -1337,12 +1363,20 @@ class PumiTally:
                 prev_in = self._prev_even
 
                 def _go():
-                    out = megastep_fn(
+                    margs = (
                         self.mesh, s.origin, s.elem, s.material_id,
                         s.weight, s.group, s.in_flight, s.particle_id,
                         flux_in, move0, rng_key, sig_dev, ab_dev,
-                        prev_in, conv_in, n_moves=k, **statics,
+                        prev_in, conv_in,
                     )
+                    mkw = dict(n_moves=k, **statics)
+                    if self._bank is not None:
+                        out = self._bank.dispatch(
+                            "megastep", margs, mkw,
+                            shape_key=self.shape_key,
+                        )
+                    else:
+                        out = megastep_fn(*margs, **mkw)
                     return out, jax.device_get(out.readback)
 
                 # Amnesty key includes k: each distinct chunk length

@@ -54,6 +54,11 @@ class BatchResult(NamedTuple):
     # counters (ops/source.py MEGA_PHYS_FIELDS). None for plain
     # submit() batches.
     physics: dict | None = None
+    # The shape-class key (tuning/shapes.py classify().key()) resolved
+    # for THIS submission's batch size — the serving scheduler and the
+    # bench attribute work to AOT-bank/tuning entries by it without
+    # re-deriving the bucketing.
+    shape_key: str | None = None
 
 
 class StreamingTallyPipeline:
@@ -106,6 +111,9 @@ class StreamingTallyPipeline:
         self._inflight: collections.deque = collections.deque()
         self._n_submitted = 0
         self._results: list[BatchResult] = []
+        # Per-submit shape-class attribution: {shape key: batches
+        # submitted}.  The key also rides each BatchResult.
+        self._shape_counts: collections.Counter = collections.Counter()
 
     # ------------------------------------------------------------------ #
     def submit(self, origin, dest, elem, weight=None, group=None,
@@ -201,10 +209,29 @@ class StreamingTallyPipeline:
         # The flux chain threads through every batch (donated each step);
         # per-batch outputs wait in the in-flight queue.
         self.flux = result.flux
-        self._inflight.append((self._n_submitted, result))
+        self._inflight.append(
+            (self._n_submitted, result, self._classify(n))
+        )
         self._n_submitted += 1
         while len(self._inflight) > self.depth:
             self._drain_one()
+
+    def _classify(self, n: int) -> str:
+        """The submission's resolved shape-class key, counted into the
+        per-class attribution table."""
+        from ..tuning.shapes import classify
+
+        key = classify(
+            self.mesh.ntet, n, self.config.n_groups, self.config.dtype,
+            getattr(self.mesh, "geo20", None) is not None,
+        ).key()
+        self._shape_counts[key] += 1
+        return key
+
+    def shape_keys(self) -> dict:
+        """{shape-class key: batches submitted} — the scheduler/bench
+        attribution surface."""
+        return dict(self._shape_counts)
 
     def submit_source(
         self, origin, elem, n_moves: int, source=None, weight=None,
@@ -292,15 +319,17 @@ class StreamingTallyPipeline:
             integrity=False,
         )
         self.flux = out.flux
-        self._inflight.append((self._n_submitted, out))
+        self._inflight.append(
+            (self._n_submitted, out, self._classify(n))
+        )
         self._n_submitted += 1
         while len(self._inflight) > self.depth:
             self._drain_one()
 
     def _drain_one(self) -> None:
-        idx, r = self._inflight.popleft()
+        idx, r, shape_key = self._inflight.popleft()
         if getattr(r, "readback", None) is not None:
-            self._drain_megastep(idx, r)
+            self._drain_megastep(idx, r, shape_key)
             return
         if self.want_outputs:
             if r.stats is not None:
@@ -328,10 +357,11 @@ class StreamingTallyPipeline:
                         else np.asarray(r.n_xpoints)
                     ),
                     stats=stats,
+                    shape_key=shape_key,
                 )
             )
 
-    def _drain_megastep(self, idx: int, r) -> None:
+    def _drain_megastep(self, idx: int, r, shape_key: str) -> None:
         """Drain one submit_source() batch: one readback fetch carries
         the stats/physics tails; per-lane outputs come back only when
         the pipeline wants them."""
@@ -370,6 +400,7 @@ class StreamingTallyPipeline:
                 all_done=p["alive"] == 0 and p["truncated"] == 0,
                 stats=stats,
                 physics=p,
+                shape_key=shape_key,
             )
         )
 

@@ -1,0 +1,23 @@
+"""Tally-as-a-service: the AOT program bank + shape-bucketed scheduler
+(ROADMAP item 3).
+
+``ProgramBank`` persists compiled walk/megastep executables to disk per
+(shape class x environment section) so a warm server process serves
+jobs with ZERO XLA compiles; ``TallyScheduler`` multiplexes concurrent
+jobs over one device at megastep-K granularity with convergence-based
+early eviction and checkpoint preemption; ``run_saturation`` is the
+shared many-job workload driver behind scripts/serve.py and bench.py's
+``BENCH_SERVE`` probe.
+"""
+from .bank import ProgramBank, validate_loaded
+from .saturate import run_saturation, synthetic_requests
+from .scheduler import JobRequest, TallyScheduler
+
+__all__ = [
+    "JobRequest",
+    "ProgramBank",
+    "TallyScheduler",
+    "run_saturation",
+    "synthetic_requests",
+    "validate_loaded",
+]

@@ -1,0 +1,112 @@
+"""Saturation workload driver shared by scripts/serve.py and bench.py.
+
+One definition of the synthetic many-job workload — N jobs spread
+round-robin over a ladder of request sizes (each a distinct shape
+class after padding), every job with its own RNG seed — so the server
+entrypoint's ``--demo`` mode and the bench's ``BENCH_SERVE`` probe
+drive the SAME scheduler with the SAME job mix and their
+``jobs_per_sec`` numbers are comparable.
+"""
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+
+def synthetic_requests(
+    mesh,
+    n_jobs: int,
+    *,
+    class_sizes: tuple = (96, 192),
+    n_moves: int = 8,
+    seed: int = 0,
+) -> list:
+    """Build ``n_jobs`` JobRequests cycling over ``class_sizes``
+    particle counts (each size pads to its own shape bucket).  Origins
+    are element centroids sampled per-job; each job gets its own
+    source seed, so jobs are statistically independent streams."""
+    from ..ops.source import SourceParams
+    from .scheduler import JobRequest
+
+    centroids = np.asarray(mesh.centroids(), np.float64)
+    out = []
+    for i in range(n_jobs):
+        n = int(class_sizes[i % len(class_sizes)])
+        rng = np.random.default_rng([seed, i])
+        elems = rng.integers(0, mesh.ntet, n)
+        out.append(
+            JobRequest(
+                origins=centroids[elems],
+                n_moves=int(n_moves),
+                source=SourceParams(seed=seed + 1000 + i),
+                job_id=f"sat-{i:04d}",
+            )
+        )
+    return out
+
+
+def run_saturation(
+    mesh,
+    config=None,
+    *,
+    bank=None,
+    n_jobs: int = 8,
+    class_sizes: tuple = (96, 192),
+    n_moves: int = 8,
+    seed: int = 0,
+    max_resident: int = 2,
+    quantum_moves: int | None = None,
+    preempt_after: int | None = None,
+    checkpoint_dir: str | None = None,
+) -> dict:
+    """Submit the synthetic workload, drain the scheduler, and return
+    the measurement record: ``jobs_per_sec`` over the drain window
+    (submission is instant; the window prices scheduling + dispatch),
+    the scheduler/bank counter summary, and per-job rows."""
+    from .scheduler import TallyScheduler
+
+    sched = TallyScheduler(
+        mesh,
+        config,
+        bank=bank,
+        max_resident=max_resident,
+        quantum_moves=quantum_moves,
+        preempt_after=preempt_after,
+        checkpoint_dir=checkpoint_dir,
+    )
+    try:
+        requests = synthetic_requests(
+            mesh, n_jobs, class_sizes=class_sizes, n_moves=n_moves,
+            seed=seed,
+        )
+        ids = [sched.submit(r) for r in requests]
+        t0 = time.perf_counter()
+        sched.run()
+        elapsed = time.perf_counter() - t0
+        stats = sched.stats()
+        per_job = [
+            {
+                "job": j.id,
+                "shape_key": j.shape_key,
+                "outcome": j.outcome,
+                "moves": j.moves_done,
+                "preemptions": j.preemptions,
+            }
+            for j in (sched.job(i) for i in ids)
+        ]
+        return {
+            "n_jobs": n_jobs,
+            "class_sizes": list(class_sizes),
+            "n_moves": n_moves,
+            "elapsed_s": round(elapsed, 4),
+            "jobs_per_sec": round(n_jobs / elapsed, 3),
+            "scheduler": stats,
+            "per_job": per_job,
+            # Raw flux per job id — callers that verify bitwise parity
+            # (tests, the bench's off-vs-warm check) read these; JSON
+            # writers drop the arrays first.
+            "results": {i: sched.result(i) for i in ids},
+        }
+    finally:
+        sched.close()

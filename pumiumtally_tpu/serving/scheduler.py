@@ -1,0 +1,533 @@
+"""Shape-bucketed multi-tenant scheduler (tally-as-a-service).
+
+A production server multiplexes many concurrent tally jobs over one
+device.  This scheduler makes that a first-class loop:
+
+  * Requests are PADDED onto the tuning shape ladder
+    (``tuning/shapes.py`` — the same power-of-two ``bucket`` the
+    autotuner and the AOT bank key on) and bucketed by shape class, so
+    every job of a class dispatches the SAME compiled programs: one
+    bank entry pair (packed init search + megastep) serves every job
+    in the bucket, however many distinct request sizes arrive.
+  * Up to ``max_resident`` jobs are RESIDENT at once (live device
+    state: particle lanes + flux accumulator).  Admission is
+    round-robin ACROSS shape classes, so one hot bucket cannot starve
+    the others.
+  * The device is time-sliced at MEGASTEP-K granularity: each
+    scheduling round gives every resident job exactly one quantum (one
+    ``run_source_moves`` call of up to ``quantum_moves`` fused moves —
+    one H2D + one D2H per quantum, PR 6's contract), which is both the
+    fairness grain and the natural preemption boundary.
+  * Jobs finish by exhaustion (all requested moves), by DRAINING
+    (every particle terminated), or by CONVERGENCE — with
+    ``TallyConfig(convergence=True)`` the PR 5 ``converged()``
+    statistic evicts a job early the moment its requested precision is
+    reached, freeing the slot for queued work.
+  * PREEMPTION reuses the PR 2 checkpoint subsystem: when queued jobs
+    wait and a resident job has held its slot for ``preempt_after``
+    quanta, the job is checkpointed to disk, its device state dropped,
+    and it re-queues; on re-admission it restores and continues
+    BITWISE-identically (megastep RNG is keyed by the persistent move
+    counter, so replay equals the uninterrupted run —
+    tests/test_serving.py pins it).
+
+Observability rides the PR 1/PR 5 machinery: ``pumi_jobs_total
+{outcome}``, ``pumi_queue_depth``, ``pumi_preemptions_total``, the
+bank's ``pumi_aot_hits_total`` / ``pumi_aot_misses_total`` /
+``pumi_compile_seconds_total`` (one shared registry), per-job and
+per-quantum flight records, and the live Prometheus endpoint via
+``PUMI_TPU_PROM_PORT``.
+"""
+from __future__ import annotations
+
+import collections
+import contextlib
+import dataclasses
+import os
+import time
+
+import numpy as np
+
+from ..obs import FlightRecorder, MetricsRegistry, maybe_start_exporter
+from ..tuning.shapes import bucket, classify
+from ..utils.config import TallyConfig
+from .bank import ProgramBank
+
+# Job lifecycle: queued -> resident -> (preempted -> queued ->)* -> done
+QUEUED, RESIDENT, PREEMPTED, DONE = (
+    "queued", "resident", "preempted", "done",
+)
+
+
+@dataclasses.dataclass
+class JobRequest:
+    """One tally job: walk ``n_moves`` device-sourced moves for the
+    given source particles and return the raw flux.  ``origins`` is
+    [n, 3] float64 (host order); ``weights``/``groups`` default to
+    ones/zeros.  ``source`` is an ``ops.source.SourceParams`` (its
+    ``seed`` keys the job's RNG stream)."""
+
+    origins: np.ndarray
+    n_moves: int
+    source: object | None = None
+    weights: np.ndarray | None = None
+    groups: np.ndarray | None = None
+    job_id: str | None = None
+
+
+class Job:
+    """Scheduler-internal job state."""
+
+    def __init__(self, job_id: str, request: JobRequest, n: int,
+                 padded_n: int, shape_key: str):
+        self.id = job_id
+        self.request = request
+        self.n = n
+        self.padded_n = padded_n
+        self.shape_key = shape_key
+        self.state = QUEUED
+        self.outcome: str | None = None
+        self.tally = None
+        self.moves_done = 0
+        self.quanta = 0            # quanta run since last admission
+        self.preemptions = 0
+        self.needs_stage = True    # first quantum stages the lanes
+        self.checkpoint: str | None = None
+        self.result: np.ndarray | None = None
+        self.totals: dict = collections.defaultdict(float)
+        self.submitted_s = time.perf_counter()
+        self.finished_s: float | None = None
+
+    @property
+    def terminal(self) -> bool:
+        return self.state == DONE
+
+
+@contextlib.contextmanager
+def _quiet_exporter():
+    """Suppress the per-tally Prometheus endpoint while the scheduler
+    constructs job facades — the SCHEDULER's registry owns the scrape
+    port; dozens of short-lived job tallies racing to bind it would
+    only warn-spam."""
+    prev = os.environ.pop("PUMI_TPU_PROM_PORT", None)
+    try:
+        yield
+    finally:
+        if prev is not None:
+            os.environ["PUMI_TPU_PROM_PORT"] = prev
+
+
+class TallyScheduler:
+    """Multi-tenant megastep-quantum scheduler over one mesh.
+
+    Args:
+      mesh: the served TetMesh (device-resident, shared by every job).
+      config: per-job TallyConfig template.  ``megastep`` is overridden
+        by the resolved quantum so facade chunking and scheduler
+        quanta coincide (a preemption boundary is always a megastep
+        boundary).
+      bank: a ProgramBank, a bank root path (constructed with the
+        scheduler's registry), or None (jit path — every fresh process
+        pays compile cost; the bench's aot=off baseline).
+      max_resident: resident-job cap (device memory bound: each
+        resident job holds padded lanes + one flux accumulator).
+      quantum_moves: fused moves per scheduling quantum (default: the
+        config/env/tuning-resolved megastep K).
+      preempt_after: quanta a resident job may hold its slot while
+        other jobs queue before it is checkpoint-preempted (None: run
+        to completion).
+      checkpoint_dir: where preemption checkpoints live (required when
+        ``preempt_after`` is set).
+    """
+
+    def __init__(
+        self,
+        mesh,
+        config: TallyConfig | None = None,
+        *,
+        bank: ProgramBank | str | None = None,
+        max_resident: int = 2,
+        quantum_moves: int | None = None,
+        preempt_after: int | None = None,
+        checkpoint_dir: str | None = None,
+        registry: MetricsRegistry | None = None,
+    ):
+        self.mesh = mesh
+        base = config or TallyConfig()
+        self.quantum = int(
+            quantum_moves
+            if quantum_moves is not None
+            else base.resolve_megastep()
+        )
+        if self.quantum < 1:
+            raise ValueError(f"quantum_moves must be >= 1: {self.quantum}")
+        # Facade chunking == scheduler quantum: run_source_moves(k)
+        # with megastep=quantum runs one fused dispatch per quantum,
+        # and a job interleaved with others chains bitwise-identically
+        # to the same chunks run back to back.
+        self.config = dataclasses.replace(base, megastep=self.quantum)
+        self.max_resident = int(max_resident)
+        if self.max_resident < 1:
+            raise ValueError(
+                f"max_resident must be >= 1: {self.max_resident}"
+            )
+        self.preempt_after = preempt_after
+        self.checkpoint_dir = checkpoint_dir
+        if preempt_after is not None and checkpoint_dir is None:
+            raise ValueError(
+                "preempt_after needs checkpoint_dir (preemption "
+                "persists job state through the checkpoint subsystem)"
+            )
+        if checkpoint_dir is not None:
+            # Fail at construction, not at the first mid-run
+            # preemption (the atomic checkpoint writer mkstemps into
+            # this directory and does not create it).
+            os.makedirs(checkpoint_dir, exist_ok=True)
+        self.registry = (
+            registry if registry is not None else MetricsRegistry()
+        )
+        self.recorder = FlightRecorder()
+        if isinstance(bank, str):
+            bank = ProgramBank(
+                bank, registry=self.registry, recorder=self.recorder
+            )
+        self.bank = bank
+        r = self.registry
+        self._jobs_total = r.counter(
+            "pumi_jobs_total",
+            "served tally jobs by outcome (completed: move budget "
+            "exhausted or all particles terminated; converged: "
+            "evicted early at the requested precision; failed)",
+        )
+        self._queue_depth = r.gauge(
+            "pumi_queue_depth",
+            "jobs waiting for a resident slot (preempted jobs "
+            "re-queue and count)",
+        )
+        self._preempt_total = r.counter(
+            "pumi_preemptions_total",
+            "resident jobs checkpoint-preempted to admit queued work",
+        )
+        self._quanta_total = r.counter(
+            "pumi_quanta_total",
+            "scheduling quanta executed (one megastep-K dispatch "
+            "window per resident job per round)",
+        )
+        self._job_seconds = r.histogram(
+            "pumi_job_seconds",
+            "wall seconds from job submission to completion",
+        )
+        # Per-class FIFO queues + a rotation pointer: admission takes
+        # one job per class in turn, so a burst in one shape bucket
+        # cannot starve the others.
+        self._queues: dict[str, collections.deque] = {}
+        self._class_order: list[str] = []
+        self._next_class = 0
+        self._resident: list[Job] = []
+        self._jobs: dict[str, Job] = {}
+        self._n_submitted = 0
+        self._exporter = maybe_start_exporter(self.registry)
+
+    # ------------------------------------------------------------------ #
+    # Submission
+    # ------------------------------------------------------------------ #
+    def submit(self, request: JobRequest) -> str:
+        """Enqueue one job; returns its id.  The job is padded onto the
+        shape ladder here — its bucket decides which queue it joins
+        and which bank entries will serve it."""
+        origins = np.asarray(request.origins, np.float64).reshape(-1, 3)
+        n = origins.shape[0]
+        if n < 1:
+            raise ValueError("a job needs at least one particle")
+        if request.n_moves < 1:
+            raise ValueError(f"n_moves must be >= 1: {request.n_moves}")
+        for name, arr in (
+            ("weights", request.weights), ("groups", request.groups),
+        ):
+            if arr is not None and np.asarray(arr).reshape(-1).size != n:
+                # A silent [:n] truncation would scale the flux by the
+                # wrong source weights — reject the mismatch up front.
+                raise ValueError(
+                    f"{name} has {np.asarray(arr).reshape(-1).size} "
+                    f"entries for {n} particles — per-lane arrays must "
+                    "match the request's UNPADDED particle count"
+                )
+        padded_n = bucket(n)
+        cfg = self.config
+        shape = classify(
+            self.mesh.ntet, padded_n, cfg.n_groups, cfg.dtype,
+            getattr(self.mesh, "geo20", None) is not None,
+        )
+        job_id = request.job_id or f"job-{self._n_submitted:05d}"
+        if job_id in self._jobs:
+            raise ValueError(f"duplicate job id {job_id!r}")
+        self._n_submitted += 1
+        job = Job(job_id, request, n, padded_n, shape.key())
+        self._jobs[job_id] = job
+        self._enqueue(job)
+        self.recorder.record(
+            "job_submitted", job=job_id, shape_key=job.shape_key,
+            n=n, padded_n=padded_n, n_moves=int(request.n_moves),
+        )
+        return job_id
+
+    def _enqueue(self, job: Job) -> None:
+        q = self._queues.get(job.shape_key)
+        if q is None:
+            q = self._queues[job.shape_key] = collections.deque()
+            self._class_order.append(job.shape_key)
+        q.append(job)
+        job.state = QUEUED if job.checkpoint is None else PREEMPTED
+        self._queue_depth.set(self.queue_depth)
+
+    @property
+    def queue_depth(self) -> int:
+        return sum(len(q) for q in self._queues.values())
+
+    def _pop_next(self) -> Job | None:
+        """Round-robin across shape-class queues."""
+        if not self._class_order:
+            return None
+        for _ in range(len(self._class_order)):
+            key = self._class_order[
+                self._next_class % len(self._class_order)
+            ]
+            self._next_class += 1
+            q = self._queues[key]
+            if q:
+                return q.popleft()
+        return None
+
+    # ------------------------------------------------------------------ #
+    # Padding helpers
+    # ------------------------------------------------------------------ #
+    def _padded_inputs(self, job: Job):
+        """Host arrays padded to the shape bucket: pad lanes sit at the
+        first request position with zero weight and alive=False — they
+        are initialized (parent-element search needs a valid position)
+        but never walk, never score, and never sample."""
+        req, n, N = job.request, job.n, job.padded_n
+        origins = np.asarray(req.origins, np.float64).reshape(-1, 3)
+        pad = np.broadcast_to(origins[0], (N - n, 3))
+        origins_p = np.concatenate([origins, pad], axis=0)
+        w = (
+            np.ones(n) if req.weights is None
+            else np.asarray(req.weights, np.float64).reshape(-1)[:n]
+        )
+        g = (
+            np.zeros(n, np.int32) if req.groups is None
+            else np.asarray(req.groups, np.int32).reshape(-1)[:n]
+        )
+        weights_p = np.concatenate([w, np.zeros(N - n)])
+        groups_p = np.concatenate([g, np.zeros(N - n, np.int32)])
+        alive_p = np.concatenate(
+            [np.ones(n, bool), np.zeros(N - n, bool)]
+        )
+        return origins_p, weights_p, groups_p, alive_p
+
+    # ------------------------------------------------------------------ #
+    # Residency
+    # ------------------------------------------------------------------ #
+    def _admit(self, job: Job) -> None:
+        from ..api import PumiTally
+
+        with _quiet_exporter():
+            tally = PumiTally(
+                self.mesh, job.padded_n, self.config,
+                program_bank=self.bank,
+            )
+        if job.checkpoint is not None:
+            # Preempted job: restore the exact megastep boundary it was
+            # parked at — the move counter keys the RNG stream, so the
+            # continuation is bitwise the uninterrupted run.
+            tally.restore_checkpoint(job.checkpoint)
+            job.needs_stage = False
+        else:
+            origins_p, _, _, _ = self._padded_inputs(job)
+            tally.initialize_particle_location(
+                origins_p.reshape(-1).copy()
+            )
+            job.needs_stage = True
+        job.tally = tally
+        job.quanta = 0
+        job.state = RESIDENT
+        self._resident.append(job)
+        self.recorder.record(
+            "job_admitted", job=job.id, shape_key=job.shape_key,
+            restored=job.checkpoint is not None,
+        )
+
+    def _quantum(self, job: Job) -> None:
+        """One scheduling quantum: up to ``quantum_moves`` fused moves
+        for one resident job, then the completion checks."""
+        remaining = job.request.n_moves - job.moves_done
+        k = min(self.quantum, remaining)
+        kw = {}
+        if job.needs_stage:
+            _, w, g, alive = self._padded_inputs(job)
+            kw = dict(weights=w, groups=g, alive=alive)
+            job.needs_stage = False
+        t0 = time.perf_counter()
+        totals = job.tally.run_source_moves(
+            k, job.request.source, **kw
+        )
+        job.moves_done += totals["moves"]
+        job.quanta += 1
+        for key, v in totals.items():
+            job.totals[key] += v
+        job.totals["alive"] = totals["alive"]
+        self._quanta_total.inc()
+        self.recorder.record(
+            "quantum", job=job.id, shape_key=job.shape_key,
+            moves=int(totals["moves"]), move_total=job.moves_done,
+            alive=int(totals["alive"]),
+            seconds=round(time.perf_counter() - t0, 6),
+        )
+        if totals["alive"] == 0 or job.moves_done >= job.request.n_moves:
+            self._finish(job, "completed")
+        elif self.config.convergence and job.tally.converged():
+            self._finish(job, "converged")
+
+    def _finish(self, job: Job, outcome: str) -> None:
+        job.result = job.tally.raw_flux.copy()
+        job.tally.close()
+        job.tally = None
+        if job.checkpoint is not None:
+            try:
+                os.remove(job.checkpoint)
+            except OSError:
+                pass
+            job.checkpoint = None
+        if job in self._resident:
+            self._resident.remove(job)
+        job.state = DONE
+        job.outcome = outcome
+        job.finished_s = time.perf_counter()
+        self._jobs_total.inc(outcome=outcome)
+        self._job_seconds.observe(job.finished_s - job.submitted_s)
+        self.recorder.record(
+            "job_done", job=job.id, shape_key=job.shape_key,
+            outcome=outcome, moves=job.moves_done,
+            preemptions=job.preemptions,
+            seconds=round(job.finished_s - job.submitted_s, 6),
+        )
+
+    def _preempt(self, job: Job) -> None:
+        """Checkpoint-preempt one resident job (megastep boundary —
+        quanta never split) and re-queue it."""
+        path = os.path.join(
+            self.checkpoint_dir, f"{job.id}.ckpt.npz"
+        )
+        job.tally.save_checkpoint(path)
+        job.tally.close()
+        job.tally = None
+        job.checkpoint = path
+        job.preemptions += 1
+        self._resident.remove(job)
+        self._preempt_total.inc()
+        self.recorder.record(
+            "job_preempted", job=job.id, shape_key=job.shape_key,
+            moves=job.moves_done, quanta=job.quanta,
+        )
+        self._enqueue(job)
+
+    # ------------------------------------------------------------------ #
+    # The scheduling loop
+    # ------------------------------------------------------------------ #
+    def step(self) -> bool:
+        """One scheduling round: admit to capacity, run one quantum per
+        resident job (round-robin fairness), then apply the preemption
+        policy.  Returns True while any job is non-terminal."""
+        while len(self._resident) < self.max_resident:
+            nxt = self._pop_next()
+            if nxt is None:
+                break
+            self._admit(nxt)
+            self._queue_depth.set(self.queue_depth)
+        for job in list(self._resident):
+            self._quantum(job)
+        if (
+            self.preempt_after is not None
+            and self.queue_depth > 0
+            and len(self._resident) >= self.max_resident
+        ):
+            # Yield the slot held longest (most quanta since admission,
+            # oldest first on ties) — one per round keeps the policy
+            # simple and the churn bounded.
+            ripe = [
+                j for j in self._resident
+                if j.quanta >= self.preempt_after
+            ]
+            if ripe:
+                self._preempt(max(ripe, key=lambda j: j.quanta))
+        self._queue_depth.set(self.queue_depth)
+        return any(not j.terminal for j in self._jobs.values())
+
+    def run(self, max_rounds: int = 100000) -> None:
+        """Drive scheduling rounds until every submitted job is done."""
+        for _ in range(max_rounds):
+            if not self.step():
+                return
+        raise RuntimeError(
+            f"scheduler did not drain within {max_rounds} rounds "
+            f"({self.queue_depth} queued, {len(self._resident)} "
+            "resident)"
+        )
+
+    # ------------------------------------------------------------------ #
+    # Introspection
+    # ------------------------------------------------------------------ #
+    def job(self, job_id: str) -> Job:
+        return self._jobs[job_id]
+
+    def jobs(self) -> list[Job]:
+        return list(self._jobs.values())
+
+    def result(self, job_id: str) -> np.ndarray:
+        """Raw flux [ntet, n_groups, 2] of one finished job."""
+        job = self._jobs[job_id]
+        if job.result is None:
+            raise RuntimeError(
+                f"job {job_id} is not finished (state={job.state})"
+            )
+        return job.result
+
+    def stats(self) -> dict:
+        """Summary for the bench / serve.py JSON."""
+        outcomes = {
+            s["labels"].get("outcome", ""): int(s["value"])
+            for s in self._jobs_total.snapshot()["series"]
+        }
+        out = {
+            "jobs": len(self._jobs),
+            "outcomes": outcomes,
+            "queue_depth": self.queue_depth,
+            "resident": len(self._resident),
+            "preemptions": int(
+                sum(s["value"]
+                    for s in self._preempt_total.snapshot()["series"])
+            ),
+            "quanta": int(self._quanta_total.value()),
+            "quantum_moves": self.quantum,
+            "max_resident": self.max_resident,
+            "classes": {
+                key: sum(
+                    1 for j in self._jobs.values()
+                    if j.shape_key == key
+                )
+                for key in self._class_order
+            },
+            "aot": self.bank.stats() if self.bank is not None else None,
+        }
+        return out
+
+    def close(self) -> None:
+        """Stop the exporter and drop any resident device state."""
+        for job in list(self._resident):
+            if job.tally is not None:
+                job.tally.close()
+                job.tally = None
+            self._resident.remove(job)
+        if self._exporter is not None:
+            self._exporter.stop()
+            self._exporter = None

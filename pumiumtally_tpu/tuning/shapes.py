@@ -11,9 +11,10 @@ two large axes (``ntet``, ``n_particles``) and stay exact in the small
 ones (``n_groups``, dtype, packedness — each changes the program
 structurally, so they never share a bucket).
 
-The same ladder is the natural shape key for the ROADMAP item-3 AOT
-program bank: a request scheduler that buckets jobs by padded shape
-class reuses ``classify``/``ShapeClass.key()`` unchanged.
+The same ladder IS the shape key of the serving layer (ROADMAP item
+3): the AOT program bank (serving/bank.py) and the request scheduler
+(serving/scheduler.py) bucket jobs by padded shape class through
+``bucket``/``classify``/``ShapeClass.key()`` unchanged.
 """
 from __future__ import annotations
 

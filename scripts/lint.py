@@ -124,18 +124,28 @@ def run_costmodel(args, baseline_entries, verbose, traced=None):
 
     entries = _layer_entries(baseline_entries, "costmodel")
     perf_path = os.path.join(ROOT, args.perf_contracts)
-    cap = M.capture(base_traced=traced)
+    kept_exes: dict = {}
+    cap = M.capture(base_traced=traced, keep_compiled=kept_exes)
     if args.write_perf_contracts:
         M.write_perf_contracts(perf_path, cap)
         print(
             f"wrote {args.perf_contracts} for "
             f"{sorted(cap['families'])} under {cap['environment']}"
         )
-        findings = M.check_cost(cap)
+        findings = M.check_cost(cap) + M.check_aot(
+            traced=traced, compiled=kept_exes
+        )
         kept, suppressed, unused = apply_baseline(findings, entries)
         return report("costmodel", kept, suppressed, unused, verbose,
                       args.allow_stale)
-    findings = M.check_cost(cap)
+    # The AOT round-trip gate (cost.donation.aot): the serving bank's
+    # serialized executables must stay as donated and as callback-free
+    # as the jit path — checked on the base-rung executables the
+    # capture above already compiled (keep_compiled), no second
+    # compile.
+    findings = M.check_cost(cap) + M.check_aot(
+        traced=traced, compiled=kept_exes
+    )
     if os.path.exists(perf_path):
         findings += M.diff_cost(cap, M.load_perf_contracts(perf_path))
     else:

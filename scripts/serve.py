@@ -1,0 +1,130 @@
+#!/usr/bin/env python
+"""Tally-as-a-service entrypoint (ROADMAP item 3).
+
+Stand up the shape-bucketed scheduler over a box mesh with a
+persistent AOT program bank and serve a synthetic many-job workload:
+
+  python scripts/serve.py --demo 8                 # 8 jobs, temp bank
+  python scripts/serve.py --demo 8 --bank BANK/    # persistent bank:
+                                                   # run it twice — the
+                                                   # second process is
+                                                   # the warm, zero-
+                                                   # compile regime
+  python scripts/serve.py --demo 8 --prom-port 9464  # live /metrics
+
+The demo drives the SAME ``run_saturation`` workload driver bench.py's
+``BENCH_SERVE`` probe uses, so the printed ``jobs_per_sec`` is
+directly comparable to the committed bench rows.  Exit 0 = every job
+finished (completed or converged); the JSON summary lands on stdout
+(and ``--out`` when given).
+
+The scheduler admits up to ``--max-resident`` jobs, time-slices at
+megastep ``--quantum`` granularity, evicts converged jobs early when
+``--convergence`` is set, and checkpoint-preempts long residents when
+``--preempt-after`` is set.  ``--bank off`` serves from the jit path
+(every fresh process pays compile cost — the baseline the bank
+exists to beat).
+"""
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--demo", type=int, default=8, metavar="N_JOBS",
+                    help="serve N synthetic jobs and exit (default 8)")
+    ap.add_argument("--cells", type=int, default=4,
+                    help="box subdivisions per axis (ntet = 6*cells^3)")
+    ap.add_argument("--groups", type=int, default=2)
+    ap.add_argument("--dtype", default="float32")
+    ap.add_argument("--bank", default=None, metavar="DIR|off",
+                    help="AOT program-bank root (default: throwaway "
+                         "temp dir; 'off' = jit path)")
+    ap.add_argument("--classes", default="96,192",
+                    help="comma list of request particle counts (each "
+                         "pads to its own shape bucket)")
+    ap.add_argument("--moves", type=int, default=8,
+                    help="device-sourced moves per job")
+    ap.add_argument("--quantum", type=int, default=4,
+                    help="megastep moves per scheduling quantum")
+    ap.add_argument("--max-resident", type=int, default=2)
+    ap.add_argument("--preempt-after", type=int, default=None,
+                    help="quanta before a resident job yields its slot "
+                         "to queued work (checkpoint preemption)")
+    ap.add_argument("--convergence", action="store_true",
+                    help="enable convergence observability + early "
+                         "eviction at the target precision")
+    ap.add_argument("--rel-err-target", type=float, default=0.05)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--prom-port", type=int, default=None,
+                    help="serve live Prometheus /metrics on this port")
+    ap.add_argument("--out", default=None, help="also write JSON here")
+    args = ap.parse_args()
+
+    if args.prom_port is not None:
+        os.environ["PUMI_TPU_PROM_PORT"] = str(args.prom_port)
+
+    from pumiumtally_tpu import TallyConfig, build_box
+    from pumiumtally_tpu.serving import run_saturation
+
+    mesh = build_box(
+        1.0, 1.0, 1.0, args.cells, args.cells, args.cells,
+        dtype=args.dtype,
+    )
+    cfg = TallyConfig(
+        n_groups=args.groups, dtype=args.dtype, tolerance=1e-6,
+        convergence=args.convergence,
+        rel_err_target=args.rel_err_target,
+    )
+    # The bank rides as a PATH: the scheduler then constructs it on
+    # its own registry, so the pumi_aot_* counters land on the same
+    # Prometheus endpoint as the job metrics.
+    tmp_bank = tmp_ck = None
+    if args.bank == "off":
+        bank = None
+    elif args.bank:
+        bank = args.bank
+    else:
+        tmp_bank = bank = tempfile.mkdtemp(prefix="pumi_bank_")
+    ck_dir = None
+    if args.preempt_after is not None:
+        tmp_ck = ck_dir = tempfile.mkdtemp(prefix="pumi_serve_ck_")
+    try:
+        out = run_saturation(
+            mesh, cfg, bank=bank, n_jobs=args.demo,
+            class_sizes=tuple(
+                int(x) for x in args.classes.split(",")
+            ),
+            n_moves=args.moves, seed=args.seed,
+            max_resident=args.max_resident,
+            quantum_moves=args.quantum,
+            preempt_after=args.preempt_after,
+            checkpoint_dir=ck_dir,
+        )
+    finally:
+        for d in (tmp_bank, tmp_ck):
+            if d is not None:
+                shutil.rmtree(d, ignore_errors=True)
+    out.pop("results")  # raw flux arrays — not JSON material
+    text = json.dumps(out, indent=1, sort_keys=True)
+    print(text)
+    if args.out:
+        with open(args.out, "w") as fh:
+            fh.write(text + "\n")
+    bad = [
+        row for row in out["per_job"]
+        if row["outcome"] not in ("completed", "converged")
+    ]
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
