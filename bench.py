@@ -636,7 +636,14 @@ def run_serve_saturation(n_jobs: int, seed: int) -> dict:
     — and record ``jobs_per_sec`` + the bank counters per pass, each
     row tagged with its ``aot`` axis.  The warm pass's flux is checked
     bitwise against the off pass (the AOT-vs-jit parity contract, also
-    pinned in tests/test_serving.py).  Knobs: BENCH_SERVE_CELLS (4),
+    pinned in tests/test_serving.py).  With ``BENCH_SERVE_FAULTS=
+    <spec>`` (the PUMI_TPU_FAULTS grammar, e.g.
+    ``poison_job:1,transient_quantum:2``) a FOURTH pass re-runs the
+    same mix over the warm bank under the fault storm, tagged
+    ``aot="faults"``, recording ``jobs_per_sec`` under fire plus
+    per-job retries/``recovery_seconds`` and the survivor-bitwise
+    check against the off pass (the serving fault-isolation contract,
+    tests/test_serving_resilience.py).  Knobs: BENCH_SERVE_CELLS (4),
     BENCH_SERVE_CLASSES ("96,192"), BENCH_SERVE_MOVES (8),
     BENCH_SERVE_QUANTUM (4), BENCH_SERVE_RESIDENT (2),
     BENCH_SERVE_BANK (default: a throwaway temp dir)."""
@@ -665,12 +672,12 @@ def run_serve_saturation(n_jobs: int, seed: int) -> dict:
         tolerance=1e-6,
     )
 
-    def one_pass(tag, bank):
+    def one_pass(tag, bank, faults=None):
         t0 = time.perf_counter()
         out = run_saturation(
             mesh, cfg, bank=bank, n_jobs=n_jobs, class_sizes=classes,
             n_moves=moves, seed=seed, max_resident=resident,
-            quantum_moves=quantum,
+            quantum_moves=quantum, faults=faults,
         )
         aot = out["scheduler"]["aot"] or {}
         return out, {
@@ -685,6 +692,7 @@ def run_serve_saturation(n_jobs: int, seed: int) -> dict:
             "outcomes": out["scheduler"]["outcomes"],
         }
 
+    fault_spec = os.environ.get("BENCH_SERVE_FAULTS", "")
     try:
         # The bank rides as a path: each pass gets a fresh ProgramBank
         # on the scheduler's own registry (cold = empty dir → misses,
@@ -697,6 +705,50 @@ def run_serve_saturation(n_jobs: int, seed: int) -> dict:
             == off_out["results"][k].tobytes()
             for k in off_out["results"]
         )
+        rows = [off_row, cold_row, warm_row]
+        storm = None
+        if fault_spec:
+            # Fault-storm pass over the warm bank: jobs_per_sec under
+            # fire, per-job MTTR, and survivor-bitwise isolation vs
+            # the fault-free off pass.
+            from pumiumtally_tpu.resilience.faultinject import (
+                FaultInjector,
+                parse_faults,
+            )
+
+            fault_plan = parse_faults(fault_spec)
+            if fault_plan.kill_server_at_quantum is not None:
+                # The crash-model fault kills THIS process — it can
+                # only be measured from outside (the chaos_serve
+                # subprocess driver), never by the in-process bench.
+                raise ValueError(
+                    "BENCH_SERVE_FAULTS: kill_server_at_quantum is "
+                    "the crash-model fault; the bench measures a "
+                    "surviving server — drive server kills through "
+                    "scripts/chaos_serve.py instead"
+                )
+            f_out, f_row = one_pass(
+                "faults", bank_dir,
+                faults=FaultInjector(fault_plan),
+            )
+            f_row["faults"] = fault_spec
+            f_row["retries"] = f_out["scheduler"]["retries"]
+            f_row["per_job"] = [
+                {
+                    "job": r["job"],
+                    "outcome": r["outcome"],
+                    "retries": r["retries"],
+                    "recovery_seconds": r["recovery_seconds"],
+                }
+                for r in f_out["per_job"]
+            ]
+            f_row["survivors_bitwise"] = all(
+                f_out["results"][k].tobytes()
+                == off_out["results"][k].tobytes()
+                for k in f_out["results"]
+            )
+            rows.append(f_row)
+            storm = fault_spec
     finally:
         if tmp is not None:
             shutil.rmtree(tmp, ignore_errors=True)
@@ -708,7 +760,8 @@ def run_serve_saturation(n_jobs: int, seed: int) -> dict:
             "quantum_moves": quantum,
             "max_resident": resident,
             "aot_bitwise_vs_jit": bool(parity),
-            "runs": [off_row, cold_row, warm_row],
+            "fault_storm": storm,
+            "runs": rows,
         }
     }
 

@@ -22,8 +22,10 @@ as first-class):
     ``ChaosInjector`` multi-fault scheduler driving the chaos
     campaigns (scripts/chaos.py, scripts/soak_walk.py --chaos);
   * ``coordinator`` — ``ResilienceCoordinator``: the failure taxonomy
-    ({transient, chip-lost, preempted}) and the per-chip health probe
-    behind the ``pumi_chip_health`` gauge;
+    ({transient, chip-lost, preempted, persistent}) and the per-chip
+    health probe behind the ``pumi_chip_health`` gauge — shared by the
+    run supervisor and the serving scheduler's per-job isolation
+    (serving/scheduler.py);
   * ``elastic`` — mesh-shrink recovery: rebuild the partitioned facade
     on the surviving device set from the layout-independent
     checkpoint state and continue the run.
@@ -42,6 +44,7 @@ from .faultinject import (
     FaultPlan,
     InjectedFault,
     InjectedKill,
+    InjectedPoisonFault,
     InjectedPreemption,
     InjectedTransientFault,
     chaos_plan,
@@ -70,6 +73,7 @@ __all__ = [
     "FaultPlan",
     "InjectedFault",
     "InjectedKill",
+    "InjectedPoisonFault",
     "InjectedPreemption",
     "InjectedTransientFault",
     "parse_faults",

@@ -19,6 +19,13 @@ dispatches on:
     real SIGTERM/SIGINT through the runner's handlers). Recovery: one
     final flush of the LAST-GOOD generation, then die; the next
     process auto-resumes.
+  * ``"persistent"`` — a failure replay cannot fix (a fatal integrity
+    violation, an injected poison job): retrying burns the bounded
+    budget on a deterministic failure. The runner surfaces these
+    before classification (its halt path); the serving scheduler
+    dispatches on the verdict — the job is POISONED (finished
+    ``outcome="poisoned"``, slot freed) and every other job continues
+    bitwise (serving/scheduler.py).
 
 The health probe stages a tiny round-trip computation on every chip of
 the tally's mesh (a dead TPU fails the put or returns garbage) and
@@ -38,10 +45,12 @@ from __future__ import annotations
 
 import numpy as np
 
+from ..integrity.policy import FatalIntegrityViolation
 from ..integrity.watchdog import DispatchTimeoutError
 from .faultinject import (
     ChipLostError,
     FaultInjector,
+    InjectedPoisonFault,
     InjectedPreemption,
     InjectedTransientFault,
 )
@@ -54,7 +63,7 @@ except ImportError:  # pragma: no cover
 
 
 #: The classifier's verdicts, in escalation order.
-VERDICTS = ("transient", "chip-lost", "preempted")
+VERDICTS = ("transient", "chip-lost", "preempted", "persistent")
 
 
 class ResilienceCoordinator:
@@ -153,6 +162,13 @@ class ResilienceCoordinator:
         # bygone all-healthy map would make the recovery skip the
         # shrink and re-dispatch onto the dead chip.
         self._last_probe = None
+        if isinstance(exc, (FatalIntegrityViolation, InjectedPoisonFault)):
+            # Deterministic failures: replaying the same inputs hits
+            # them again — no probe can soften the verdict. (The
+            # runner's halt path intercepts FatalIntegrityViolation
+            # before classifying; the serving scheduler dispatches on
+            # this verdict to poison exactly one job.)
+            return "persistent"
         if isinstance(exc, InjectedPreemption):
             return "preempted"
         if isinstance(exc, ChipLostError):

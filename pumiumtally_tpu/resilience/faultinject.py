@@ -61,6 +61,26 @@ Grammar: comma-separated ``name[:value]`` clauses —
                           corrupt_ckpt byte-flip), so its manifest
                           digest fails and find_latest must reject the
                           WHOLE generation atomically;
+  ``poison_job:K``        the job with submission index K is POISON:
+                          every scheduling quantum it dispatches
+                          raises ``InjectedPoisonFault`` — a
+                          persistent per-job failure the serving
+                          scheduler must isolate (finish the job
+                          ``poisoned``, free its slot) while every
+                          other job continues bitwise
+                          (serving/scheduler.py);
+  ``transient_quantum:K`` job K's next scheduling quantum raises
+                          ``InjectedTransientFault`` once — the
+                          scheduler's bounded per-job retry must
+                          replay the quantum bitwise from the job's
+                          own snapshot;
+  ``kill_server_at_quantum:Q`` the Q-th scheduling quantum the server
+                          executes (1-based, counted across all jobs)
+                          raises ``InjectedKill`` BEFORE the dispatch
+                          — a server crash mid-run. Fires once per
+                          injector (the restarted process is a new
+                          one); recovery is the JOBS.json journal's
+                          ``TallyScheduler.recover`` path;
   ``seed:S``              rng seed for nan_src lane choice (default 0).
 
 The PR 2 modes (nan_src/die/transient/corrupt_ckpt) are driven by the
@@ -102,6 +122,14 @@ class InjectedPreemption(InjectedKill):
     the next process's auto-resume."""
 
 
+class InjectedPoisonFault(InjectedFault):
+    """Simulated persistent per-job failure (a poison job): NOT
+    retryable — replaying the same request hits the same failure every
+    time. The serving scheduler must isolate it (job finished
+    ``poisoned``, device slot freed) instead of retrying forever or
+    taking the server down with it."""
+
+
 class ChipLostError(RuntimeError):
     """A device dropped out of the mesh. Raised by the injector
     (``chip_down_at_move``) and by the coordinator when a health probe
@@ -129,6 +157,9 @@ class FaultPlan:
     chip: int = -1
     preempt_at_move: int | None = None
     torn_shard: int | None = None
+    poison_job: int | None = None
+    transient_quantum: int | None = None
+    kill_server_at_quantum: int | None = None
     seed: int = 0
 
     def any(self) -> bool:
@@ -143,6 +174,9 @@ class FaultPlan:
             or self.chip_down_at_move is not None
             or self.preempt_at_move is not None
             or self.torn_shard is not None
+            or self.poison_job is not None
+            or self.transient_quantum is not None
+            or self.kill_server_at_quantum is not None
         )
 
 
@@ -191,6 +225,17 @@ def parse_faults(spec: str) -> FaultPlan:
                 raise ValueError(
                     f"torn_shard counts generations from 1: {value!r}"
                 )
+        elif name == "poison_job":
+            fields["poison_job"] = int(value)
+        elif name == "transient_quantum":
+            fields["transient_quantum"] = int(value)
+        elif name == "kill_server_at_quantum":
+            fields["kill_server_at_quantum"] = int(value)
+            if fields["kill_server_at_quantum"] < 1:
+                raise ValueError(
+                    "kill_server_at_quantum counts quanta from 1: "
+                    f"{value!r}"
+                )
         elif name == "seed":
             fields["seed"] = int(value)
         else:
@@ -199,7 +244,8 @@ def parse_faults(spec: str) -> FaultPlan:
                 f"(known: nan_src, die_at_move, transient_at_move, "
                 f"corrupt_ckpt, bitflip_flux, sdc_walk, hang_at_move, "
                 f"hang_seconds, chip_down_at_move, chip, "
-                f"preempt_at_move, torn_shard, seed)"
+                f"preempt_at_move, torn_shard, poison_job, "
+                f"transient_quantum, kill_server_at_quantum, seed)"
             )
     return FaultPlan(**fields)
 
@@ -232,6 +278,8 @@ class FaultInjector:
         self.downed: set[int] = set()
         self._ckpt_writes = 0
         self._torn_fired = False
+        self._quantum_transient_fired = False
+        self._server_killed = False
 
     # ------------------------------------------------------------------ #
     def maybe_die(self, move: int) -> None:
@@ -287,6 +335,52 @@ class FaultInjector:
             raise InjectedPreemption(
                 f"injected preemption at move {move} "
                 f"(PUMI_TPU_FAULTS preempt_at_move)"
+            )
+
+    # -- serving-scheduler hooks (per-JOB fault targeting) ------------- #
+    def maybe_poison_job(self, job_index: int) -> None:
+        """``poison_job:K``: job K's quantum dispatches raise a
+        PERSISTENT fault — every time, not once; a poison request does
+        not get better on replay. The scheduler must classify it
+        persistent and isolate the job."""
+        if (
+            self.plan.poison_job is not None
+            and job_index == self.plan.poison_job
+        ):
+            raise InjectedPoisonFault(
+                f"injected poison job at index {job_index} "
+                f"(PUMI_TPU_FAULTS poison_job)"
+            )
+
+    def maybe_transient_quantum(self, job_index: int) -> None:
+        """``transient_quantum:K``: job K's next quantum raises a
+        transient once — the scheduler's bounded retry must absorb it
+        with a bitwise replay from the job's own snapshot."""
+        if (
+            self.plan.transient_quantum is not None
+            and job_index == self.plan.transient_quantum
+            and not self._quantum_transient_fired
+        ):
+            self._quantum_transient_fired = True
+            raise InjectedTransientFault(
+                f"injected transient quantum for job {job_index} "
+                f"(PUMI_TPU_FAULTS transient_quantum)"
+            )
+
+    def maybe_kill_server(self, quantum: int) -> None:
+        """``kill_server_at_quantum:Q``: the server 'crashes' before
+        dispatching its Q-th scheduling quantum (1-based, across all
+        jobs), once per injector. The write-ahead journal must make
+        the next process's ``recover`` resume every job."""
+        if (
+            self.plan.kill_server_at_quantum is not None
+            and quantum == self.plan.kill_server_at_quantum
+            and not self._server_killed
+        ):
+            self._server_killed = True
+            raise InjectedKill(
+                f"injected server kill at quantum {quantum} "
+                f"(PUMI_TPU_FAULTS kill_server_at_quantum)"
             )
 
     def bitflip_at(self, move: int) -> bool:
@@ -419,6 +513,9 @@ class ChaosPlan:
     chip: int = -1
     preempt_move: int | None = None
     torn_generation: int | None = None
+    poison_job: int | None = None
+    transient_quantum: int | None = None
+    kill_server_at_quantum: int | None = None
     seed: int = 0
 
     def describe(self) -> str:
@@ -433,6 +530,12 @@ class ChaosPlan:
             bits.append(f"preempt@{self.preempt_move}")
         if self.torn_generation is not None:
             bits.append(f"torn_shard@gen{self.torn_generation}")
+        if self.poison_job is not None:
+            bits.append(f"poison_job@{self.poison_job}")
+        if self.transient_quantum is not None:
+            bits.append(f"transient_quantum@job{self.transient_quantum}")
+        if self.kill_server_at_quantum is not None:
+            bits.append(f"kill_server@q{self.kill_server_at_quantum}")
         return " ".join(bits)
 
 
@@ -448,12 +551,16 @@ def chaos_plan(spec: str, n_moves: int) -> ChaosPlan:
                         every other fault (so recovery is exercised
                         before the eviction);
       ``torn:G``        tear the G-th checkpoint generation written;
+      ``poison_job:K``  job index K is poison (serving campaigns);
+      ``transient_quantum:K``  one transient on job K's next quantum;
+      ``kill_server:Q`` the server dies before its Q-th quantum;
       ``seed:S``        the schedule seed (default 0).
 
     Same spec + seed + n_moves → the same schedule, so a chaos soak
     failure reproduces exactly."""
     counts = {"transients": 0, "chip_down": 0, "preempt": 0}
     chip, torn, seed = -1, None, 0
+    poison_job = transient_quantum = kill_server = None
     for clause in filter(None, (c.strip() for c in spec.split(","))):
         name, _, value = clause.partition(":")
         if name in counts:
@@ -462,12 +569,19 @@ def chaos_plan(spec: str, n_moves: int) -> ChaosPlan:
             chip = int(value)
         elif name == "torn":
             torn = int(value)
+        elif name == "poison_job":
+            poison_job = int(value)
+        elif name == "transient_quantum":
+            transient_quantum = int(value)
+        elif name == "kill_server":
+            kill_server = int(value)
         elif name == "seed":
             seed = int(value)
         else:
             raise ValueError(
                 f"unknown chaos clause {name!r} (known: transients, "
-                "chip_down, chip, preempt, torn, seed)"
+                "chip_down, chip, preempt, torn, poison_job, "
+                "transient_quantum, kill_server, seed)"
             )
     rng = np.random.default_rng([987654321, seed])
     # Faults land in [2, n_moves-1]: move 1 establishes a good state
@@ -494,6 +608,9 @@ def chaos_plan(spec: str, n_moves: int) -> ChaosPlan:
         chip=chip,
         preempt_move=preempt,
         torn_generation=torn,
+        poison_job=poison_job,
+        transient_quantum=transient_quantum,
+        kill_server_at_quantum=kill_server,
         seed=seed,
     )
 
@@ -503,10 +620,17 @@ class ChaosInjector(FaultInjector):
     fire at SEVERAL moves (fault storms), a chip loss and a preemption
     can ride the same run (fault-during-recovery compositions), and a
     generation tear composes with all of them. Each scheduled fault
-    fires once."""
+    fires once. The serving-side faults (poison job / transient
+    quantum / server kill) ride the inherited FaultPlan hooks, so one
+    chaos schedule can compose per-move and per-job failures."""
 
     def __init__(self, plan: ChaosPlan):
-        super().__init__(FaultPlan(torn_shard=plan.torn_generation))
+        super().__init__(FaultPlan(
+            torn_shard=plan.torn_generation,
+            poison_job=plan.poison_job,
+            transient_quantum=plan.transient_quantum,
+            kill_server_at_quantum=plan.kill_server_at_quantum,
+        ))
         self.chaos = plan
         self._fired_transients: set[int] = set()
 

@@ -47,7 +47,6 @@ Driver shape (the resume-aware loop)::
 """
 from __future__ import annotations
 
-import signal
 import time
 
 import numpy as np
@@ -59,6 +58,11 @@ from ..integrity.policy import (
 from ..integrity.watchdog import DispatchTimeoutError
 from ..utils.checkpoint import restore_state, snapshot_state
 from ..utils.log import log_info, log_warn
+from ..utils.signals import (
+    install_preemption_handlers,
+    resume_previous_handler,
+    uninstall_preemption_handlers,
+)
 from .coordinator import ResilienceCoordinator
 from .faultinject import (
     ChipLostError,
@@ -599,24 +603,14 @@ class ResilientRunner:
     # Preemption handling
     # ------------------------------------------------------------------ #
     def _install_signal_handlers(self) -> None:
-        for sig in (signal.SIGTERM, signal.SIGINT):
-            try:
-                self._prev_handlers[sig] = signal.signal(
-                    sig, self._on_signal
-                )
-            except ValueError:
-                # Not the main thread: signal delivery belongs to the
-                # embedding application; the cadence checkpoints still
-                # bound the loss window.
-                log_warn(
-                    "ResilientRunner: cannot install signal handlers "
-                    "outside the main thread; preemption flush disabled"
-                )
-                return
+        self._prev_handlers = install_preemption_handlers(
+            self._on_signal, "ResilientRunner"
+        )
 
     def _uninstall_signal_handlers(self) -> None:
-        for sig, prev in self._prev_handlers.items():
-            signal.signal(sig, prev)
+        uninstall_preemption_handlers(
+            self._prev_handlers, mine=self._on_signal
+        )
         self._prev_handlers = {}
 
     def _on_signal(self, signum, frame) -> None:
@@ -648,12 +642,7 @@ class ResilientRunner:
             log_warn(f"preemption flush failed: {e}")
         prev = self._prev_handlers.get(signum)
         self._uninstall_signal_handlers()
-        if callable(prev):
-            prev(signum, frame)
-        elif prev == signal.SIG_IGN:
-            return
-        else:
-            raise SystemExit(128 + signum)
+        resume_previous_handler(prev, signum, frame)
 
     # ------------------------------------------------------------------ #
     def close(self, final_checkpoint: bool = True) -> None:

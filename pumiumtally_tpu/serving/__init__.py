@@ -5,17 +5,23 @@
 (shape class x environment section) so a warm server process serves
 jobs with ZERO XLA compiles; ``TallyScheduler`` multiplexes concurrent
 jobs over one device at megastep-K granularity with convergence-based
-early eviction and checkpoint preemption; ``run_saturation`` is the
-shared many-job workload driver behind scripts/serve.py and bench.py's
-``BENCH_SERVE`` probe.
+early eviction, checkpoint preemption, per-job failure isolation
+(transient quanta replay bitwise, persistent failures poison exactly
+one job), admission backpressure, and a crash-safe ``JOBS.json``
+write-ahead journal (``SchedulerJournal``, ``TallyScheduler.recover``)
+so a killed server resumes every job bitwise; ``run_saturation`` is
+the shared many-job workload driver behind scripts/serve.py and
+bench.py's ``BENCH_SERVE`` probe.
 """
 from .bank import ProgramBank, validate_loaded
+from .journal import SchedulerJournal
 from .saturate import run_saturation, synthetic_requests
 from .scheduler import JobRequest, TallyScheduler
 
 __all__ = [
     "JobRequest",
     "ProgramBank",
+    "SchedulerJournal",
     "TallyScheduler",
     "run_saturation",
     "synthetic_requests",

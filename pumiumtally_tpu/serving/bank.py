@@ -453,8 +453,19 @@ class ProgramBank:
                 meta = json.load(fh)
             with open(prog_path, "rb") as fh:
                 payload = fh.read()
-        except (OSError, json.JSONDecodeError) as e:
+        except (OSError, ValueError) as e:
+            # ValueError covers json.JSONDecodeError AND the
+            # UnicodeDecodeError a byte-flipped META raises before the
+            # json parser even runs — a torn/corrupted entry must
+            # degrade to a recompile-and-rewrite, never crash a
+            # dispatch.
             self._note_rewrite(fam, key, "corrupt", f"unreadable: {e}")
+            return (None, "corrupt")
+        if not isinstance(meta, dict):
+            self._note_rewrite(
+                fam, key, "corrupt",
+                f"META.json parses but is not an object: {type(meta).__name__}",
+            )
             return (None, "corrupt")
         if (
             meta.get("schema") != BANK_SCHEMA

@@ -1,0 +1,210 @@
+"""Crash-safe scheduler journal: the ``JOBS.json`` write-ahead log.
+
+A served job must survive the server, not just the device: one SIGKILL
+mid-run previously lost every queued and resident job the scheduler
+held in memory.  This module persists the scheduler's whole job table
+through a single-file write-ahead journal so a fresh process can
+``TallyScheduler.recover(journal_dir)`` and continue every job —
+bitwise, because the megastep RNG is keyed by the persistent move
+counter the PR 2 checkpoints carry.
+
+Layout — one directory per scheduler::
+
+  <journal_dir>/JOBS.json            the journal document (atomic
+                                     tmp+fsync+rename on every flush —
+                                     a crash leaves the previous
+                                     committed document, never a torn
+                                     one)
+  <journal_dir>/<job>.ckpt.npz       the job's latest quantum-boundary
+                                     checkpoint (the PR 2 atomic
+                                     writer; doubles as the preemption
+                                     checkpoint when journaling is on)
+  <journal_dir>/<job>.flux.npy       the finished job's raw flux
+                                     (atomic), so results survive the
+                                     process that computed them
+
+Document format (schema 1)::
+
+  {"schema": 1, "quantum_moves": K,
+   "jobs": {job_id: {id, index, state: "pending"|"done", outcome,
+                     error, shape_key, n, padded_n, moves_done,
+                     preemptions, retries, checkpoint, flux,
+                     request: {...}}}}
+
+Write-ahead discipline: the journal is flushed AFTER every state
+transition (submit/reject/quantum/preempt/finish/poison) and each
+resident job's checkpoint is written BEFORE the flush that references
+it.  The two writes are individually atomic but not jointly: a crash
+between them leaves a journal whose ``moves_done`` lags the checkpoint
+on disk.  That skew is harmless by construction — the checkpoint
+carries its own move counter, recovery re-reads it at restore time,
+and replaying quanta a stale journal forgot is bitwise (the RNG stream
+is keyed by the counter, not by wall history).
+
+Request payloads round-trip EXACTLY: Python's json emits floats via
+``repr`` (shortest round trip), so float64 origins/weights come back
+bit-identical, and ``SourceParams.tables()`` coerces the
+string-keyed region dicts json produces back to integer classes.
+Requests are serialized ONCE at submit and the dict reused on every
+flush, but each flush still rewrites the whole document — the
+single-file layout trades O(jobs) flush cost for atomicity, sized for
+the current single-chip fleet scale (sharding the journal like the
+checkpoint store is the known next step if job counts grow).
+"""
+from __future__ import annotations
+
+import dataclasses
+import io
+import json
+import os
+import re
+
+import numpy as np
+
+from ..utils.checkpoint import _atomic_write_bytes
+
+JOURNAL_SCHEMA = 1
+JOURNAL_FILE = "JOBS.json"
+
+# Journaled job ids become filenames — refuse anything that cannot be
+# one (path separators, parent-dir tricks) before it is persisted.
+_SAFE_ID = re.compile(r"[A-Za-z0-9._-]{1,128}")
+
+
+def check_job_id(job_id: str) -> str:
+    if not _SAFE_ID.fullmatch(job_id) or job_id in (".", ".."):
+        raise ValueError(
+            f"job id {job_id!r} is not journal-safe (allowed: "
+            "1-128 chars of [A-Za-z0-9._-])"
+        )
+    return job_id
+
+
+# --------------------------------------------------------------------- #
+# Request (de)serialization
+# --------------------------------------------------------------------- #
+def request_to_json(request) -> dict:
+    """One JobRequest as a json-safe dict (module docstring contract:
+    float64 payloads survive bitwise through repr round-trip)."""
+    from ..ops.source import SourceParams
+
+    origins = np.asarray(request.origins, np.float64).reshape(-1, 3)
+    src = request.source
+    if src is not None and not isinstance(src, SourceParams):
+        raise TypeError(
+            "journaling serves SourceParams sources only; got "
+            f"{type(src).__name__} (a custom source object cannot be "
+            "reconstructed by a fresh recovery process)"
+        )
+    return {
+        "origins": origins.tolist(),
+        "n_moves": int(request.n_moves),
+        "weights": (
+            None if request.weights is None
+            else np.asarray(request.weights, np.float64)
+            .reshape(-1).tolist()
+        ),
+        "groups": (
+            None if request.groups is None
+            else np.asarray(request.groups, np.int32)
+            .reshape(-1).tolist()
+        ),
+        "source": (
+            None if src is None else dataclasses.asdict(src)
+        ),
+        "job_id": request.job_id,
+    }
+
+
+def request_from_json(d: dict):
+    from ..ops.source import SourceParams
+    from .scheduler import JobRequest
+
+    src = d.get("source")
+    return JobRequest(
+        origins=np.asarray(d["origins"], np.float64).reshape(-1, 3),
+        n_moves=int(d["n_moves"]),
+        source=None if src is None else SourceParams(**src),
+        weights=(
+            None if d.get("weights") is None
+            else np.asarray(d["weights"], np.float64)
+        ),
+        groups=(
+            None if d.get("groups") is None
+            else np.asarray(d["groups"], np.int32)
+        ),
+        job_id=d.get("job_id"),
+    )
+
+
+# --------------------------------------------------------------------- #
+# The journal
+# --------------------------------------------------------------------- #
+class SchedulerJournal:
+    """Atomic JOBS.json document plus the per-job checkpoint/flux
+    side files (module docstring layout).  The scheduler is the single
+    writer; recovery is the single reader."""
+
+    def __init__(self, dirname: str):
+        self.dir = str(dirname)
+        os.makedirs(self.dir, exist_ok=True)
+        self.path = os.path.join(self.dir, JOURNAL_FILE)
+
+    # -- side files ---------------------------------------------------- #
+    def checkpoint_path(self, job_id: str) -> str:
+        return os.path.join(self.dir, f"{job_id}.ckpt.npz")
+
+    def flux_path(self, job_id: str) -> str:
+        return os.path.join(self.dir, f"{job_id}.flux.npy")
+
+    def write_flux(self, job_id: str, arr: np.ndarray) -> str:
+        """Persist one finished job's raw flux atomically; returns the
+        journal-relative name the document records."""
+        buf = io.BytesIO()
+        np.save(buf, np.asarray(arr))
+        _atomic_write_bytes(self.flux_path(job_id), buf.getvalue())
+        return os.path.basename(self.flux_path(job_id))
+
+    def load_flux(self, job_id: str) -> np.ndarray | None:
+        path = self.flux_path(job_id)
+        if not os.path.exists(path):
+            return None
+        return np.load(path)
+
+    def remove_sidefiles(self, job_id: str, *, flux: bool = False) -> None:
+        paths = [self.checkpoint_path(job_id)]
+        if flux:
+            paths.append(self.flux_path(job_id))
+        for p in paths:
+            try:
+                os.remove(p)
+            except OSError:
+                pass
+
+    # -- the document -------------------------------------------------- #
+    def flush(self, entries: list[dict], *, quantum_moves: int) -> None:
+        doc = {
+            "schema": JOURNAL_SCHEMA,
+            "quantum_moves": int(quantum_moves),
+            "jobs": {e["id"]: e for e in entries},
+        }
+        _atomic_write_bytes(
+            self.path,
+            (json.dumps(doc, indent=1, sort_keys=True) + "\n").encode(),
+        )
+
+    def load(self) -> dict | None:
+        """The committed document, or None when no journal exists yet.
+        A parse failure is a real error (the atomic writer cannot tear
+        the file — unreadable means someone else wrote it)."""
+        if not os.path.exists(self.path):
+            return None
+        with open(self.path) as fh:
+            doc = json.load(fh)
+        if not isinstance(doc, dict) or doc.get("schema") != JOURNAL_SCHEMA:
+            raise ValueError(
+                f"journal {self.path}: schema "
+                f"{doc.get('schema') if isinstance(doc, dict) else doc!r}"
+                f" != {JOURNAL_SCHEMA}"
+            )
+        return doc

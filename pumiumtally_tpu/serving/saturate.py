@@ -13,6 +13,8 @@ import time
 
 import numpy as np
 
+from ..resilience.faultinject import InjectedKill
+
 
 def synthetic_requests(
     mesh,
@@ -59,30 +61,72 @@ def run_saturation(
     quantum_moves: int | None = None,
     preempt_after: int | None = None,
     checkpoint_dir: str | None = None,
+    max_queued: int | None = None,
+    job_retries: int = 2,
+    quantum_deadline_s: float | None = None,
+    journal_dir: str | None = None,
+    resume: bool = False,
+    faults=None,
 ) -> dict:
     """Submit the synthetic workload, drain the scheduler, and return
     the measurement record: ``jobs_per_sec`` over the drain window
     (submission is instant; the window prices scheduling + dispatch),
-    the scheduler/bank counter summary, and per-job rows."""
+    the scheduler/bank counter summary, and per-job rows.
+
+    ``resume=True`` with a populated ``journal_dir`` recovers the
+    previous process's job table first (``TallyScheduler.recover``)
+    and only submits fleet members the journal does not already know —
+    the restart path of a killed server re-runs the SAME call and
+    loses nothing."""
+    import os
+
+    from .journal import JOURNAL_FILE
     from .scheduler import TallyScheduler
 
-    sched = TallyScheduler(
-        mesh,
-        config,
+    kwargs = dict(
         bank=bank,
         max_resident=max_resident,
         quantum_moves=quantum_moves,
         preempt_after=preempt_after,
         checkpoint_dir=checkpoint_dir,
+        max_queued=max_queued,
+        job_retries=job_retries,
+        quantum_deadline_s=quantum_deadline_s,
+        faults=faults,
     )
+    if (
+        resume
+        and journal_dir is not None
+        and os.path.exists(os.path.join(journal_dir, JOURNAL_FILE))
+    ):
+        sched = TallyScheduler.recover(journal_dir, mesh, config, **kwargs)
+    else:
+        sched = TallyScheduler(
+            mesh, config, journal_dir=journal_dir, **kwargs
+        )
+    crashed = False
     try:
         requests = synthetic_requests(
             mesh, n_jobs, class_sizes=class_sizes, n_moves=n_moves,
             seed=seed,
         )
-        ids = [sched.submit(r) for r in requests]
+        known = {j.id for j in sched.jobs()}
+        ids = [
+            r.job_id if r.job_id in known else sched.submit(r)
+            for r in requests
+        ]
         t0 = time.perf_counter()
-        sched.run()
+        try:
+            sched.run()
+        except InjectedKill:
+            # A modeled server crash: skip close() and its graceful
+            # checkpoint parking — recovery must work from the
+            # write-ahead journal ALONE (the chaos-campaign contract).
+            # abandon() still releases device state and the signal
+            # handlers, which a real dead process would not hold.
+            crashed = True
+            sched.abandon()
+            raise
         elapsed = time.perf_counter() - t0
         stats = sched.stats()
         per_job = [
@@ -92,6 +136,9 @@ def run_saturation(
                 "outcome": j.outcome,
                 "moves": j.moves_done,
                 "preemptions": j.preemptions,
+                "retries": j.retries,
+                "recovery_seconds": round(j.recovery_seconds, 4),
+                "error": j.error,
             }
             for j in (sched.job(i) for i in ids)
         ]
@@ -105,8 +152,13 @@ def run_saturation(
             "per_job": per_job,
             # Raw flux per job id — callers that verify bitwise parity
             # (tests, the bench's off-vs-warm check) read these; JSON
-            # writers drop the arrays first.
-            "results": {i: sched.result(i) for i in ids},
+            # writers drop the arrays first.  Poisoned/rejected jobs
+            # have no flux and no entry.
+            "results": {
+                i: sched.result(i) for i in ids
+                if sched.job(i).result is not None
+            },
         }
     finally:
-        sched.close()
+        if not crashed:
+            sched.close()

@@ -31,11 +31,50 @@ device.  This scheduler makes that a first-class loop:
     counter, so replay equals the uninterrupted run —
     tests/test_serving.py pins it).
 
+Serving under failure (the fault-isolation layer)
+-------------------------------------------------
+A multi-tenant server must contain one job's failure to that job:
+
+  * Every quantum dispatch is CLASSIFIED through the PR 11 failure
+    taxonomy (``resilience/coordinator.py``).  A ``transient`` verdict
+    (injected transients, retryable JAX runtime errors, a watchdog
+    timeout with the chip still answering its probe) replays the
+    quantum BITWISE from the job's own pre-quantum snapshot — the same
+    ``snapshot_state`` payload the checkpoint subsystem persists —
+    with bounded exponential backoff, counted in
+    ``pumi_job_retries_total{cause}``.  A ``persistent`` verdict (a
+    fatal integrity violation, an injected poison job) or an exhausted
+    retry budget POISONS the job: finished ``outcome="poisoned"``,
+    device slot freed, and every other resident and queued job
+    continues bitwise-identical to a fault-free run (jobs are
+    facade-isolated; scheduling order never enters their RNG streams).
+  * ADMISSION CONTROL: ``max_queued`` bounds the wait queue — an
+    over-limit submission is finished ``outcome="rejected"`` (named
+    backpressure) instead of growing the queue without bound.
+  * A per-quantum DEADLINE (``quantum_deadline_s``) arms the PR 4
+    dispatch watchdog inside every job facade, so one wedged dispatch
+    surfaces as a classified ``DispatchTimeoutError`` instead of
+    stalling the round-robin loop forever (first dispatch per program
+    kind keeps the compile amnesty).
+  * The CRASH-SAFE JOURNAL (``journal_dir``, serving/journal.py): the
+    whole job table rides a ``JOBS.json`` write-ahead log — request
+    params, shape key, moves_done, checkpoint, outcome — flushed
+    atomically after every state transition, with each resident job
+    checkpointed at its quantum boundary BEFORE the flush that
+    references it, a SIGTERM/SIGINT flush, and a
+    ``TallyScheduler.recover(journal_dir)`` startup path that
+    re-queues interrupted jobs from their checkpoints and resumes
+    bitwise (over a warm program bank the restarted process compiles
+    nothing).  Finished fluxes persist beside the journal, so a
+    restart loses zero jobs — not even completed ones.
+
 Observability rides the PR 1/PR 5 machinery: ``pumi_jobs_total
-{outcome}``, ``pumi_queue_depth``, ``pumi_preemptions_total``, the
-bank's ``pumi_aot_hits_total`` / ``pumi_aot_misses_total`` /
-``pumi_compile_seconds_total`` (one shared registry), per-job and
-per-quantum flight records, and the live Prometheus endpoint via
+{outcome}``, ``pumi_queue_depth``, ``pumi_preemptions_total``,
+``pumi_job_retries_total{cause}``, the ``pumi_job_queue_seconds``
+wait histogram, the bank's ``pumi_aot_hits_total`` /
+``pumi_aot_misses_total`` / ``pumi_compile_seconds_total`` (one shared
+registry), per-job and per-quantum flight records plus
+journal/recovery records, and the live Prometheus endpoint via
 ``PUMI_TPU_PROM_PORT``.
 """
 from __future__ import annotations
@@ -45,13 +84,34 @@ import contextlib
 import dataclasses
 import os
 import time
+import types
 
 import numpy as np
 
+from ..integrity.watchdog import DispatchTimeoutError
 from ..obs import FlightRecorder, MetricsRegistry, maybe_start_exporter
+from ..resilience.coordinator import ResilienceCoordinator
+from ..resilience.faultinject import FaultInjector, InjectedKill
 from ..tuning.shapes import bucket, classify
+from ..utils.checkpoint import (
+    restore_state,
+    snapshot_state,
+    verify_checkpoint,
+)
 from ..utils.config import TallyConfig
+from ..utils.log import log_info, log_warn
+from ..utils.signals import (
+    install_preemption_handlers,
+    resume_previous_handler,
+    uninstall_preemption_handlers,
+)
 from .bank import ProgramBank
+from .journal import (
+    SchedulerJournal,
+    check_job_id,
+    request_from_json,
+    request_to_json,
+)
 
 # Job lifecycle: queued -> resident -> (preempted -> queued ->)* -> done
 QUEUED, RESIDENT, PREEMPTED, DONE = (
@@ -79,23 +139,30 @@ class Job:
     """Scheduler-internal job state."""
 
     def __init__(self, job_id: str, request: JobRequest, n: int,
-                 padded_n: int, shape_key: str):
+                 padded_n: int, shape_key: str, index: int = 0):
         self.id = job_id
+        self.index = index         # submission ordinal (fault targeting)
         self.request = request
         self.n = n
         self.padded_n = padded_n
         self.shape_key = shape_key
         self.state = QUEUED
         self.outcome: str | None = None
+        self.error: str | None = None
         self.tally = None
         self.moves_done = 0
         self.quanta = 0            # quanta run since last admission
         self.preemptions = 0
+        self.retries = 0           # transient quanta replayed
+        self.recovery_seconds = 0.0
         self.needs_stage = True    # first quantum stages the lanes
         self.checkpoint: str | None = None
         self.result: np.ndarray | None = None
+        self.flux_name: str | None = None   # journal-relative, if any
+        self.request_json: dict | None = None  # serialized-once cache
         self.totals: dict = collections.defaultdict(float)
         self.submitted_s = time.perf_counter()
+        self.enqueued_s = self.submitted_s
         self.finished_s: float | None = None
 
     @property
@@ -137,7 +204,24 @@ class TallyScheduler:
         other jobs queue before it is checkpoint-preempted (None: run
         to completion).
       checkpoint_dir: where preemption checkpoints live (required when
-        ``preempt_after`` is set).
+        ``preempt_after`` is set and no journal_dir is given — a
+        journaled scheduler preempts into its journal directory).
+      max_queued: admission backpressure — a submission arriving with
+        this many jobs already waiting is finished
+        ``outcome="rejected"`` instead of queued (None: unbounded).
+      job_retries: bounded per-quantum replay budget for transient
+        failures (0 disables snapshots and retries — any dispatch
+        failure poisons the job).
+      quantum_deadline_s: per-quantum dispatch watchdog deadline
+        (integrity/watchdog.py via the job configs' move_deadline_s);
+        a timeout is classified like any transient.
+      journal_dir: the JOBS.json write-ahead journal directory
+        (serving/journal.py); enables ``recover`` and the
+        SIGTERM/SIGINT flush.
+      faults: the scheduler-level FaultInjector driving the per-job
+        fault hooks (poison_job / transient_quantum /
+        kill_server_at_quantum); default: one built from
+        PUMI_TPU_FAULTS.
     """
 
     def __init__(
@@ -150,7 +234,16 @@ class TallyScheduler:
         quantum_moves: int | None = None,
         preempt_after: int | None = None,
         checkpoint_dir: str | None = None,
+        max_queued: int | None = None,
+        job_retries: int = 2,
+        backoff_base: float = 0.05,
+        backoff_max: float = 2.0,
+        quantum_deadline_s: float | None = None,
+        journal_dir: str | None = None,
+        faults: FaultInjector | None = None,
+        handle_signals: bool = True,
         registry: MetricsRegistry | None = None,
+        sleep=time.sleep,
     ):
         self.mesh = mesh
         base = config or TallyConfig()
@@ -166,17 +259,40 @@ class TallyScheduler:
         # and a job interleaved with others chains bitwise-identically
         # to the same chunks run back to back.
         self.config = dataclasses.replace(base, megastep=self.quantum)
+        if quantum_deadline_s is not None:
+            self.config = dataclasses.replace(
+                self.config, move_deadline_s=float(quantum_deadline_s)
+            )
         self.max_resident = int(max_resident)
         if self.max_resident < 1:
             raise ValueError(
                 f"max_resident must be >= 1: {self.max_resident}"
             )
+        self.max_queued = None if max_queued is None else int(max_queued)
+        if self.max_queued is not None and self.max_queued < 1:
+            raise ValueError(
+                f"max_queued must be >= 1: {self.max_queued}"
+            )
+        self.job_retries = int(job_retries)
+        self.backoff_base = float(backoff_base)
+        self.backoff_max = float(backoff_max)
+        self._sleep = sleep
+        self.faults = faults if faults is not None else FaultInjector()
+        self.journal = (
+            SchedulerJournal(journal_dir)
+            if journal_dir is not None else None
+        )
         self.preempt_after = preempt_after
         self.checkpoint_dir = checkpoint_dir
-        if preempt_after is not None and checkpoint_dir is None:
+        if (
+            preempt_after is not None
+            and checkpoint_dir is None
+            and self.journal is None
+        ):
             raise ValueError(
-                "preempt_after needs checkpoint_dir (preemption "
-                "persists job state through the checkpoint subsystem)"
+                "preempt_after needs checkpoint_dir or journal_dir "
+                "(preemption persists job state through the "
+                "checkpoint subsystem)"
             )
         if checkpoint_dir is not None:
             # Fail at construction, not at the first mid-run
@@ -197,7 +313,10 @@ class TallyScheduler:
             "pumi_jobs_total",
             "served tally jobs by outcome (completed: move budget "
             "exhausted or all particles terminated; converged: "
-            "evicted early at the requested precision; failed)",
+            "evicted early at the requested precision; poisoned: "
+            "isolated after a persistent per-job failure or an "
+            "exhausted retry budget; rejected: admission "
+            "backpressure at max_queued)",
         )
         self._queue_depth = r.gauge(
             "pumi_queue_depth",
@@ -217,6 +336,29 @@ class TallyScheduler:
             "pumi_job_seconds",
             "wall seconds from job submission to completion",
         )
+        self._retries_total = r.counter(
+            "pumi_job_retries_total",
+            "per-job quantum replays after a transient-classified "
+            "dispatch failure (labeled by cause: transient, timeout)",
+        )
+        self._queue_seconds = r.histogram(
+            "pumi_job_queue_seconds",
+            "wall seconds a job waited in the admission queue before "
+            "each (re)admission to a device slot",
+        )
+        self._recovered_total = r.counter(
+            "pumi_jobs_recovered_total",
+            "jobs re-queued from the JOBS.json journal at recovery "
+            "(labeled by source: checkpoint = resumed mid-run, "
+            "scratch = request replayed from move 0)",
+        )
+        # The PR 11 failure taxonomy, shared with ResilientRunner: one
+        # coordinator on the SCHEDULER registry, rebound to the failing
+        # job's facade at classification time (the probe needs the
+        # job's device set; the counters belong to the server).
+        self._coordinator = ResilienceCoordinator(
+            types.SimpleNamespace(metrics=r), faults=self.faults
+        )
         # Per-class FIFO queues + a rotation pointer: admission takes
         # one job per class in turn, so a burst in one shape bucket
         # cannot starve the others.
@@ -226,6 +368,13 @@ class TallyScheduler:
         self._resident: list[Job] = []
         self._jobs: dict[str, Job] = {}
         self._n_submitted = 0
+        self._n_quanta = 0          # lifetime quanta (fault targeting)
+        self._n_recovered = 0
+        self._in_step = False
+        self._pending_signal: int | None = None
+        self._prev_handlers: dict = {}
+        if self.journal is not None and handle_signals:
+            self._install_signal_handlers()
         self._exporter = maybe_start_exporter(self.registry)
 
     # ------------------------------------------------------------------ #
@@ -261,14 +410,48 @@ class TallyScheduler:
         job_id = request.job_id or f"job-{self._n_submitted:05d}"
         if job_id in self._jobs:
             raise ValueError(f"duplicate job id {job_id!r}")
+        # The id becomes filenames (journal sidefiles AND the
+        # preemption checkpoint path) — refuse path tricks up front,
+        # journaled or not.
+        check_job_id(job_id)
+        # Serialize the (immutable) request ONCE; every journal flush
+        # reuses the dict instead of re-walking the float64 payload.
+        request_json = (
+            request_to_json(request) if self.journal is not None
+            else None
+        )
+        job = Job(
+            job_id, request, n, padded_n, shape.key(),
+            index=self._n_submitted,
+        )
+        job.request_json = request_json
         self._n_submitted += 1
-        job = Job(job_id, request, n, padded_n, shape.key())
         self._jobs[job_id] = job
+        if (
+            self.max_queued is not None
+            and self.queue_depth >= self.max_queued
+        ):
+            # Named backpressure: the job is terminal on arrival — the
+            # caller sees outcome="rejected" instead of an unbounded
+            # queue absorbing work the server cannot promise to run.
+            job.state = DONE
+            job.outcome = "rejected"
+            job.finished_s = time.perf_counter()
+            self._jobs_total.inc(outcome="rejected")
+            self._job_seconds.observe(job.finished_s - job.submitted_s)
+            self.recorder.record(
+                "job_rejected", job=job_id, shape_key=job.shape_key,
+                queue_depth=self.queue_depth,
+                max_queued=self.max_queued,
+            )
+            self._flush_journal()
+            return job_id
         self._enqueue(job)
         self.recorder.record(
             "job_submitted", job=job_id, shape_key=job.shape_key,
             n=n, padded_n=padded_n, n_moves=int(request.n_moves),
         )
+        self._flush_journal()
         return job_id
 
     def _enqueue(self, job: Job) -> None:
@@ -278,6 +461,7 @@ class TallyScheduler:
             self._class_order.append(job.shape_key)
         q.append(job)
         job.state = QUEUED if job.checkpoint is None else PREEMPTED
+        job.enqueued_s = time.perf_counter()
         self._queue_depth.set(self.queue_depth)
 
     @property
@@ -297,6 +481,192 @@ class TallyScheduler:
             if q:
                 return q.popleft()
         return None
+
+    # ------------------------------------------------------------------ #
+    # Crash-safe journal + recovery
+    # ------------------------------------------------------------------ #
+    def _journal_entry(self, job: Job) -> dict:
+        done = job.state == DONE
+        if job.request_json is None:
+            job.request_json = request_to_json(job.request)
+        return {
+            "id": job.id,
+            "index": job.index,
+            "state": "done" if done else "pending",
+            "outcome": job.outcome,
+            "error": job.error,
+            "shape_key": job.shape_key,
+            "n": job.n,
+            "padded_n": job.padded_n,
+            "moves_done": job.moves_done,
+            "preemptions": job.preemptions,
+            "retries": job.retries,
+            # Terminal records never reference a checkpoint: the side
+            # file is deleted AFTER the flush that marks the job done
+            # (write-ahead order — a crash between the two must not
+            # leave a record pointing at a removed file).
+            "checkpoint": (
+                os.path.basename(job.checkpoint)
+                if job.checkpoint is not None and not done else None
+            ),
+            "flux": job.flux_name,
+            "request": job.request_json,
+        }
+
+    def _flush_journal(self) -> None:
+        if self.journal is None:
+            return
+        self.journal.flush(
+            [
+                self._journal_entry(j)
+                for j in sorted(
+                    self._jobs.values(), key=lambda j: j.index
+                )
+            ],
+            quantum_moves=self.quantum,
+        )
+
+    def _journal_checkpoint(self, job: Job) -> None:
+        """Quantum-boundary checkpoint into the journal dir (written
+        BEFORE the journal flush that references it — the write-ahead
+        discipline serving/journal.py documents)."""
+        if self.journal is None or job.tally is None:
+            return
+        path = self.journal.checkpoint_path(job.id)
+        job.tally.save_checkpoint(path)
+        job.checkpoint = path
+
+    @classmethod
+    def recover(cls, journal_dir: str, mesh,
+                config: TallyConfig | None = None, **kwargs):
+        """Build a scheduler over an existing journal and re-queue
+        every interrupted job: terminal jobs come back with their
+        outcome (and their persisted flux, so results survive the
+        process that computed them); pending jobs resume from their
+        quantum-boundary checkpoint when it verifies — BITWISE, since
+        the megastep RNG is keyed by the restored move counter — or
+        replay from move 0 when it does not (also bitwise: the whole
+        trajectory re-runs).  Over a warm program bank the recovered
+        process compiles no program family."""
+        sched = cls(mesh, config, journal_dir=journal_dir, **kwargs)
+        doc = sched.journal.load()
+        if not doc:
+            return sched
+        for entry in sorted(
+            doc.get("jobs", {}).values(), key=lambda e: e["index"]
+        ):
+            sched._recover_job(entry)
+        sched._n_submitted = max(
+            (j.index + 1 for j in sched._jobs.values()),
+            default=sched._n_submitted,
+        )
+        sched.recorder.record(
+            "journal_recovery", jobs=len(sched._jobs),
+            recovered=sched._n_recovered,
+            quantum_moves=doc.get("quantum_moves"),
+        )
+        log_info(
+            f"scheduler recovery: {len(sched._jobs)} journaled jobs, "
+            f"{sched._n_recovered} re-queued from {journal_dir}"
+        )
+        sched._flush_journal()
+        return sched
+
+    def _recover_job(self, entry: dict) -> None:
+        request = request_from_json(entry["request"])
+        origins = np.asarray(request.origins, np.float64).reshape(-1, 3)
+        n = origins.shape[0]
+        padded_n = bucket(n)
+        cfg = self.config
+        shape_key = classify(
+            self.mesh.ntet, padded_n, cfg.n_groups, cfg.dtype,
+            getattr(self.mesh, "geo20", None) is not None,
+        ).key()
+        job = Job(
+            entry["id"], request, n, padded_n, shape_key,
+            index=int(entry["index"]),
+        )
+        job.request_json = entry["request"]
+        job.preemptions = int(entry.get("preemptions", 0))
+        job.retries = int(entry.get("retries", 0))
+        job.error = entry.get("error")
+        self._jobs[job.id] = job
+        if entry["state"] == "done":
+            job.state = DONE
+            job.outcome = entry.get("outcome")
+            job.moves_done = int(entry.get("moves_done", 0))
+            job.finished_s = job.submitted_s
+            if entry.get("flux"):
+                job.result = self.journal.load_flux(job.id)
+                job.flux_name = entry["flux"]
+            return
+        source = "scratch"
+        if entry.get("checkpoint"):
+            ck = self.journal.checkpoint_path(job.id)
+            try:
+                verify_checkpoint(ck)
+                job.checkpoint = ck
+                job.moves_done = int(entry.get("moves_done", 0))
+                source = "checkpoint"
+            except Exception as e:
+                # Torn/corrupt/missing checkpoint: the request is
+                # still intact in the journal — replay from move 0
+                # (bitwise: the whole stream re-runs on the same
+                # counter keys) instead of losing the job.
+                log_warn(
+                    f"scheduler recovery: checkpoint for {job.id} "
+                    f"unusable ({e}); replaying from move 0"
+                )
+        self._enqueue(job)
+        self._n_recovered += 1
+        self._recovered_total.inc(source=source)
+        self.recorder.record(
+            "journal_recovered", job=job.id, shape_key=job.shape_key,
+            source=source, moves_done=job.moves_done,
+        )
+
+    # ------------------------------------------------------------------ #
+    # Preemption-signal flush (journaled schedulers only)
+    # ------------------------------------------------------------------ #
+    def _install_signal_handlers(self) -> None:
+        self._prev_handlers = install_preemption_handlers(
+            self._on_signal, "TallyScheduler"
+        )
+
+    def _uninstall_signal_handlers(self) -> None:
+        uninstall_preemption_handlers(
+            self._prev_handlers, mine=self._on_signal
+        )
+        self._prev_handlers = {}
+
+    def _on_signal(self, signum, frame) -> None:
+        if self._in_step:
+            # Mid-quantum: defer to the quantum boundary so the
+            # flushed checkpoints are consistent post-dispatch states.
+            self._pending_signal = signum
+            return
+        self._signal_flush(signum, frame)
+
+    def _signal_flush(self, signum, frame) -> None:
+        """One final checkpoint of every resident job + a journal
+        flush, then die the way the process would have without us —
+        the next process's ``recover`` resumes every job."""
+        for job in list(self._resident):
+            try:
+                self._journal_checkpoint(job)
+            except Exception as e:  # pragma: no cover - best-effort
+                log_warn(f"preemption checkpoint of {job.id} failed: {e}")
+        try:
+            self._flush_journal()
+            log_info(
+                f"scheduler preemption flush: journal written on "
+                f"signal {signum}"
+            )
+        except Exception as e:  # pragma: no cover - flush best-effort
+            log_warn(f"scheduler preemption flush failed: {e}")
+        prev = self._prev_handlers.get(signum)
+        self._uninstall_signal_handlers()
+        resume_previous_handler(prev, signum, frame)
 
     # ------------------------------------------------------------------ #
     # Padding helpers
@@ -328,26 +698,97 @@ class TallyScheduler:
     # ------------------------------------------------------------------ #
     # Residency
     # ------------------------------------------------------------------ #
-    def _admit(self, job: Job) -> None:
+    def _admit(self, job: Job) -> bool:
         from ..api import PumiTally
 
-        with _quiet_exporter():
-            tally = PumiTally(
-                self.mesh, job.padded_n, self.config,
-                program_bank=self.bank,
-            )
-        if job.checkpoint is not None:
-            # Preempted job: restore the exact megastep boundary it was
-            # parked at — the move counter keys the RNG stream, so the
-            # continuation is bitwise the uninterrupted run.
-            tally.restore_checkpoint(job.checkpoint)
-            job.needs_stage = False
-        else:
-            origins_p, _, _, _ = self._padded_inputs(job)
-            tally.initialize_particle_location(
-                origins_p.reshape(-1).copy()
-            )
-            job.needs_stage = True
+        self._queue_seconds.observe(
+            time.perf_counter() - job.enqueued_s
+        )
+        tally = None
+        try:
+            with _quiet_exporter():
+                tally = PumiTally(
+                    self.mesh, job.padded_n, self.config,
+                    program_bank=self.bank,
+                )
+            restored = False
+            if job.checkpoint is not None:
+                # Preempted/recovered job: restore the exact megastep
+                # boundary it was parked at — the move counter keys the
+                # RNG stream, so the continuation is bitwise the
+                # uninterrupted run.  An unusable checkpoint falls back
+                # to a from-scratch replay (also bitwise) instead of
+                # failing the job.
+                try:
+                    tally.restore_checkpoint(job.checkpoint)
+                    restored = True
+                except Exception as e:
+                    log_warn(
+                        f"checkpoint restore for {job.id} failed "
+                        f"({e}); replaying from move 0"
+                    )
+                    job.checkpoint = None
+                    job.moves_done = 0
+            if restored:
+                # The checkpoint's own counter is the truth — a journal
+                # written just before a crash may lag it by one quantum.
+                job.moves_done = int(tally.iter_count)
+                job.needs_stage = False
+            else:
+                origins_p, _, _, _ = self._padded_inputs(job)
+                tally.initialize_particle_location(
+                    origins_p.reshape(-1).copy()
+                )
+                job.needs_stage = True
+        except InjectedKill:
+            raise
+        except Exception as e:
+            if tally is not None:
+                # Constructed but never handed to the job: release its
+                # device buffers before deciding the job's fate.
+                try:
+                    tally.close()
+                except Exception:  # pragma: no cover - best-effort
+                    pass
+            # Admission failures go through the SAME taxonomy as
+            # quantum failures: a transient verdict (retryable runtime
+            # error, timeout with healthy chips) re-queues the job
+            # against its bounded retry budget instead of permanently
+            # poisoning work one replay would have saved.
+            self._coordinator.rebind(types.SimpleNamespace())
+            verdict = self._coordinator.classify(e)
+            if verdict == "transient" and job.retries < self.job_retries:
+                job.retries += 1
+                cause = (
+                    "timeout"
+                    if isinstance(e, DispatchTimeoutError)
+                    else "transient"
+                )
+                self._retries_total.inc(cause=cause)
+                log_warn(
+                    f"admission of {job.id} failed transiently ({e}); "
+                    f"re-queueing (attempt {job.retries}/"
+                    f"{self.job_retries})"
+                )
+                self.recorder.record(
+                    "job_retry", job=job.id, shape_key=job.shape_key,
+                    cause=cause, attempt=job.retries, at="admission",
+                    error=str(e)[:200],
+                )
+                self._sleep(min(
+                    self.backoff_base * 2 ** (job.retries - 1),
+                    self.backoff_max,
+                ))
+                self._enqueue(job)
+            else:
+                self._poison(
+                    job, e,
+                    cause=(
+                        "retries-exhausted" if verdict == "transient"
+                        else verdict
+                    ),
+                )
+            return False
         job.tally = tally
         job.quanta = 0
         job.state = RESIDENT
@@ -356,21 +797,98 @@ class TallyScheduler:
             "job_admitted", job=job.id, shape_key=job.shape_key,
             restored=job.checkpoint is not None,
         )
+        return True
 
     def _quantum(self, job: Job) -> None:
         """One scheduling quantum: up to ``quantum_moves`` fused moves
-        for one resident job, then the completion checks."""
+        for one resident job, then the completion checks.  The
+        dispatch runs under the per-job failure containment loop
+        (module docstring): transient-classified failures replay the
+        quantum bitwise from the job's pre-quantum snapshot with
+        bounded backoff; everything else poisons THIS job only."""
         remaining = job.request.n_moves - job.moves_done
+        if remaining <= 0:
+            # A recovered checkpoint already at the move budget (the
+            # crash landed between the final checkpoint and the finish
+            # record): nothing to dispatch — the restored accumulator
+            # IS the result.
+            self._finish(job, "completed")
+            return
         k = min(self.quantum, remaining)
         kw = {}
         if job.needs_stage:
             _, w, g, alive = self._padded_inputs(job)
             kw = dict(weights=w, groups=g, alive=alive)
-            job.needs_stage = False
-        t0 = time.perf_counter()
-        totals = job.tally.run_source_moves(
-            k, job.request.source, **kw
+        self._n_quanta += 1
+        # Crash model: the injected server kill propagates raw — no
+        # flush, no cleanup.  The write-ahead journal must already
+        # hold everything recovery needs (that is the contract the
+        # chaos campaign proves).
+        self.faults.maybe_kill_server(self._n_quanta)
+        snap = (
+            snapshot_state(job.tally)
+            if self.job_retries > 0 else None
         )
+        t0 = time.perf_counter()
+        fail_t0 = None
+        attempt = 0
+        while True:
+            try:
+                self.faults.maybe_poison_job(job.index)
+                self.faults.maybe_transient_quantum(job.index)
+                totals = job.tally.run_source_moves(
+                    k, job.request.source, **kw
+                )
+                break
+            except InjectedKill:
+                raise
+            except Exception as e:
+                if fail_t0 is None:
+                    fail_t0 = time.perf_counter()
+                self._coordinator.rebind(job.tally)
+                verdict = self._coordinator.classify(e)
+                if (
+                    verdict != "transient"
+                    or attempt >= self.job_retries
+                    or snap is None
+                ):
+                    cause = (
+                        "retries-exhausted"
+                        if verdict == "transient" else verdict
+                    )
+                    self._poison(job, e, cause=cause)
+                    return
+                attempt += 1
+                job.retries += 1
+                cause = (
+                    "timeout"
+                    if isinstance(e, DispatchTimeoutError)
+                    else "transient"
+                )
+                self._retries_total.inc(cause=cause)
+                log_warn(
+                    f"job {job.id} quantum failed transiently ({e}); "
+                    f"replaying from its snapshot (attempt "
+                    f"{attempt}/{self.job_retries})"
+                )
+                # Bitwise replay anchor: the snapshot is the same
+                # payload the checkpoint subsystem persists, and the
+                # restore rebuilds every donated buffer from host
+                # copies — a half-consumed dispatch leaves nothing
+                # behind.
+                restore_state(job.tally, snap)
+                self.recorder.record(
+                    "job_retry", job=job.id, shape_key=job.shape_key,
+                    cause=cause, attempt=attempt,
+                    error=str(e)[:200],
+                )
+                self._sleep(min(
+                    self.backoff_base * 2 ** (attempt - 1),
+                    self.backoff_max,
+                ))
+        if fail_t0 is not None:
+            job.recovery_seconds += time.perf_counter() - fail_t0
+        job.needs_stage = False
         job.moves_done += totals["moves"]
         job.quanta += 1
         for key, v in totals.items():
@@ -380,24 +898,23 @@ class TallyScheduler:
         self.recorder.record(
             "quantum", job=job.id, shape_key=job.shape_key,
             moves=int(totals["moves"]), move_total=job.moves_done,
-            alive=int(totals["alive"]),
+            alive=int(totals["alive"]), retries=attempt,
             seconds=round(time.perf_counter() - t0, 6),
         )
         if totals["alive"] == 0 or job.moves_done >= job.request.n_moves:
             self._finish(job, "completed")
         elif self.config.convergence and job.tally.converged():
             self._finish(job, "converged")
+        elif self.journal is not None:
+            # Write-ahead: checkpoint the quantum boundary, THEN the
+            # journal record that references it.
+            self._journal_checkpoint(job)
+            self._flush_journal()
 
     def _finish(self, job: Job, outcome: str) -> None:
         job.result = job.tally.raw_flux.copy()
         job.tally.close()
         job.tally = None
-        if job.checkpoint is not None:
-            try:
-                os.remove(job.checkpoint)
-            except OSError:
-                pass
-            job.checkpoint = None
         if job in self._resident:
             self._resident.remove(job)
         job.state = DONE
@@ -405,18 +922,72 @@ class TallyScheduler:
         job.finished_s = time.perf_counter()
         self._jobs_total.inc(outcome=outcome)
         self._job_seconds.observe(job.finished_s - job.submitted_s)
+        if self.journal is not None:
+            # Results survive the process: flux first, then the journal
+            # record that references it.
+            job.flux_name = self.journal.write_flux(job.id, job.result)
         self.recorder.record(
             "job_done", job=job.id, shape_key=job.shape_key,
             outcome=outcome, moves=job.moves_done,
-            preemptions=job.preemptions,
+            preemptions=job.preemptions, retries=job.retries,
             seconds=round(job.finished_s - job.submitted_s, 6),
         )
+        # Write-ahead order: commit the terminal record (with its
+        # flux) BEFORE deleting the checkpoint — a crash between the
+        # two must cost a redundant file, never the finished work.
+        self._flush_journal()
+        self._remove_checkpoint(job)
+
+    def _remove_checkpoint(self, job: Job) -> None:
+        if job.checkpoint is not None:
+            try:
+                os.remove(job.checkpoint)
+            except OSError:
+                pass
+            job.checkpoint = None
+        if self.journal is not None:
+            self.journal.remove_sidefiles(job.id)
+
+    def _poison(self, job: Job, exc: BaseException, cause: str) -> None:
+        """Isolate one failed job: free its device slot, mark it
+        terminal with ``outcome="poisoned"``, and keep serving — every
+        other resident and queued job continues bitwise-identical to a
+        fault-free run (jobs are facade-isolated)."""
+        if job.tally is not None:
+            try:
+                job.tally.close()
+            except Exception:  # pragma: no cover - teardown best-effort
+                pass
+            job.tally = None
+        if job in self._resident:
+            self._resident.remove(job)
+        job.state = DONE
+        job.outcome = "poisoned"
+        job.error = f"{type(exc).__name__}: {exc}"
+        job.finished_s = time.perf_counter()
+        self._jobs_total.inc(outcome="poisoned")
+        self._job_seconds.observe(job.finished_s - job.submitted_s)
+        log_warn(
+            f"job {job.id} poisoned ({cause}): {job.error} — slot "
+            "freed, remaining jobs unaffected"
+        )
+        self.recorder.record(
+            "job_poisoned", job=job.id, shape_key=job.shape_key,
+            cause=cause, error=job.error[:200], moves=job.moves_done,
+            retries=job.retries,
+        )
+        self._flush_journal()
+        self._remove_checkpoint(job)
 
     def _preempt(self, job: Job) -> None:
         """Checkpoint-preempt one resident job (megastep boundary —
-        quanta never split) and re-queue it."""
-        path = os.path.join(
-            self.checkpoint_dir, f"{job.id}.ckpt.npz"
+        quanta never split) and re-queue it.  Journaled schedulers
+        park the checkpoint in the journal directory, where recovery
+        already looks."""
+        path = (
+            self.journal.checkpoint_path(job.id)
+            if self.journal is not None
+            else os.path.join(self.checkpoint_dir, f"{job.id}.ckpt.npz")
         )
         job.tally.save_checkpoint(path)
         job.tally.close()
@@ -430,6 +1001,7 @@ class TallyScheduler:
             moves=job.moves_done, quanta=job.quanta,
         )
         self._enqueue(job)
+        self._flush_journal()
 
     # ------------------------------------------------------------------ #
     # The scheduling loop
@@ -437,30 +1009,41 @@ class TallyScheduler:
     def step(self) -> bool:
         """One scheduling round: admit to capacity, run one quantum per
         resident job (round-robin fairness), then apply the preemption
-        policy.  Returns True while any job is non-terminal."""
-        while len(self._resident) < self.max_resident:
-            nxt = self._pop_next()
-            if nxt is None:
-                break
-            self._admit(nxt)
+        policy.  Returns True while any job is non-terminal.  A
+        preemption signal landing mid-round defers to the next quantum
+        boundary, where the journal flush writes consistent state."""
+        self._in_step = True
+        try:
+            while len(self._resident) < self.max_resident:
+                nxt = self._pop_next()
+                if nxt is None:
+                    break
+                self._admit(nxt)
+                self._queue_depth.set(self.queue_depth)
+            for job in list(self._resident):
+                if self._pending_signal is not None:
+                    break
+                self._quantum(job)
+            if (
+                self.preempt_after is not None
+                and self.queue_depth > 0
+                and len(self._resident) >= self.max_resident
+            ):
+                # Yield the slot held longest (most quanta since
+                # admission, oldest first on ties) — one per round
+                # keeps the policy simple and the churn bounded.
+                ripe = [
+                    j for j in self._resident
+                    if j.quanta >= self.preempt_after
+                ]
+                if ripe:
+                    self._preempt(max(ripe, key=lambda j: j.quanta))
             self._queue_depth.set(self.queue_depth)
-        for job in list(self._resident):
-            self._quantum(job)
-        if (
-            self.preempt_after is not None
-            and self.queue_depth > 0
-            and len(self._resident) >= self.max_resident
-        ):
-            # Yield the slot held longest (most quanta since admission,
-            # oldest first on ties) — one per round keeps the policy
-            # simple and the churn bounded.
-            ripe = [
-                j for j in self._resident
-                if j.quanta >= self.preempt_after
-            ]
-            if ripe:
-                self._preempt(max(ripe, key=lambda j: j.quanta))
-        self._queue_depth.set(self.queue_depth)
+        finally:
+            self._in_step = False
+            if self._pending_signal is not None:
+                sig, self._pending_signal = self._pending_signal, None
+                self._signal_flush(sig, None)
         return any(not j.terminal for j in self._jobs.values())
 
     def run(self, max_rounds: int = 100000) -> None:
@@ -488,7 +1071,8 @@ class TallyScheduler:
         job = self._jobs[job_id]
         if job.result is None:
             raise RuntimeError(
-                f"job {job_id} is not finished (state={job.state})"
+                f"job {job_id} has no result (state={job.state}, "
+                f"outcome={job.outcome})"
             )
         return job.result
 
@@ -507,9 +1091,18 @@ class TallyScheduler:
                 sum(s["value"]
                     for s in self._preempt_total.snapshot()["series"])
             ),
+            "retries": int(
+                sum(s["value"]
+                    for s in self._retries_total.snapshot()["series"])
+            ),
+            "recovered": self._n_recovered,
+            "journal": (
+                self.journal.dir if self.journal is not None else None
+            ),
             "quanta": int(self._quanta_total.value()),
             "quantum_moves": self.quantum,
             "max_resident": self.max_resident,
+            "max_queued": self.max_queued,
             "classes": {
                 key: sum(
                     1 for j in self._jobs.values()
@@ -521,13 +1114,44 @@ class TallyScheduler:
         }
         return out
 
-    def close(self) -> None:
-        """Stop the exporter and drop any resident device state."""
+    def abandon(self) -> None:
+        """Crash-model teardown: release device state, signal handlers
+        and the exporter WITHOUT any journal write — what a modeled
+        server kill leaves behind must be exactly what the write-ahead
+        journal already committed (otherwise a stale handler chained
+        from a later scheduler in the same process could rewrite the
+        journal with this scheduler's dead job table)."""
         for job in list(self._resident):
             if job.tally is not None:
+                try:
+                    job.tally.close()
+                except Exception:  # pragma: no cover - best-effort
+                    pass
+                job.tally = None
+            self._resident.remove(job)
+        self._uninstall_signal_handlers()
+        if self._exporter is not None:
+            self._exporter.stop()
+            self._exporter = None
+
+    def close(self) -> None:
+        """Stop the exporter and drop any resident device state.  A
+        journaled scheduler parks every resident job's checkpoint
+        first, so a graceful shutdown is as resumable as a crash."""
+        for job in list(self._resident):
+            if job.tally is not None:
+                if self.journal is not None:
+                    try:
+                        self._journal_checkpoint(job)
+                    except Exception as e:  # pragma: no cover
+                        log_warn(
+                            f"close checkpoint of {job.id} failed: {e}"
+                        )
                 job.tally.close()
                 job.tally = None
             self._resident.remove(job)
+        self._flush_journal()
+        self._uninstall_signal_handlers()
         if self._exporter is not None:
             self._exporter.stop()
             self._exporter = None

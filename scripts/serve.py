@@ -11,19 +11,36 @@ persistent AOT program bank and serve a synthetic many-job workload:
                                                    # the warm, zero-
                                                    # compile regime
   python scripts/serve.py --demo 8 --prom-port 9464  # live /metrics
+  python scripts/serve.py --demo 8 --journal J/    # crash-safe journal
+  python scripts/serve.py --demo 8 --journal J/ --resume
+                                                   # restart a killed
+                                                   # server: recover
+                                                   # every job from
+                                                   # JOBS.json and
+                                                   # drain bitwise
 
 The demo drives the SAME ``run_saturation`` workload driver bench.py's
 ``BENCH_SERVE`` probe uses, so the printed ``jobs_per_sec`` is
-directly comparable to the committed bench rows.  Exit 0 = every job
-finished (completed or converged); the JSON summary lands on stdout
-(and ``--out`` when given).
+directly comparable to the committed bench rows.  The full JSON lands
+on stdout (and ``--out`` when given), followed by one compact
+per-outcome summary line (the last stdout line is always valid JSON).
 
-The scheduler admits up to ``--max-resident`` jobs, time-slices at
-megastep ``--quantum`` granularity, evicts converged jobs early when
-``--convergence`` is set, and checkpoint-preempts long residents when
-``--preempt-after`` is set.  ``--bank off`` serves from the jit path
-(every fresh process pays compile cost — the baseline the bank
-exists to beat).
+Exit codes:
+  0  every job completed or converged;
+  3  some jobs poisoned (persistent per-job failure isolated) or
+     rejected (admission backpressure) — the SERVER stayed healthy;
+  1  anything else (crash, injected server kill, unfinished jobs).
+
+The scheduler admits up to ``--max-resident`` jobs (and at most
+``--max-queued`` waiting), time-slices at megastep ``--quantum``
+granularity, replays transient quanta up to ``--retries`` times from
+per-job snapshots, arms a ``--deadline`` watchdog around every
+quantum, evicts converged jobs early when ``--convergence`` is set,
+and checkpoint-preempts long residents when ``--preempt-after`` is
+set.  ``--bank off`` serves from the jit path (every fresh process
+pays compile cost — the baseline the bank exists to beat).  Per-job
+fault injection (poison_job / transient_quantum /
+kill_server_at_quantum) rides the ``PUMI_TPU_FAULTS`` env.
 """
 import argparse
 import json
@@ -35,6 +52,11 @@ import tempfile
 sys.path.insert(
     0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 )
+
+#: Outcomes that leave the exit code at 0.
+GOOD = ("completed", "converged")
+#: Outcomes that mean "job failed / shed, server healthy" — exit 3.
+ISOLATED = ("poisoned", "rejected")
 
 
 def main() -> int:
@@ -56,6 +78,21 @@ def main() -> int:
     ap.add_argument("--quantum", type=int, default=4,
                     help="megastep moves per scheduling quantum")
     ap.add_argument("--max-resident", type=int, default=2)
+    ap.add_argument("--max-queued", type=int, default=None,
+                    help="admission backpressure: submissions beyond "
+                         "this queue depth finish outcome=rejected")
+    ap.add_argument("--retries", type=int, default=2,
+                    help="bounded per-quantum transient replays before "
+                         "a job is poisoned")
+    ap.add_argument("--deadline", type=float, default=None,
+                    help="per-quantum dispatch watchdog deadline "
+                         "(seconds); a timeout classifies as transient")
+    ap.add_argument("--journal", default=None, metavar="DIR",
+                    help="crash-safe JOBS.json write-ahead journal "
+                         "directory (enables --resume)")
+    ap.add_argument("--resume", action="store_true",
+                    help="recover the job table from --journal before "
+                         "serving (the restart path of a killed server)")
     ap.add_argument("--preempt-after", type=int, default=None,
                     help="quanta before a resident job yields its slot "
                          "to queued work (checkpoint preemption)")
@@ -71,6 +108,8 @@ def main() -> int:
 
     if args.prom_port is not None:
         os.environ["PUMI_TPU_PROM_PORT"] = str(args.prom_port)
+    if args.resume and not args.journal:
+        ap.error("--resume needs --journal DIR")
 
     from pumiumtally_tpu import TallyConfig, build_box
     from pumiumtally_tpu.serving import run_saturation
@@ -95,7 +134,7 @@ def main() -> int:
     else:
         tmp_bank = bank = tempfile.mkdtemp(prefix="pumi_bank_")
     ck_dir = None
-    if args.preempt_after is not None:
+    if args.preempt_after is not None and args.journal is None:
         tmp_ck = ck_dir = tempfile.mkdtemp(prefix="pumi_serve_ck_")
     try:
         out = run_saturation(
@@ -108,6 +147,11 @@ def main() -> int:
             quantum_moves=args.quantum,
             preempt_after=args.preempt_after,
             checkpoint_dir=ck_dir,
+            max_queued=args.max_queued,
+            job_retries=args.retries,
+            quantum_deadline_s=args.deadline,
+            journal_dir=args.journal,
+            resume=args.resume,
         )
     finally:
         for d in (tmp_bank, tmp_ck):
@@ -119,11 +163,30 @@ def main() -> int:
     if args.out:
         with open(args.out, "w") as fh:
             fh.write(text + "\n")
-    bad = [
-        row for row in out["per_job"]
-        if row["outcome"] not in ("completed", "converged")
-    ]
-    return 1 if bad else 0
+    outcomes: dict = {}
+    for row in out["per_job"]:
+        outcomes[row["outcome"]] = outcomes.get(row["outcome"], 0) + 1
+    bad = [r for r in out["per_job"] if r["outcome"] not in GOOD]
+    if not bad:
+        rc = 0
+    elif all(r["outcome"] in ISOLATED for r in bad):
+        rc = 3  # jobs failed/shed in isolation; the server is healthy
+    else:
+        rc = 1
+    sched = out["scheduler"]
+    # The per-outcome summary line: always the LAST stdout line,
+    # always one valid JSON object (chaos drivers parse it).
+    print(json.dumps({
+        "summary": {
+            "outcomes": outcomes,
+            "jobs": len(out["per_job"]),
+            "recovered": sched.get("recovered", 0),
+            "retries": sched.get("retries", 0),
+            "aot": sched.get("aot"),
+            "exit": rc,
+        }
+    }, sort_keys=True))
+    return rc
 
 
 if __name__ == "__main__":

@@ -1,0 +1,555 @@
+"""Serving-under-failure contracts (the ISSUE 14 robustness tentpole:
+fault-isolated multi-tenant scheduling + crash-safe job recovery).
+
+Contracts pinned here:
+
+  * POISON-JOB ISOLATION — a persistent per-job failure (injected
+    ``poison_job``) finishes exactly that job ``outcome="poisoned"``
+    and frees its slot; every survivor's flux is BITWISE identical to
+    the fault-free solo reference.
+  * TRANSIENT REPLAY — a transient-classified quantum failure replays
+    bitwise from the job's pre-quantum snapshot under the bounded
+    retry budget (``pumi_job_retries_total{cause}``); an exhausted
+    budget (``job_retries=0``) poisons instead of looping.
+  * WATCHDOG CLASSIFICATION — a wedged quantum dispatch
+    (``hang_at_move`` + ``quantum_deadline_s``) surfaces as a
+    ``DispatchTimeoutError``, classifies transient (the chip answers
+    its probe), and replays bitwise — one stuck dispatch cannot stall
+    the round-robin loop.
+  * CRASH-SAFE JOURNAL — the JOBS.json write-ahead log round-trips
+    the whole job table: ``TallyScheduler.recover`` re-queues
+    interrupted jobs from their quantum-boundary checkpoints and the
+    drained fleet is bitwise vs solo references; a FRESH SUBPROCESS
+    recovery over a warm bank compiles NO program family (compile-log
+    + bank-counter pinned) and completed jobs keep their persisted
+    flux.
+  * ADMISSION CONTROL — ``max_queued`` backpressure finishes
+    over-limit submissions ``outcome="rejected"`` (named, counted,
+    no queue growth, no dispatch).
+  * BANK CORRUPTION TOLERANCE — a byte-flipped PROGRAM.bin or a torn
+    META.json (driven by ``FaultInjector.corrupt_file`` /
+    ``maybe_tear``) degrades to recompile-and-rewrite under
+    ``pumi_aot_rewrites_total{cause="corrupt"}``, never crashes a
+    dispatch, and the rewritten entry loads clean.
+
+Compile budget: the fast core (-m 'not slow') keeps the grammar /
+journal-serialization / admission tests (no XLA compiles); everything
+that dispatches real programs or launches subprocesses is marked slow
+and runs in the dedicated CI serving-chaos step.
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from pumiumtally_tpu import PumiTally, TallyConfig, build_box
+from pumiumtally_tpu.ops.source import SourceParams
+from pumiumtally_tpu.resilience.faultinject import (
+    ChaosInjector,
+    ChaosPlan,
+    FaultInjector,
+    FaultPlan,
+    parse_faults,
+)
+from pumiumtally_tpu.serving import (
+    JobRequest,
+    ProgramBank,
+    TallyScheduler,
+    run_saturation,
+    synthetic_requests,
+)
+from pumiumtally_tpu.serving.journal import (
+    check_job_id,
+    request_from_json,
+    request_to_json,
+)
+from pumiumtally_tpu.tuning.shapes import bucket
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_env(monkeypatch):
+    """The serving resilience contracts drive faults/knobs explicitly
+    — scrub any CI sweep's env overrides (incl. PUMI_TPU_FAULTS: the
+    scheduler's default injector reads it)."""
+    for var in (
+        "PUMI_TPU_MEGASTEP", "PUMI_TPU_KERNEL", "PUMI_TPU_IO_PIPELINE",
+        "PUMI_TPU_TUNING", "PUMI_TPU_AOT_FAULT", "PUMI_TPU_PROM_PORT",
+        "PUMI_TPU_FAULTS",
+    ):
+        monkeypatch.delenv(var, raising=False)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return build_box(1.0, 1.0, 1.0, 2, 2, 2)
+
+
+def _cfg(**kw):
+    return TallyConfig(tolerance=1e-6, **kw)
+
+
+def _solo_reference(mesh, request, quantum, cfg):
+    """The uninterrupted jit-path run of one scheduler job, padded to
+    the same shape bucket with the same chunking (megastep=quantum) —
+    what fault-isolated/replayed/recovered execution must match
+    bitwise (same helper as tests/test_serving.py)."""
+    origins = np.asarray(request.origins, np.float64).reshape(-1, 3)
+    n = origins.shape[0]
+    N = bucket(n)
+    pad = np.broadcast_to(origins[0], (N - n, 3))
+    origins_p = np.concatenate([origins, pad], axis=0)
+    t = PumiTally(
+        mesh, N, dataclasses.replace(cfg, megastep=quantum)
+    )
+    t.initialize_particle_location(origins_p.reshape(-1).copy())
+    t.run_source_moves(
+        request.n_moves, request.source,
+        weights=np.concatenate([np.ones(n), np.zeros(N - n)]),
+        groups=np.zeros(N, np.int32),
+        alive=np.concatenate([np.ones(n, bool), np.zeros(N - n, bool)]),
+    )
+    return t.raw_flux.copy()
+
+
+# --------------------------------------------------------------------- #
+# Fast core: grammar, journal serialization, admission control
+# --------------------------------------------------------------------- #
+def test_fault_grammar_serving_clauses():
+    plan = parse_faults(
+        "poison_job:1,transient_quantum:2,kill_server_at_quantum:7"
+    )
+    assert plan.poison_job == 1
+    assert plan.transient_quantum == 2
+    assert plan.kill_server_at_quantum == 7
+    assert plan.any()
+    with pytest.raises(ValueError, match="kill_server_at_quantum"):
+        parse_faults("kill_server_at_quantum:0")
+    with pytest.raises(ValueError, match="unknown fault"):
+        parse_faults("poison_jb:1")
+    # The chaos scheduler composes the serving faults with the
+    # per-move ones through the inherited FaultPlan hooks.
+    inj = ChaosInjector(ChaosPlan(
+        poison_job=3, transient_quantum=0, kill_server_at_quantum=5,
+    ))
+    assert inj.plan.poison_job == 3
+    assert inj.plan.transient_quantum == 0
+    assert inj.plan.kill_server_at_quantum == 5
+    desc = inj.chaos.describe()
+    assert "poison_job@3" in desc and "kill_server@q5" in desc
+    # poison fires every time; the transient and the kill fire once.
+    for _ in range(2):
+        with pytest.raises(Exception, match="poison"):
+            inj.maybe_poison_job(3)
+    with pytest.raises(Exception, match="transient"):
+        inj.maybe_transient_quantum(0)
+    inj.maybe_transient_quantum(0)  # fired once — silent now
+    with pytest.raises(Exception, match="server kill"):
+        inj.maybe_kill_server(5)
+    inj.maybe_kill_server(5)
+
+
+def test_journal_request_roundtrip_bitwise():
+    """Float64 request payloads survive the JSON journal bitwise
+    (repr round-trip), incl. awkward values; SourceParams reconstructs
+    with identical tables and seed."""
+    rng = np.random.default_rng(5)
+    origins = rng.uniform(0.0, 1.0, (7, 3))
+    origins[0, 0] = 1.0 / 3.0
+    origins[1, 1] = np.nextafter(0.5, 1.0)
+    req = JobRequest(
+        origins=origins,
+        n_moves=9,
+        source=SourceParams(
+            sigma_t={0: 1.25, 3: 0.7}, absorption={0: 0.31},
+            default_sigma_t=0.9, survival_weight=0.05, seed=42,
+        ),
+        weights=rng.uniform(0.5, 2.0, 7),
+        groups=np.array([0, 1, 0, 1, 0, 1, 0], np.int32),
+        job_id="rt-0",
+    )
+    back = request_from_json(
+        json.loads(json.dumps(request_to_json(req)))
+    )
+    assert back.origins.tobytes() == np.asarray(
+        origins, np.float64
+    ).tobytes()
+    assert back.weights.tobytes() == np.asarray(
+        req.weights, np.float64
+    ).tobytes()
+    assert back.groups.tobytes() == req.groups.tobytes()
+    assert back.n_moves == 9 and back.job_id == "rt-0"
+    assert back.source.seed == 42
+    cid = np.arange(4)
+    for a, b in zip(back.source.tables(cid), req.source.tables(cid)):
+        assert a.tobytes() == b.tobytes()
+    # Custom source objects cannot be reconstructed by a fresh
+    # recovery process — refused up front, not at recovery time.
+    with pytest.raises(TypeError, match="SourceParams"):
+        request_to_json(JobRequest(
+            origins=origins, n_moves=1, source=object(),
+        ))
+    # Job ids become journal filenames.
+    with pytest.raises(ValueError, match="journal-safe"):
+        check_job_id("../evil")
+
+
+def test_admission_rejection_at_max_queued(mesh, tmp_path):
+    """Backpressure is a named terminal outcome, not queue growth —
+    and it needs no dispatch (no compiles in this test)."""
+    sched = TallyScheduler(
+        mesh, _cfg(), max_resident=1, max_queued=2,
+        journal_dir=str(tmp_path / "j"), handle_signals=False,
+    )
+    ids = [
+        sched.submit(JobRequest(
+            origins=np.full((4, 3), 0.5), n_moves=2, job_id=f"q{i}",
+        ))
+        for i in range(4)
+    ]
+    states = [sched.job(i).outcome for i in ids]
+    assert states == [None, None, "rejected", "rejected"]
+    assert sched.queue_depth == 2
+    assert sched.stats()["outcomes"] == {"rejected": 2}
+    with pytest.raises(RuntimeError, match="rejected"):
+        sched.result("q2")
+    text = sched.registry.render_prometheus()
+    assert 'pumi_jobs_total{outcome="rejected"} 2' in text
+    assert "pumi_job_queue_seconds" in text
+    # The rejections are journaled terminal — a recovery does not
+    # resurrect them.
+    doc = sched.journal.load()
+    assert doc["jobs"]["q2"]["state"] == "done"
+    assert doc["jobs"]["q2"]["outcome"] == "rejected"
+    kinds = [r["kind"] for r in sched.recorder.records()]
+    assert kinds.count("job_rejected") == 2
+    sched.close()
+    with pytest.raises(ValueError, match="max_queued"):
+        TallyScheduler(mesh, _cfg(), max_queued=0)
+
+
+def test_scheduler_new_knob_validation(mesh, tmp_path):
+    # preempt_after accepts a journal_dir in place of checkpoint_dir.
+    sched = TallyScheduler(
+        mesh, _cfg(), preempt_after=1,
+        journal_dir=str(tmp_path / "j"), handle_signals=False,
+    )
+    assert sched.checkpoint_dir is None and sched.journal is not None
+    sched.close()
+    with pytest.raises(ValueError, match="checkpoint_dir or journal"):
+        TallyScheduler(mesh, _cfg(), preempt_after=1)
+    # quantum_deadline_s arms the facade watchdog via the job config.
+    sched = TallyScheduler(mesh, _cfg(), quantum_deadline_s=5.0)
+    assert sched.config.move_deadline_s == 5.0
+    sched.close()
+
+
+# --------------------------------------------------------------------- #
+# Fault isolation (slow: real dispatches)
+# --------------------------------------------------------------------- #
+@pytest.mark.slow
+def test_poison_job_isolation_bitwise(mesh):
+    """One poison job is finished ``poisoned`` with its slot freed;
+    every survivor is bitwise the fault-free solo run."""
+    cfg = _cfg()
+    reqs = synthetic_requests(
+        mesh, 3, class_sizes=(40, 100), n_moves=4, seed=3
+    )
+    out = run_saturation(
+        mesh, cfg, n_jobs=3, class_sizes=(40, 100), n_moves=4, seed=3,
+        max_resident=2, quantum_moves=2,
+        faults=FaultInjector(FaultPlan(poison_job=1)),
+    )
+    rows = {r["job"]: r for r in out["per_job"]}
+    assert rows["sat-0001"]["outcome"] == "poisoned"
+    assert "InjectedPoisonFault" in rows["sat-0001"]["error"]
+    assert "sat-0001" not in out["results"]
+    assert out["scheduler"]["outcomes"] == {
+        "poisoned": 1, "completed": 2,
+    }
+    for req in (reqs[0], reqs[2]):
+        ref = _solo_reference(mesh, req, 2, cfg)
+        assert out["results"][req.job_id].tobytes() == ref.tobytes()
+
+
+@pytest.mark.slow
+def test_transient_quantum_bitwise_replay(mesh):
+    """A transient-classified quantum failure replays bitwise from the
+    job's snapshot; the retry is counted by cause."""
+    cfg = _cfg()
+    req = synthetic_requests(
+        mesh, 1, class_sizes=(40,), n_moves=4, seed=3
+    )[0]
+    out = run_saturation(
+        mesh, cfg, n_jobs=1, class_sizes=(40,), n_moves=4, seed=3,
+        max_resident=1, quantum_moves=2,
+        faults=FaultInjector(FaultPlan(transient_quantum=0)),
+    )
+    row = out["per_job"][0]
+    assert row["outcome"] == "completed" and row["retries"] == 1
+    assert row["recovery_seconds"] > 0
+    ref = _solo_reference(mesh, req, 2, cfg)
+    assert out["results"][req.job_id].tobytes() == ref.tobytes()
+
+
+@pytest.mark.slow
+def test_retry_budget_exhaustion_poisons(mesh):
+    """job_retries=0: even a transient verdict cannot replay — the
+    job is poisoned (named), the server stays healthy."""
+    out = run_saturation(
+        mesh, _cfg(), n_jobs=2, class_sizes=(40,), n_moves=4, seed=3,
+        max_resident=1, quantum_moves=2, job_retries=0,
+        faults=FaultInjector(FaultPlan(transient_quantum=0)),
+    )
+    rows = {r["job"]: r for r in out["per_job"]}
+    assert rows["sat-0000"]["outcome"] == "poisoned"
+    assert "InjectedTransientFault" in rows["sat-0000"]["error"]
+    assert rows["sat-0001"]["outcome"] == "completed"
+
+
+@pytest.mark.slow
+def test_watchdog_timeout_classified_and_replayed(mesh, monkeypatch):
+    """A wedged quantum dispatch hits the PR 4 watchdog deadline, the
+    timeout classifies transient (the chip still answers its probe),
+    and the quantum replays bitwise — counted under cause="timeout"."""
+    cfg = _cfg()
+    req = synthetic_requests(
+        mesh, 1, class_sizes=(40,), n_moves=4, seed=3
+    )[0]
+    # The facade's own injector wedges move 3 — the SECOND quantum,
+    # past the first-dispatch compile amnesty, so the armed deadline
+    # fires.
+    monkeypatch.setenv(
+        "PUMI_TPU_FAULTS", "hang_at_move:3,hang_seconds:1.5"
+    )
+    out = run_saturation(
+        mesh, cfg, n_jobs=1, class_sizes=(40,), n_moves=4, seed=3,
+        max_resident=1, quantum_moves=2, quantum_deadline_s=0.3,
+        faults=FaultInjector(FaultPlan()),  # scheduler faults: none
+    )
+    monkeypatch.delenv("PUMI_TPU_FAULTS")
+    row = out["per_job"][0]
+    assert row["outcome"] == "completed" and row["retries"] >= 1
+    ref = _solo_reference(mesh, req, 2, cfg)
+    assert out["results"][req.job_id].tobytes() == ref.tobytes()
+    retried = out["scheduler"]["retries"]
+    assert retried >= 1
+
+
+# --------------------------------------------------------------------- #
+# Crash-safe journal + recovery (slow)
+# --------------------------------------------------------------------- #
+@pytest.mark.slow
+def test_journal_roundtrip_recovery_in_process(mesh, tmp_path):
+    """An abandoned scheduler's journal recovers in-process: completed
+    jobs keep their persisted flux, interrupted jobs resume from their
+    quantum-boundary checkpoints, and the drained fleet is bitwise vs
+    solo references."""
+    cfg = _cfg()
+    jdir = str(tmp_path / "journal")
+    reqs = synthetic_requests(
+        mesh, 3, class_sizes=(40,), n_moves=6, seed=11
+    )
+    sched = TallyScheduler(
+        mesh, cfg, max_resident=1, quantum_moves=2,
+        journal_dir=jdir, handle_signals=False,
+    )
+    for r in reqs:
+        sched.submit(r)
+    # Enough rounds to finish the first job and leave the second
+    # mid-flight with a journaled checkpoint; then 'crash' (no close).
+    for _ in range(4):
+        sched.step()
+    assert sched.job("sat-0000").outcome == "completed"
+    mid = sched.job("sat-0001")
+    assert 0 < mid.moves_done < 6
+    doc = sched.journal.load()
+    assert doc["jobs"]["sat-0000"]["state"] == "done"
+    assert doc["jobs"]["sat-0001"]["checkpoint"] is not None
+    del sched
+
+    rec = TallyScheduler.recover(
+        jdir, mesh, cfg, max_resident=1, quantum_moves=2,
+        handle_signals=False,
+    )
+    # The completed job came back terminal WITH its flux (no re-run).
+    done = rec.job("sat-0000")
+    assert done.outcome == "completed" and done.result is not None
+    # The mid-flight job resumes from its checkpoint, not move 0.
+    resumed = rec.job("sat-0001")
+    assert resumed.checkpoint is not None and resumed.moves_done > 0
+    assert rec.stats()["recovered"] == 2  # sat-0001 + sat-0002
+    rec.run()
+    rec.close()
+    for req in reqs:
+        ref = _solo_reference(mesh, req, 2, cfg)
+        assert rec.result(req.job_id).tobytes() == ref.tobytes(), req.job_id
+    kinds = [r["kind"] for r in rec.recorder.records()]
+    assert "journal_recovery" in kinds and "journal_recovered" in kinds
+
+
+_RECOVER_SCRIPT = """
+import os, sys, json, hashlib, logging
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    )
+msgs = []
+class _H(logging.Handler):
+    def emit(self, rec):
+        msgs.append(rec.getMessage())
+logging.getLogger().addHandler(_H())
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+jax.config.update("jax_log_compiles", True)
+sys.path.insert(0, {root!r})
+import numpy as np
+from pumiumtally_tpu import TallyConfig, build_box
+from pumiumtally_tpu.serving import run_saturation
+mesh = build_box(1.0, 1.0, 1.0, 2, 2, 2)
+out = run_saturation(
+    mesh, TallyConfig(tolerance=1e-6), bank={bank!r}, n_jobs=3,
+    class_sizes=(40,), n_moves=4, seed=5, max_resident=1,
+    quantum_moves=2, journal_dir={journal!r}, resume=True,
+)
+hashes = {{
+    k: hashlib.sha256(v.tobytes()).hexdigest()
+    for k, v in sorted(out["results"].items())
+}}
+family_compiles = [
+    m for m in msgs
+    if "Finished XLA compilation" in m
+    and ("trace_packed" in m or "megastep" in m)
+]
+outcomes = {{}}
+for row in out["per_job"]:
+    outcomes[row["outcome"]] = outcomes.get(row["outcome"], 0) + 1
+print(json.dumps({{
+    "stats": out["scheduler"]["aot"],
+    "recovered": out["scheduler"]["recovered"],
+    "hashes": hashes,
+    "family_compiles": family_compiles,
+    "outcomes": outcomes,
+}}))
+"""
+
+
+@pytest.mark.slow
+def test_journal_recovery_subprocess_zero_compiles(mesh, tmp_path):
+    """The acceptance pin: a FRESH process recovers an interrupted
+    journaled fleet over a warm bank with zero bank misses, no XLA
+    compile of either program family (compile log), and results
+    bitwise-identical to the uninterrupted reference."""
+    bank_dir = str(tmp_path / "bank")
+    jdir = str(tmp_path / "journal")
+    cfg = _cfg()
+    # Uninterrupted reference over a cold bank (also populates it).
+    ref = run_saturation(
+        mesh, cfg, bank=ProgramBank(bank_dir), n_jobs=3,
+        class_sizes=(40,), n_moves=4, seed=5, max_resident=1,
+        quantum_moves=2,
+    )
+    want = {
+        k: hashlib.sha256(v.tobytes()).hexdigest()
+        for k, v in sorted(ref["results"].items())
+    }
+    # Interrupted journaled run: a few rounds, then 'crash'.
+    sched = TallyScheduler(
+        mesh, cfg, bank=bank_dir, max_resident=1, quantum_moves=2,
+        journal_dir=jdir, handle_signals=False,
+    )
+    for r in synthetic_requests(
+        mesh, 3, class_sizes=(40,), n_moves=4, seed=5
+    ):
+        sched.submit(r)
+    for _ in range(3):
+        sched.step()
+    assert any(j.moves_done > 0 and j.outcome is None
+               for j in sched.jobs())
+    del sched
+
+    env = {
+        k: v for k, v in os.environ.items()
+        if not k.startswith("PUMI_TPU_")
+        and k not in ("JAX_COMPILATION_CACHE_DIR",)
+    }
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         _RECOVER_SCRIPT.format(root=ROOT, bank=bank_dir, journal=jdir)],
+        capture_output=True, text=True, timeout=600, env=env, cwd=ROOT,
+    )
+    assert proc.returncode == 0, proc.stderr
+    got = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert got["recovered"] >= 1
+    assert got["stats"]["misses"] == 0, got["stats"]
+    assert got["stats"]["compile_seconds"] == 0.0, got["stats"]
+    assert got["family_compiles"] == [], got["family_compiles"]
+    assert got["outcomes"] == {"completed": 3}
+    assert got["hashes"] == want
+
+
+# --------------------------------------------------------------------- #
+# Bank corruption tolerance (slow)
+# --------------------------------------------------------------------- #
+@pytest.mark.slow
+def test_torn_bank_entry_degrades_to_rewrite(mesh, tmp_path):
+    """A byte-flipped PROGRAM.bin and a torn META.json (the
+    FaultInjector's own corruption drivers) each degrade to a
+    recompile-and-rewrite under cause="corrupt" — never a crashed
+    dispatch — and the rewritten entries load clean."""
+    cfg = _cfg(megastep=2)
+
+    def run_via(bank):
+        t = PumiTally(mesh, 64, cfg, program_bank=bank)
+        cents = np.asarray(mesh.centroids(), np.float64)
+        origins = cents[np.arange(64) % mesh.ntet].reshape(-1).copy()
+        t.initialize_particle_location(origins)
+        t.run_source_moves(
+            4, SourceParams(seed=7),
+            weights=np.ones(64), groups=np.zeros(64, np.int32),
+            alive=np.ones(64, bool),
+        )
+        out = np.asarray(t.flux).copy()
+        t.close()
+        return out
+
+    cold = ProgramBank(str(tmp_path))
+    f_ref = run_via(cold)
+    entries = cold.entries_on_disk()
+    assert len(entries) == 2
+    # Corrupt one entry's program bytes, tear the other's META —
+    # through the injector's file-corruption drivers.
+    prog = os.path.join(
+        cold.section_dir, entries[0], "PROGRAM.bin"
+    )
+    meta = os.path.join(cold.section_dir, entries[1], "META.json")
+    assert FaultInjector(
+        FaultPlan(corrupt_ckpt=True)
+    ).corrupt_file(prog)
+    assert FaultInjector(FaultPlan(torn_shard=1)).maybe_tear(meta)
+    hurt = ProgramBank(str(tmp_path))
+    f_hurt = run_via(hurt)
+    assert f_hurt.tobytes() == f_ref.tobytes()
+    assert hurt.rewrites == 2 and hurt.hits == 0
+    causes = {
+        s["labels"]["cause"]
+        for s in hurt._rewrites.snapshot()["series"]
+    }
+    assert causes == {"corrupt"}
+    # The rewritten entries are whole again: pure hits, no findings.
+    clean = ProgramBank(str(tmp_path))
+    f_clean = run_via(clean)
+    assert f_clean.tobytes() == f_ref.tobytes()
+    assert clean.hits == 2 and clean.rewrites == 0
+    assert clean.findings == []
