@@ -1,19 +1,26 @@
 """Static analysis of the codebase and its compiled programs.
 
-Seven PRs of invariants — the 1 H2D + 1 D2H per move/megastep contract,
-donated-buffer discipline, bitwise XLA↔Pallas parity, f32 dtype hygiene,
-and the lock protocols of the threaded observers — were until now pinned
-only by runtime tests that must *execute* a failure to see it.  This
-package makes them machine-checked properties of the code and of the
-lowered programs themselves, in three layers:
+Fourteen PRs of invariants — the 1 H2D + 1 D2H per move/megastep
+contract, donated-buffer discipline, bitwise XLA↔Pallas parity, f32
+dtype hygiene, the lock protocols of the threaded observers, and the
+durability/ordering promises of the crash-safety surface — were until
+now pinned only by runtime tests (and chaos campaigns) that must
+*execute* a failure to see it.  This package makes them machine-checked
+properties of the code and of the lowered programs themselves, in four
+layers:
 
   * :mod:`analysis.astlint` — an AST lint engine with codebase-specific
-    rules (PUMI001..PUMI007): host syncs inside traced bodies, transfers
+    rules (PUMI001..PUMI011): host syncs inside traced bodies, transfers
     outside the approved staging modules, use-after-donate, trace-time
     nondeterminism, stray float64 on device paths, jit static-argnum
-    hygiene, and a ``# guarded by: <lock>`` concurrency lint over the
-    threaded surface (FlightRecorder / watchdog / HostStager / exporter).
-    The traced-body rules also cover ``scripts/`` and ``bench.py``.
+    hygiene, a ``# guarded by: <lock>`` concurrency lint over the
+    threaded surface (FlightRecorder / watchdog / HostStager / exporter),
+    and the layer-4 codebase rules: raw persistent writes outside the
+    atomic-write modules (PUMI008), signal-handler safety (PUMI009),
+    unguarded thread-shared state (PUMI010), and swallowed retryables
+    (PUMI011).  The traced-body rules also cover ``scripts/`` and
+    ``bench.py``; the journal-owning scripts additionally get
+    PUMI008/PUMI009.
   * :mod:`analysis.contracts` — abstract-traces the public program
     families (trace, trace_packed, megastep, the partitioned packed
     step, the Pallas kernel in interpret mode) to jaxpr + lowered
@@ -34,8 +41,21 @@ lowered programs themselves, in three layers:
     committed ``PERF_CONTRACTS.json`` within per-metric tolerance
     bands.  Hardware-free perf regression gates for every program
     family.
+  * :mod:`analysis.protolint` — the effect-ordering protocol analyzer
+    (layer 4's second half): named effect points (``checkpoint.save``,
+    ``journal.flush``, ``manifest.commit``, ``checkpoint.delete``,
+    ``handler.install/uninstall``, ``terminal.record``) are recognized
+    by callee, and declared happens-before protocols are verified along
+    all CFG paths of the functions that own them —
+    ``TallyScheduler._finish``/``._poison``, ``SchedulerJournal``,
+    ``CheckpointStore``, ``save_sharded_checkpoint``, the signal
+    flushes — then diffed against the committed ``PROTOCOLS.json``
+    (cross-environment captures refused, regenerable with
+    ``--write-protocols``).  The ordering bugs PR 14's reviews caught
+    by hand (terminal-record-before-checkpoint-delete, the
+    stale-handler clobber) are named, machine-checked findings forever.
 
-``scripts/lint.py`` runs all three layers with the
+``scripts/lint.py`` runs all four layers with the
 ``LINT_BASELINE.json`` suppression file (every suppression carries a
 justification string, and a STALE entry is itself a failure unless
 ``--allow-stale``); the ``static-analysis`` and ``perf-contracts`` CI

@@ -1,4 +1,5 @@
-"""AST lint engine with codebase-specific rules (layer 1).
+"""AST lint engine with codebase-specific rules (layer 1 + the
+codebase half of layer 4).
 
 The rules encode invariants that runtime tests can only witness by
 executing a failure; here they are properties of the source tree:
@@ -34,6 +35,32 @@ executing a failure; here they are properties of the source tree:
       ``# guarded by: <event> (event)`` must be written only by worker
       closures that ``<event>.set()`` and read only after
       ``<event>.wait(...)``.
+
+Layer-4 codebase rules (the durability & concurrency half; the
+effect-ordering protocols live in :mod:`analysis.protolint`):
+
+  PUMI008 raw-durable-write       ``open(..., "w")`` / ``np.save`` /
+      ``json.dump`` / ``Path.write_*`` outside the approved
+      atomic-write modules (``utils/checkpoint.py``,
+      ``serving/journal.py``, ``serving/bank.py``,
+      ``resilience/store.py``, ``tuning/db.py``) — a raw write can
+      tear under crash/ENOSPC, and torn state is exactly what the
+      crash-safety layer exists to rule out.
+  PUMI009 signal-handler-safety   handler bodies reachable from
+      ``utils/signals.install_preemption_handlers`` must not flush the
+      journal without the mid-dispatch deferral guard, take locks
+      annotated ``# guarded by:``, or call into jit dispatch; every
+      install needs a matching uninstall, and a handler that chains
+      the previous handler must uninstall its own first.
+  PUMI010 unguarded-thread-shared  state written from functions
+      reachable from ``threading.Thread`` targets / executor workers
+      without a ``# guarded by:`` annotation — PUMI007 only enforces
+      *annotated* state; this closes the inference gap.
+  PUMI011 swallowed-retryable     an ``except`` catching a RETRYABLE /
+      ``Transient*`` type must re-raise, route through
+      ``ResilienceCoordinator.classify``, or count the swallow into a
+      metric — silently absorbing a retryable error erases the
+      resilience layer's signal.
 
 The traced-body notion is a package-wide fixpoint: functions handed to
 ``jax.jit`` / ``lax.scan`` / ``while_loop`` / ``fori_loop`` / ``cond`` /
@@ -95,6 +122,21 @@ APPROVED_TRANSFER_MODULES = frozenset(
 # reference walker is DEFINED as an f64 NumPy oracle.
 F64_EXEMPT_MODULES = frozenset({f"{PACKAGE}/integrity/audit.py"})
 
+# Modules allowed to perform raw persistent writes: they ARE the
+# atomic-write layer (tmp + fsync + rename) every other module must
+# route durable state through.  A raw write anywhere else can tear
+# under crash/ENOSPC — the exact failure mode the crash-safety surface
+# (journal, two-phase checkpoints) exists to rule out.
+APPROVED_DURABLE_MODULES = frozenset(
+    {
+        f"{PACKAGE}/utils/checkpoint.py",
+        f"{PACKAGE}/serving/journal.py",
+        f"{PACKAGE}/serving/bank.py",
+        f"{PACKAGE}/resilience/store.py",
+        f"{PACKAGE}/tuning/db.py",
+    }
+)
+
 # Rule subset applied to sources OUTSIDE the package tree (scripts/,
 # bench.py): the traced-body contracts travel with the jitted code
 # wherever it is launched from, and use-after-donate corrupts data no
@@ -102,6 +144,23 @@ F64_EXEMPT_MODULES = frozenset({f"{PACKAGE}/integrity/audit.py"})
 # transfer-placement and jit-hygiene rules are package-structure
 # contracts and stay package-scoped.
 SCRIPT_RULES = frozenset({"PUMI001", "PUMI003", "PUMI004", "PUMI005"})
+
+# Scripts that OWN crash-safety surface: serve.py writes result JSON
+# beside the journal it resumes from, chaos_serve.py orchestrates the
+# kill/restart campaign around signal-sensitive subprocesses — they
+# additionally get the durability + signal-handler rules on top of the
+# value-safety subset.
+JOURNAL_SCRIPTS = frozenset({"scripts/serve.py", "scripts/chaos_serve.py"})
+JOURNAL_SCRIPT_RULES = SCRIPT_RULES | frozenset({"PUMI008", "PUMI009"})
+
+
+def rules_for_path(path: str) -> frozenset | None:
+    """The rule subset applied to ``path`` (None = every rule)."""
+    if path.startswith(f"{PACKAGE}/"):
+        return None
+    if path in JOURNAL_SCRIPTS:
+        return JOURNAL_SCRIPT_RULES
+    return SCRIPT_RULES
 
 # Call heads whose function-valued arguments become traced.
 _TRACING_HEADS_LAST = frozenset(
@@ -1047,7 +1106,47 @@ def _with_lock_stack(parents, node) -> list[str]:
     return locks
 
 
+def _class_attr_guards(mod: Module, cls: ast.ClassDef) -> dict[str, str]:
+    """``self.<attr>`` → lock expression for every annotated attribute
+    assignment inside ``cls`` (shared by PUMI007's enforcement and
+    PUMI010's is-it-annotated-at-all check)."""
+    annotated = _guard_annotations(mod)
+    attr_guards: dict[str, str] = {}
+    if not annotated:
+        return attr_guards
+    for node in ast.walk(cls):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        lock = annotated.get(node.lineno)
+        if lock is None:
+            continue
+        targets = (
+            node.targets
+            if isinstance(node, ast.Assign)
+            else [node.target]
+        )
+        for t in targets:
+            if (
+                isinstance(t, ast.Attribute)
+                and isinstance(t.value, ast.Name)
+                and t.value.id == "self"
+            ):
+                attr_guards[t.attr] = lock
+    return attr_guards
+
+
 def _rule_guarded_by(index: PackageIndex, out: list[Finding]):
+    """PUMI007 — declared lock protocols, enforced.
+
+    Rationale: the threaded surface (FlightRecorder, HostStager,
+    exporter, watchdog) declares its discipline as ``# guarded by:
+    <lock>`` comments; an access outside ``with <lock>:`` is a data
+    race a test only sees when the interleaving cooperates.
+    Example finding: ``self._records`` annotated ``# guarded by:
+    self._lock`` appended without the lock held.
+    Fix pattern: wrap the access in ``with <lock>:`` (or, for
+    event-guarded handoffs, add the missing ``set()``/``wait()`` edge).
+    """
     for path, mod in index.modules.items():
         annotated = _guard_annotations(mod)
         if not annotated:
@@ -1055,28 +1154,7 @@ def _rule_guarded_by(index: PackageIndex, out: list[Finding]):
         for cls in ast.walk(mod.tree):
             if not isinstance(cls, ast.ClassDef):
                 continue
-            attr_guards: dict[str, str] = {}
-            event_guards: dict[str, str] = {}
-            for node in ast.walk(cls):
-                if not isinstance(
-                    node, (ast.Assign, ast.AnnAssign)
-                ):
-                    continue
-                lock = annotated.get(node.lineno)
-                if lock is None:
-                    continue
-                targets = (
-                    node.targets
-                    if isinstance(node, ast.Assign)
-                    else [node.target]
-                )
-                for t in targets:
-                    if (
-                        isinstance(t, ast.Attribute)
-                        and isinstance(t.value, ast.Name)
-                        and t.value.id == "self"
-                    ):
-                        attr_guards[t.attr] = lock
+            attr_guards = _class_attr_guards(mod, cls)
             if attr_guards:
                 _check_attr_guards(
                     index, path, cls, attr_guards, out
@@ -1217,6 +1295,701 @@ def _check_event_guard(index, path, q, fn, local, event, ann_line, out):
 
 
 # --------------------------------------------------------------------- #
+# Shared layer-4 machinery: raw-write classification + reachability
+# --------------------------------------------------------------------- #
+#: Write heads that serialize straight to a path: head dotted name →
+#: index of the file/path argument.
+_RAW_WRITE_HEADS = {
+    "np.save": 0, "numpy.save": 0,
+    "np.savez": 0, "numpy.savez": 0,
+    "np.savez_compressed": 0, "numpy.savez_compressed": 0,
+    "np.savetxt": 0, "numpy.savetxt": 0,
+    "json.dump": 1, "pickle.dump": 1,
+}
+_PATH_WRITE_ATTRS = frozenset({"write_text", "write_bytes"})
+
+
+def _open_mode(call: ast.Call) -> str | None:
+    mode = None
+    if len(call.args) >= 2:
+        mode = _const_str(call.args[1])
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            mode = _const_str(kw.value)
+    return mode
+
+
+def _scope_file_bindings(nodes) -> tuple[set[str], set[str]]:
+    """(names bound from ``open(...)``, names bound from in-memory
+    buffers like ``io.BytesIO()``/``StringIO()``) within one scope —
+    derivative writes through them are attributed to the ``open`` (or
+    are in-memory and durable-irrelevant), not double-reported."""
+    opened: set[str] = set()
+    buffers: set[str] = set()
+    def note(name, value):
+        if not isinstance(value, ast.Call):
+            return
+        d = _dotted(value.func) or ""
+        last = d.split(".")[-1]
+        if last in ("open", "fdopen"):
+            opened.add(name)
+        elif last in ("BytesIO", "StringIO"):
+            buffers.add(name)
+    for node in nodes:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    note(t.id, node.value)
+        elif isinstance(node, ast.withitem):
+            if isinstance(node.optional_vars, ast.Name):
+                note(node.optional_vars.id, node.context_expr)
+        elif isinstance(node, ast.With):
+            for item in node.items:
+                if isinstance(item.optional_vars, ast.Name):
+                    note(item.optional_vars.id, item.context_expr)
+    return opened, buffers
+
+
+def raw_write_head(call: ast.Call, opened: set[str],
+                   buffers: set[str]) -> str | None:
+    """Classify one call as a raw persistent write; returns the head
+    description, or None.  ``opened``/``buffers`` are the scope's file
+    bindings (``_scope_file_bindings``): writes through an already-
+    reported ``open`` handle or into an in-memory buffer are skipped."""
+    d = _dotted(call.func)
+    if d is None:
+        return None
+    last = d.split(".")[-1]
+    if last == "open" and d in ("open", "io.open"):
+        mode = _open_mode(call)
+        if mode is not None and any(c in mode for c in "wax"):
+            return f'open(..., "{mode}")'
+        return None
+    if d in _RAW_WRITE_HEADS:
+        i = _RAW_WRITE_HEADS[d]
+        arg = call.args[i] if len(call.args) > i else None
+        if isinstance(arg, ast.Name) and arg.id in (opened | buffers):
+            return None
+        if isinstance(arg, ast.Call):
+            inner = (_dotted(arg.func) or "").split(".")[-1]
+            if inner in ("open", "fdopen", "BytesIO", "StringIO"):
+                # json.dump(obj, open(p, "w")) is ONE write — the
+                # inline open reports it (or it's an in-memory buffer).
+                return None
+        return f"{d}()"
+    if isinstance(call.func, ast.Attribute) and (
+        call.func.attr in _PATH_WRITE_ATTRS
+    ):
+        return f".{call.func.attr}()"
+    return None
+
+
+def _enclosing_class(index: PackageIndex, path, node) -> ast.ClassDef | None:
+    cur = node
+    parent = index.parents[path]
+    while cur is not None:
+        cur = parent.get(cur)
+        if isinstance(cur, ast.ClassDef):
+            return cur
+    return None
+
+
+def _class_method(cls: ast.ClassDef | None, name: str):
+    if cls is None:
+        return None
+    for stmt in cls.body:
+        if isinstance(
+            stmt, (ast.FunctionDef, ast.AsyncFunctionDef)
+        ) and stmt.name == name:
+            return stmt
+    return None
+
+
+def _resolve_callable(index: PackageIndex, path, expr, cls,
+                      local_env=None):
+    """Resolve a callable expression to (path, fn_node, class) — a
+    ``self.X`` method of ``cls``, a local/module def, or an imported
+    package def.  None when not statically resolvable."""
+    if (
+        isinstance(expr, ast.Attribute)
+        and isinstance(expr.value, ast.Name)
+        and expr.value.id == "self"
+    ):
+        m = _class_method(cls, expr.attr)
+        return (path, m, cls) if m is not None else None
+    key = index._resolve(path, expr, local_env)
+    if key and key[0] == "def@":
+        fn = index.defs.get((key[1], key[2]))
+        if fn is not None:
+            return (key[1], fn, _enclosing_class(index, key[1], fn))
+    return None
+
+
+def _reachable_callables(index: PackageIndex, start):
+    """Transitive closure of statically-resolvable calls from ``start``
+    = (path, fn_node, class): self-methods, module defs, and imported
+    package defs.  The layer-4 rules walk this instead of the traced
+    fixpoint — signal handlers and thread workers are HOST code."""
+    seen: dict = {}
+    stack = [start]
+    while stack:
+        path, fn, cls = stack.pop()
+        qkey = (path, index.qualname(path, fn))
+        if qkey in seen:
+            continue
+        seen[qkey] = (path, fn, cls)
+        local = index._local_defs_env(path, fn)
+        local.update(index._fn_import_env(path, fn))
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                r = _resolve_callable(index, path, node.func, cls, local)
+                if r is not None:
+                    stack.append(r)
+    return list(seen.values())
+
+
+def _collect_jit_wrappers(index: PackageIndex) -> set[tuple[str, str]]:
+    """(path, name) of every module-level ``X = ...jit(...)`` — calling
+    one is a compiled-program dispatch."""
+    wrappers: set[tuple[str, str]] = set()
+    for path, mod in index.modules.items():
+        for node in mod.tree.body:
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)
+                and (_dotted(node.value.func) or "").split(".")[-1]
+                == "jit"
+            ):
+                wrappers.add((path, node.targets[0].id))
+    return wrappers
+
+
+# --------------------------------------------------------------------- #
+# PUMI008: raw persistent writes outside the atomic-write modules
+# --------------------------------------------------------------------- #
+def _rule_raw_durable_write(index: PackageIndex, out: list[Finding]):
+    """PUMI008 — durable state must ride the atomic writers.
+
+    Rationale: the crash-safety layer (journal, two-phase checkpoints,
+    AOT bank) is built on tmp+fsync+rename writes; a raw
+    ``open(..., "w")`` / ``np.save`` / ``json.dump`` / ``Path.write_*``
+    anywhere else can leave a TORN file under the real name on
+    crash/ENOSPC — and a restart then reads garbage where the recovery
+    path expected committed state.
+    Example finding: ``json.dump(state, open(path, "w"))`` in a module
+    outside utils/checkpoint.py, serving/journal.py, serving/bank.py,
+    resilience/store.py, tuning/db.py.
+    Fix pattern: route the write through
+    ``utils.checkpoint.atomic_write_bytes`` / ``atomic_savez`` (or
+    baseline a genuinely one-shot, re-creatable export with a
+    justification).
+    """
+    def scan_scope(path, nodes, symbol_of):
+        opened, buffers = _scope_file_bindings(nodes)
+        for node in nodes:
+            if not isinstance(node, ast.Call):
+                continue
+            head = raw_write_head(node, opened, buffers)
+            if head is None:
+                continue
+            out.append(
+                Finding(
+                    "PUMI008", path, node.lineno, symbol_of(node),
+                    f"{head} outside the approved atomic-write modules "
+                    "— a raw write can tear under crash/ENOSPC; route "
+                    "durable state through utils/checkpoint.py's "
+                    "atomic writers (tmp+fsync+rename), or baseline a "
+                    "one-shot re-creatable export with a justification",
+                )
+            )
+
+    for path, mod in index.modules.items():
+        if path in APPROVED_DURABLE_MODULES:
+            continue
+        # Module-level statements, plus class-body statements (run at
+        # import time); defs are scanned below through index.defs.
+        scan_scope(
+            path, list(_walk_shallow(mod.tree)),
+            lambda node, path=path: index.enclosing_symbol(path, node),
+        )
+        for cls in ast.walk(mod.tree):
+            if isinstance(cls, ast.ClassDef):
+                scan_scope(
+                    path, list(_walk_shallow(cls)),
+                    lambda node, path=path: index.enclosing_symbol(
+                        path, node
+                    ),
+                )
+    for (path, q), fn in index.defs.items():
+        if path in APPROVED_DURABLE_MODULES:
+            continue
+        scan_scope(path, list(_walk_shallow(fn)), lambda node, q=q: q)
+
+
+# --------------------------------------------------------------------- #
+# PUMI009: signal-handler safety
+# --------------------------------------------------------------------- #
+def _handler_has_deferral_guard(handler_fn) -> bool:
+    """The sanctioned mid-dispatch idiom: an ``if`` that parks the
+    signum (``self._pending_signal = signum``) and returns, so the
+    flush runs at a consistent quantum/move boundary instead of inside
+    a half-completed dispatch."""
+    params = [
+        a.arg
+        for a in list(handler_fn.args.posonlyargs)
+        + list(handler_fn.args.args)
+        if a.arg not in ("self", "cls")
+    ]
+    signum = params[0] if params else None
+    if signum is None:
+        return False
+    for node in ast.walk(handler_fn):
+        if not isinstance(node, ast.If):
+            continue
+        body_nodes = [n for s in node.body for n in ast.walk(s)]
+        stores = any(
+            isinstance(n, ast.Assign)
+            and isinstance(n.value, ast.Name)
+            and n.value.id == signum
+            and any(
+                isinstance(t, (ast.Attribute, ast.Name))
+                for t in n.targets
+            )
+            for n in body_nodes
+        )
+        returns = any(isinstance(n, ast.Return) for n in body_nodes)
+        if stores and returns:
+            return True
+    return False
+
+
+def _rule_signal_handler_safety(index: PackageIndex, out: list[Finding]):
+    """PUMI009 — preemption-signal handlers stay async-signal-safe.
+
+    Rationale: a SIGTERM/SIGINT handler interrupts the main thread at
+    an ARBITRARY bytecode boundary.  Flushing the journal from there
+    without the deferral guard can interleave with a half-finished
+    flush on the interrupted frame; taking a ``# guarded by:`` lock
+    can deadlock against the thread it interrupted; dispatching a
+    compiled program can wedge inside the runtime.  And an install
+    without a matching uninstall leaves a STALE handler that a later
+    signal routes into a dead supervisor (the PR 14 clobber bug class).
+    Example finding: a handler reachable from
+    ``install_preemption_handlers`` calling ``self._flush_journal()``
+    with no ``if self._in_step: self._pending_signal = signum; return``
+    guard.
+    Fix pattern: add the deferral guard (park the signum, flush at the
+    next quantum/move boundary); keep locks and jit dispatch out of
+    handler-reachable code; pair every install with an uninstall on
+    every exit path, uninstalling before chaining the previous handler.
+    """
+    jit_wrappers = _collect_jit_wrappers(index)
+    locks_by_module = {
+        path: {
+            lock
+            for lock in _guard_annotations(mod).values()
+            if not _EVENT_SUFFIX_RE.search(lock)
+        }
+        for path, mod in index.modules.items()
+    }
+
+    def calls_uninstall(fn, cls) -> bool:
+        """Direct uninstall, or one level through a self-method."""
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            d = _dotted(node.func) or ""
+            last = d.split(".")[-1]
+            if last == "uninstall_preemption_handlers":
+                return True
+            if (
+                isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "self"
+            ):
+                m = _class_method(cls, node.func.attr)
+                if m is not None and any(
+                    isinstance(n, ast.Call)
+                    and (_dotted(n.func) or "").split(".")[-1]
+                    == "uninstall_preemption_handlers"
+                    for n in ast.walk(m)
+                ):
+                    return True
+        return False
+
+    for path, mod in index.modules.items():
+        if path == f"{PACKAGE}/utils/signals.py":
+            continue  # the plumbing itself, not a supervisor
+        for node in ast.walk(mod.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and (_dotted(node.func) or "").split(".")[-1]
+                == "install_preemption_handlers"
+            ):
+                continue
+            cls = _enclosing_class(index, path, node)
+            install_symbol = index.enclosing_symbol(path, node)
+            # Matching uninstall must exist in the installing scope.
+            scope = cls if cls is not None else mod.tree
+            if not any(
+                isinstance(n, ast.Call)
+                and (_dotted(n.func) or "").split(".")[-1]
+                == "uninstall_preemption_handlers"
+                for n in ast.walk(scope)
+            ):
+                out.append(
+                    Finding(
+                        "PUMI009", path, node.lineno, install_symbol,
+                        "install_preemption_handlers without any "
+                        "matching uninstall_preemption_handlers in "
+                        f"{'class ' + cls.name if cls else 'the module'}"
+                        " — the handler outlives its supervisor and a "
+                        "later signal routes into dead state",
+                    )
+                )
+            handler_expr = node.args[0] if node.args else None
+            if handler_expr is None:
+                continue
+            resolved = _resolve_callable(
+                index, path, handler_expr, cls
+            )
+            if resolved is None:
+                continue
+            handler_fn = resolved[1]
+            guarded = _handler_has_deferral_guard(handler_fn)
+            for p2, fn, cls2 in _reachable_callables(index, resolved):
+                q2 = index.qualname(p2, fn)
+                for sub in ast.walk(fn):
+                    if isinstance(sub, ast.With):
+                        for item in sub.items:
+                            try:
+                                expr = ast.unparse(item.context_expr)
+                            except Exception:
+                                continue
+                            if expr in locks_by_module.get(p2, ()):
+                                out.append(
+                                    Finding(
+                                        "PUMI009", p2, sub.lineno, q2,
+                                        f"signal-handler path takes "
+                                        f"'{expr}' (a '# guarded by:' "
+                                        "lock) — the interrupted "
+                                        "thread may hold it: deadlock",
+                                    )
+                                )
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    d = _dotted(sub.func) or ""
+                    last = d.split(".")[-1]
+                    if (
+                        last == "_flush_journal"
+                        or d.endswith("journal.flush")
+                    ) and not guarded:
+                        out.append(
+                            Finding(
+                                "PUMI009", p2, sub.lineno, q2,
+                                "signal-handler path flushes the "
+                                "journal but the installed handler "
+                                "has no mid-dispatch deferral guard "
+                                "(park the signum and flush at the "
+                                "next quantum/move boundary)",
+                            )
+                        )
+                    local = index._local_defs_env(p2, fn)
+                    local.update(index._fn_import_env(p2, fn))
+                    key = index._resolve(p2, sub.func, local)
+                    is_jit_call = (
+                        key is not None
+                        and key[0] == "def@"
+                        and (key[1], key[2]) in index.traced
+                    ) or (
+                        isinstance(sub.func, ast.Name)
+                        and (p2, sub.func.id) in jit_wrappers
+                    )
+                    if is_jit_call:
+                        out.append(
+                            Finding(
+                                "PUMI009", p2, sub.lineno, q2,
+                                f"signal-handler path calls '{d}' "
+                                "which dispatches a compiled program "
+                                "— a handler wedged inside the "
+                                "runtime cannot be recovered",
+                            )
+                        )
+                    if last == "resume_previous_handler" and (
+                        not calls_uninstall(fn, cls2)
+                    ):
+                        out.append(
+                            Finding(
+                                "PUMI009", p2, sub.lineno, q2,
+                                "resume_previous_handler without "
+                                "uninstalling this supervisor's "
+                                "handlers first — dying through the "
+                                "chain leaves a stale handler "
+                                "installed for the next signal",
+                            )
+                        )
+
+
+# --------------------------------------------------------------------- #
+# PUMI010: thread-shared state without a guard annotation
+# --------------------------------------------------------------------- #
+def _thread_entry_points(index: PackageIndex):
+    """(path, target_def, class) for every statically-resolvable
+    ``threading.Thread(target=...)`` and executor ``submit``/``map``
+    worker."""
+    entries = []
+    for path, mod in index.modules.items():
+        for (p2, q), fn in index.defs.items():
+            if p2 != path:
+                continue
+            shallow = list(_walk_shallow(fn))
+            executors = set()
+            for node in shallow:
+                if isinstance(node, ast.Assign):
+                    if isinstance(node.value, ast.Call) and (
+                        _dotted(node.value.func) or ""
+                    ).split(".")[-1] == "ThreadPoolExecutor":
+                        for t in node.targets:
+                            if isinstance(t, ast.Name):
+                                executors.add(t.id)
+                elif isinstance(node, ast.With):
+                    for item in node.items:
+                        if (
+                            isinstance(item.context_expr, ast.Call)
+                            and (_dotted(item.context_expr.func) or "")
+                            .split(".")[-1] == "ThreadPoolExecutor"
+                            and isinstance(
+                                item.optional_vars, ast.Name
+                            )
+                        ):
+                            executors.add(item.optional_vars.id)
+            cls = _enclosing_class(index, path, fn)
+            local = index._local_defs_env(path, fn)
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                d = _dotted(node.func) or ""
+                last = d.split(".")[-1]
+                target_expr = None
+                if last == "Thread":
+                    for kw in node.keywords:
+                        if kw.arg == "target":
+                            target_expr = kw.value
+                elif (
+                    last in ("submit", "map")
+                    and isinstance(node.func, ast.Attribute)
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id in executors
+                    and node.args
+                ):
+                    target_expr = node.args[0]
+                if target_expr is None:
+                    continue
+                resolved = _resolve_callable(
+                    index, path, target_expr, cls, local
+                )
+                if resolved is not None:
+                    entries.append(resolved)
+    return entries
+
+
+def _rule_thread_shared_state(index: PackageIndex, out: list[Finding]):
+    """PUMI010 — thread-shared state must be annotated.
+
+    Rationale: PUMI007 enforces the lock discipline of ANNOTATED
+    state; state a worker thread writes WITHOUT an annotation is
+    invisible to it — the inference gap a racing write slips through.
+    Anything written from code reachable from a ``threading.Thread``
+    target (or an executor worker) must either carry ``# guarded by:
+    <lock>`` (PUMI007 then enforces the lock) or be provably
+    thread-confined (local to the worker).
+    Example finding: a watchdog worker writing ``self._last_beat``
+    when no assignment of ``_last_beat`` is annotated.
+    Fix pattern: annotate the attribute's assignment with
+    ``# guarded by: <lock>`` and take that lock at every access — or
+    restructure so the worker publishes through an Event-guarded
+    handoff (PUMI007's ``(event)`` form).
+    """
+    for resolved in _thread_entry_points(index):
+        tpath, tfn, _tcls = resolved
+        # Worker closures: stores to enclosing-scope locals need the
+        # event-guard annotation (or any guard comment on the line
+        # that binds them in the enclosing function).
+        parents = index.parents[tpath]
+        encl = parents.get(tfn)
+        while encl is not None and not isinstance(
+            encl, (ast.FunctionDef, ast.AsyncFunctionDef)
+        ):
+            encl = parents.get(encl)
+        outer_names: dict[str, bool] = {}  # name -> annotated?
+        if encl is not None:
+            mod = index.modules[tpath]
+            annotated_lines = _guard_annotations(mod)
+            for node in _walk_shallow(encl):
+                if isinstance(node, ast.Assign):
+                    ann = node.lineno in annotated_lines
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            outer_names[t.id] = (
+                                outer_names.get(t.id, False) or ann
+                            )
+        for p2, fn, cls2 in _reachable_callables(index, resolved):
+            q2 = index.qualname(p2, fn)
+            if q2.split(".")[-1] == "__init__":
+                continue
+            mod2 = index.modules[p2]
+            guards = (
+                _class_attr_guards(mod2, cls2)
+                if cls2 is not None else {}
+            )
+            # A plain-name rebind in the worker creates a WORKER-LOCAL
+            # unless the worker declares it nonlocal — only then (or on
+            # subscript mutation, which reads the closure cell) is the
+            # enclosing function's state actually shared.
+            nonlocals = {
+                name
+                for sub in ast.walk(fn)
+                if isinstance(sub, ast.Nonlocal)
+                for name in sub.names
+            }
+            for node in ast.walk(fn):
+                targets = []
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                elif isinstance(node, ast.AugAssign):
+                    targets = [node.target]
+                for t in targets:
+                    base = t
+                    shares_cell = False
+                    if isinstance(base, ast.Subscript):
+                        base = base.value
+                        shares_cell = True  # mutates the shared object
+                    elif isinstance(base, ast.Name):
+                        shares_cell = base.id in nonlocals
+                    if (
+                        isinstance(base, ast.Attribute)
+                        and isinstance(base.value, ast.Name)
+                        and base.value.id == "self"
+                        and base.attr not in guards
+                    ):
+                        out.append(
+                            Finding(
+                                "PUMI010", p2, t.lineno, q2,
+                                f"self.{base.attr} is written on a "
+                                "thread-worker path but carries no "
+                                "'# guarded by:' annotation — "
+                                "annotate it (PUMI007 then enforces "
+                                "the lock) or make it worker-local",
+                            )
+                        )
+                    elif (
+                        p2 == tpath
+                        and fn is tfn
+                        and encl is not None
+                        and isinstance(base, ast.Name)
+                        and shares_cell
+                        and isinstance(
+                            getattr(t, "ctx", ast.Store()), ast.Store
+                        )
+                        and outer_names.get(base.id) is False
+                    ):
+                        out.append(
+                            Finding(
+                                "PUMI010", p2, t.lineno, q2,
+                                f"worker closure writes '{base.id}' "
+                                "shared with the enclosing function "
+                                "but no '# guarded by:' annotation "
+                                "covers it — declare the handoff "
+                                "(e.g. '# guarded by: <event> "
+                                "(event)') so PUMI007 can check the "
+                                "happens-before edge",
+                            )
+                        )
+
+
+# --------------------------------------------------------------------- #
+# PUMI011: swallowed retryable exceptions
+# --------------------------------------------------------------------- #
+_RETRYABLE_EXC_NAMES = frozenset(
+    {
+        "RETRYABLE",
+        "InjectedTransientFault",
+        "TransientIntegrityViolation",
+        "DispatchTimeoutError",
+        "JaxRuntimeError",
+        "_JaxRuntimeError",
+    }
+)
+
+
+def _rule_swallowed_retryable(index: PackageIndex, out: list[Finding]):
+    """PUMI011 — retryable failures must stay visible.
+
+    Rationale: the resilience layer's whole contract is that
+    RETRYABLE / ``Transient*`` errors are CLASSIFIED and replayed (or
+    counted) — an ``except`` that silently absorbs one erases the
+    signal: no retry, no rollback, no metric, and the chaos campaigns
+    can no longer prove the failure was handled.
+    Example finding: ``except InjectedTransientFault: pass``.
+    Fix pattern: re-raise after local cleanup, route the exception
+    through ``ResilienceCoordinator.classify`` and act on the verdict,
+    or count the deliberate swallow into a ``pumi_*`` metric
+    (``counter.inc(...)``) inside a bounded retry loop.
+    """
+    for path, mod in index.modules.items():
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if node.type is None:
+                continue
+            names = {
+                (sub.id if isinstance(sub, ast.Name) else sub.attr)
+                for sub in ast.walk(node.type)
+                if isinstance(sub, (ast.Name, ast.Attribute))
+            }
+            retryable = {
+                n
+                for n in names
+                if n in _RETRYABLE_EXC_NAMES
+                or n.startswith("Transient")
+            }
+            if not retryable:
+                continue
+            body_nodes = [
+                n for s in node.body for n in ast.walk(s)
+            ]
+            reraises = any(
+                isinstance(n, ast.Raise) for n in body_nodes
+            )
+            classifies = any(
+                isinstance(n, ast.Call)
+                and (_dotted(n.func) or "").split(".")[-1]
+                == "classify"
+                for n in body_nodes
+            )
+            counts = any(
+                isinstance(n, ast.Call)
+                and (_dotted(n.func) or "").split(".")[-1] == "inc"
+                for n in body_nodes
+            )
+            if not (reraises or classifies or counts):
+                out.append(
+                    Finding(
+                        "PUMI011", path, node.lineno,
+                        index.enclosing_symbol(path, node),
+                        f"except clause catches retryable "
+                        f"{sorted(retryable)} and swallows it — "
+                        "re-raise, route through "
+                        "ResilienceCoordinator.classify, or count "
+                        "the deliberate swallow into a pumi_* "
+                        "metric inside a bounded loop",
+                    )
+                )
+
+
+# --------------------------------------------------------------------- #
 # Entry points
 # --------------------------------------------------------------------- #
 _RULES = (
@@ -1227,34 +2000,46 @@ _RULES = (
     _rule_f64,
     _rule_jit_hygiene,
     _rule_guarded_by,
+    _rule_raw_durable_write,
+    _rule_signal_handler_safety,
+    _rule_thread_shared_state,
+    _rule_swallowed_retryable,
 )
+
+
+def lint_index(index: PackageIndex) -> list[Finding]:
+    """Run every rule over an already-built index (shared with the
+    protocol layer by scripts/lint.py, so one full run parses and
+    fixpoints the tree exactly once)."""
+    out: list[Finding] = []
+    for rule in _RULES:
+        rule(index, out)
+
+    def keep(f: Finding) -> bool:
+        subset = rules_for_path(f.path)
+        return subset is None or f.rule in subset
+
+    out = [f for f in out if keep(f)]
+    out.sort(key=lambda f: (f.path, f.line, f.rule))
+    return out
 
 
 def lint_sources(sources: dict[str, str]) -> list[Finding]:
     """Lint a {relpath: source} mapping (the test fixtures' entry).
 
     Paths outside the package tree (scripts, bench) participate fully
-    in the index and the traced fixpoint, but only their
-    ``SCRIPT_RULES`` findings are reported."""
+    in the index and the traced fixpoint, but only their subset's
+    findings are reported (``rules_for_path``: the value-safety
+    ``SCRIPT_RULES``, plus PUMI008/PUMI009 for the journal-owning
+    ``JOURNAL_SCRIPTS``)."""
     modules = {p: _parse(p, s) for p, s in sources.items()}
-    index = PackageIndex(modules)
-    out: list[Finding] = []
-    for rule in _RULES:
-        rule(index, out)
-    out = [
-        f
-        for f in out
-        if f.path.startswith(f"{PACKAGE}/") or f.rule in SCRIPT_RULES
-    ]
-    out.sort(key=lambda f: (f.path, f.line, f.rule))
-    return out
+    return lint_index(PackageIndex(modules))
 
 
-def lint_package(root) -> list[Finding]:
-    """Lint every module of the package tree under ``root`` (the repo
-    checkout: ``root/pumiumtally_tpu/**/*.py``) plus the launch surface
-    — ``root/scripts/*.py`` and ``root/bench.py`` — under the
-    ``SCRIPT_RULES`` subset."""
+def collect_sources(root) -> dict[str, str]:
+    """{relpath: source} for the linted tree: the package, scripts/,
+    and bench.py (shared with :mod:`analysis.protolint`, which builds
+    its index over the same file set)."""
     root = Path(root)
     sources = {}
     for p in sorted((root / PACKAGE).rglob("*.py")):
@@ -1265,4 +2050,58 @@ def lint_package(root) -> list[Finding]:
     bench = root / "bench.py"
     if bench.exists():
         sources["bench.py"] = bench.read_text()
-    return lint_sources(sources)
+    return sources
+
+
+def lint_package(root) -> list[Finding]:
+    """Lint every module of the package tree under ``root`` (the repo
+    checkout: ``root/pumiumtally_tpu/**/*.py``) plus the launch surface
+    — ``root/scripts/*.py`` and ``root/bench.py`` — under their
+    ``rules_for_path`` subsets."""
+    return lint_sources(collect_sources(root))
+
+
+#: Rule id → rule function; ``explain`` renders the docstring
+#: (rationale / example finding / fix pattern) for self-serve CI
+#: failures via ``scripts/lint.py --explain <RULE>``.
+RULES_BY_ID = {
+    "PUMI001": _rule_host_sync,
+    "PUMI002": _rule_transfers,
+    "PUMI003": _rule_use_after_donate,
+    "PUMI004": _rule_nondeterminism,
+    "PUMI005": _rule_f64,
+    "PUMI006": _rule_jit_hygiene,
+    "PUMI007": _rule_guarded_by,
+    "PUMI008": _rule_raw_durable_write,
+    "PUMI009": _rule_signal_handler_safety,
+    "PUMI010": _rule_thread_shared_state,
+    "PUMI011": _rule_swallowed_retryable,
+}
+
+#: One-line summaries for rules whose functions predate the structured
+#: docstrings — ``explain`` falls back to the module docstring's
+#: catalogue entry for these.
+_MODULE_DOC_RULES = re.compile(
+    r"^  (?P<rule>PUMI\d{3}) .*?(?=^  PUMI|\Z)", re.M | re.S
+)
+
+
+def explain(rule: str) -> str | None:
+    """Human-readable rationale + example + fix pattern for one rule
+    id, pulled from the rule function's docstring (falling back to the
+    module docstring's catalogue entry).  None for unknown rules."""
+    rule = rule.strip().upper()
+    fn = RULES_BY_ID.get(rule)
+    if fn is None:
+        return None
+    import textwrap
+
+    doc = fn.__doc__ or ""
+    first, _, rest = doc.partition("\n")
+    doc = (first.strip() + "\n" + textwrap.dedent(rest)).strip()
+    if "Rationale" in doc:
+        return f"{rule}\n{doc}"
+    for m in _MODULE_DOC_RULES.finditer(__doc__ or ""):
+        if m.group("rule") == rule:
+            return textwrap.dedent(m.group(0)).strip()
+    return f"{rule}\n{doc}"

@@ -483,8 +483,11 @@ def load_contracts(path) -> dict:
 
 
 def write_contracts(path, cap: dict | None = None) -> dict:
+    from ..utils.checkpoint import atomic_write_json
+
     cap = cap or capture()
-    with open(path, "w") as fh:
-        json.dump(cap, fh, indent=1, sort_keys=True)
-        fh.write("\n")
+    # The committed capture is state every later lint run diffs
+    # against — atomic write (PUMI008), so an interrupted regeneration
+    # can never leave a torn baseline under the real name.
+    atomic_write_json(path, cap)
     return cap

@@ -768,8 +768,10 @@ def load_perf_contracts(path) -> dict:
 
 
 def write_perf_contracts(path, cap: dict | None = None, **kw) -> dict:
+    from ..utils.checkpoint import atomic_write_json
+
     cap = cap or capture(**kw)
-    with open(path, "w") as fh:
-        json.dump(cap, fh, indent=1, sort_keys=True)
-        fh.write("\n")
+    # Committed baseline: atomic write (PUMI008) — a torn regeneration
+    # must never masquerade as the real capture.
+    atomic_write_json(path, cap)
     return cap

@@ -17,7 +17,12 @@ from .core import TetMesh
 
 
 def save_npz(filename: str, coords, tet2vert, class_id) -> None:
-    np.savez_compressed(
+    from ..utils.checkpoint import atomic_savez
+
+    # Mesh snapshots are durable state a later run ingests — the
+    # atomic writer (tmp+fsync+rename) rules out a torn .npz under the
+    # real name on crash/ENOSPC (graft-check PUMI008).
+    atomic_savez(
         filename,
         coords=np.asarray(coords, np.float64),
         tet2vert=np.asarray(tet2vert, np.int64),

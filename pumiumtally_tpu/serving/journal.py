@@ -61,7 +61,7 @@ import re
 
 import numpy as np
 
-from ..utils.checkpoint import _atomic_write_bytes
+from ..utils.checkpoint import atomic_write_bytes, atomic_write_json
 
 JOURNAL_SCHEMA = 1
 JOURNAL_FILE = "JOBS.json"
@@ -162,7 +162,7 @@ class SchedulerJournal:
         journal-relative name the document records."""
         buf = io.BytesIO()
         np.save(buf, np.asarray(arr))
-        _atomic_write_bytes(self.flux_path(job_id), buf.getvalue())
+        atomic_write_bytes(self.flux_path(job_id), buf.getvalue())
         return os.path.basename(self.flux_path(job_id))
 
     def load_flux(self, job_id: str) -> np.ndarray | None:
@@ -188,10 +188,7 @@ class SchedulerJournal:
             "quantum_moves": int(quantum_moves),
             "jobs": {e["id"]: e for e in entries},
         }
-        _atomic_write_bytes(
-            self.path,
-            (json.dumps(doc, indent=1, sort_keys=True) + "\n").encode(),
-        )
+        atomic_write_json(self.path, doc)
 
     def load(self) -> dict | None:
         """The committed document, or None when no journal exists yet.

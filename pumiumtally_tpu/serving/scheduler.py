@@ -549,27 +549,39 @@ class TallyScheduler:
         trajectory re-runs).  Over a warm program bank the recovered
         process compiles no program family."""
         sched = cls(mesh, config, journal_dir=journal_dir, **kwargs)
-        doc = sched.journal.load()
-        if not doc:
-            return sched
-        for entry in sorted(
-            doc.get("jobs", {}).values(), key=lambda e: e["index"]
-        ):
-            sched._recover_job(entry)
-        sched._n_submitted = max(
-            (j.index + 1 for j in sched._jobs.values()),
-            default=sched._n_submitted,
-        )
-        sched.recorder.record(
-            "journal_recovery", jobs=len(sched._jobs),
-            recovered=sched._n_recovered,
-            quantum_moves=doc.get("quantum_moves"),
-        )
-        log_info(
-            f"scheduler recovery: {len(sched._jobs)} journaled jobs, "
-            f"{sched._n_recovered} re-queued from {journal_dir}"
-        )
-        sched._flush_journal()
+        try:
+            doc = sched.journal.load()
+            if not doc:
+                return sched
+            for entry in sorted(
+                doc.get("jobs", {}).values(), key=lambda e: e["index"]
+            ):
+                sched._recover_job(entry)
+            sched._n_submitted = max(
+                (j.index + 1 for j in sched._jobs.values()),
+                default=sched._n_submitted,
+            )
+            sched.recorder.record(
+                "journal_recovery", jobs=len(sched._jobs),
+                recovered=sched._n_recovered,
+                quantum_moves=doc.get("quantum_moves"),
+            )
+            log_info(
+                f"scheduler recovery: {len(sched._jobs)} journaled "
+                f"jobs, {sched._n_recovered} re-queued from "
+                f"{journal_dir}"
+            )
+            sched._flush_journal()
+        except BaseException:
+            # Construction already installed the preemption handlers;
+            # a failed recovery (unreadable journal, bad entry) must
+            # not leak them — a stale handler would route the NEXT
+            # signal into this dead half-recovered scheduler.  abandon
+            # (not close): the journal on disk stays exactly as the
+            # crashed process committed it, never rewritten with a
+            # half-recovered table.
+            sched.abandon()
+            raise
         return sched
 
     def _recover_job(self, entry: dict) -> None:

@@ -141,9 +141,12 @@ def load_tuning(path: str) -> TuningDB:
 
 
 def write_tuning(path: str, data: dict) -> None:
-    with open(path, "w") as fh:
-        json.dump(data, fh, indent=1, sort_keys=True)
-        fh.write("\n")
+    from ..utils.checkpoint import atomic_write_json
+
+    # The database outlives the tuner that wrote it and is consumed at
+    # every facade construction — atomic write, so a crash mid-retune
+    # leaves the previous committed database, never a torn one.
+    atomic_write_json(path, data)
 
 
 # Facades construct often (every test builds a tally); re-parsing the

@@ -231,9 +231,12 @@ def _file_digest(path: str) -> str:
     return h.hexdigest()
 
 
-def _atomic_write_bytes(filename: str, data: bytes) -> None:
+def atomic_write_bytes(filename: str, data: bytes) -> None:
     """The ``atomic_savez`` durability contract for a small opaque blob
-    (the manifest): tmp + fsync + rename + directory fsync."""
+    (the manifest, the journal document, committed JSON baselines):
+    tmp + fsync + rename + directory fsync.  Public alongside
+    ``atomic_savez``/``fsync_dir`` — every module that persists durable
+    state routes through one of these (graft-check PUMI008)."""
     directory = os.path.dirname(os.path.abspath(filename)) or "."
     fd, tmp = tempfile.mkstemp(
         dir=directory, prefix=os.path.basename(filename) + ".tmp-"
@@ -251,6 +254,17 @@ def _atomic_write_bytes(filename: str, data: bytes) -> None:
         except OSError:
             pass
         raise
+
+
+def atomic_write_json(filename: str, obj) -> None:
+    """Committed-JSON convenience over ``atomic_write_bytes``: the
+    repo's canonical serialization for captures/baselines/journals
+    (indent=1, sorted keys, trailing newline) in one place, so the six
+    writers cannot drift apart."""
+    atomic_write_bytes(
+        filename,
+        (json.dumps(obj, indent=1, sort_keys=True) + "\n").encode(),
+    )
 
 
 def shard_name(index: int) -> str:
@@ -319,7 +333,7 @@ def save_sharded_checkpoint(
         "n_shards": int(n_shards),
         "shards": {os.path.basename(p): _file_digest(p) for p in paths},
     }
-    _atomic_write_bytes(
+    atomic_write_bytes(
         manifest_path, json.dumps(manifest, indent=1).encode()
     )
     return n_shards

@@ -1,34 +1,50 @@
 #!/usr/bin/env python
 """graft-check: run the static-analysis layers (+ ruff when present).
 
-  python scripts/lint.py                 # astlint + contracts + cost + ruff
+  python scripts/lint.py                 # ast + contracts + cost +
+                                         # protocols + ruff
   python scripts/lint.py --ast-only
   python scripts/lint.py --contracts-only
   python scripts/lint.py --perf-only         # cost layer alone
-  python scripts/lint.py --no-perf           # everything BUT the cost
-                                             # layer (CI pairs this
-                                             # with a --perf-only step)
+  python scripts/lint.py --protocols-only    # layer 4 protocol lint
+                                             # alone (CI step)
+  python scripts/lint.py --no-perf           # skip the cost layer
+  python scripts/lint.py --no-protocols      # skip the protocol layer
+                                             # (CI pairs these with
+                                             # their dedicated steps)
   python scripts/lint.py --write-contracts   # regenerate CONTRACTS.json
   python scripts/lint.py --write-perf-contracts  # regenerate
                                              # PERF_CONTRACTS.json
                                              # (intentional drift only)
+  python scripts/lint.py --write-protocols   # regenerate PROTOCOLS.json
+  python scripts/lint.py --explain PUMI008   # a rule's rationale,
+                                             # example finding, and fix
+                                             # pattern (also takes
+                                             # 'protocol' or a protocol
+                                             # name)
   python scripts/lint.py --allow-stale       # mid-refactor: stale
                                              # baseline entries warn
                                              # instead of failing
 
 Layer 1 (pumiumtally_tpu/analysis/astlint.py) lints the package source
-— plus scripts/ and bench.py under the traced-body rule subset —
-against the codebase-specific rules PUMI001..PUMI007.  Layer 2
-(analysis/contracts.py) abstract-traces the five public program
-families and checks the structural invariants plus drift against the
-committed CONTRACTS.json.  Layer 3 (analysis/costmodel.py) compiles the
-same five families over a shape ladder and checks the resource
-invariants — f64 flop census, donation/peak memory bounds, the Pallas
-VMEM-estimator mirror, scaling exponents — plus drift against
-PERF_CONTRACTS.json within per-metric tolerance bands.  The base-rung
-trace is built ONCE and shared between layers 2 and 3 (the whole run
-stays well under 90 s).  Findings are suppressed per (rule, path,
-symbol) through LINT_BASELINE.json; every suppression carries a
+— plus scripts/ and bench.py under the traced-body rule subset, and
+the journal-owning scripts (serve.py, chaos_serve.py) additionally
+under PUMI008/PUMI009 — against the codebase-specific rules
+PUMI001..PUMI011.  Layer 2 (analysis/contracts.py) abstract-traces the
+five public program families and checks the structural invariants plus
+drift against the committed CONTRACTS.json.  Layer 3
+(analysis/costmodel.py) compiles the same five families over a shape
+ladder and checks the resource invariants — f64 flop census,
+donation/peak memory bounds, the Pallas VMEM-estimator mirror, scaling
+exponents — plus drift against PERF_CONTRACTS.json within per-metric
+tolerance bands.  Layer 4 (analysis/protolint.py) verifies the
+declared durability/concurrency protocols of the crash-safety surface
+— effect-ordering happens-before constraints along all CFG paths of
+the owning functions — plus drift against the committed PROTOCOLS.json
+(cross-environment captures refused, like the contract layers).  The
+base-rung trace is built ONCE and shared between layers 2 and 3 (the
+whole run stays well under 90 s).  Findings are suppressed per (rule,
+path, symbol) through LINT_BASELINE.json; every suppression carries a
 justification, and a STALE entry (its finding no longer exists) is
 itself a failure unless --allow-stale.  Exit 0 = no non-baselined
 findings and no stale entries; 1 = findings; 2 = environment/usage
@@ -65,19 +81,27 @@ sys.path.insert(0, ROOT)
 
 def _layer_entries(baseline_entries, layer):
     """Route baseline suppressions to their layer by rule family, so a
-    CONTRACT/COST entry never shows up as stale to the AST layer (and
-    vice versa)."""
+    CONTRACT/COST/PROTO entry never shows up as stale to the AST layer
+    (and vice versa)."""
     prefix = {"astlint": "PUMI", "contracts": "CONTRACT",
-              "costmodel": "COST"}[layer]
+              "costmodel": "COST", "protolint": "PROTO"}[layer]
+    # "PROTO" would also swallow nothing from the other layers, but
+    # "PUMI" must not claim PROTO entries (distinct leading letters
+    # keep the prefixes disjoint already).
     return [e for e in baseline_entries
             if e["rule"].startswith(prefix)]
 
 
-def run_ast(args, baseline_entries, verbose):
+def run_ast(args, baseline_entries, verbose, index=None):
     from pumiumtally_tpu.analysis import apply_baseline
-    from pumiumtally_tpu.analysis.astlint import lint_package
+    from pumiumtally_tpu.analysis.astlint import (
+        lint_index,
+        lint_package,
+    )
 
-    findings = lint_package(ROOT)
+    findings = (
+        lint_index(index) if index is not None else lint_package(ROOT)
+    )
     kept, suppressed, unused = apply_baseline(
         findings, _layer_entries(baseline_entries, "astlint")
     )
@@ -161,6 +185,57 @@ def run_costmodel(args, baseline_entries, verbose, traced=None):
                   args.allow_stale)
 
 
+def run_protocols(args, baseline_entries, verbose, index=None):
+    from pumiumtally_tpu.analysis import apply_baseline
+    from pumiumtally_tpu.analysis import protolint as P
+
+    entries = _layer_entries(baseline_entries, "protolint")
+    proto_path = os.path.join(ROOT, args.protocols)
+    if index is None:
+        index = P.build_index(ROOT)
+    findings = P.check(index)
+    cap = P.capture(index)
+    if args.write_protocols:
+        P.write_protocols(proto_path, cap)
+        print(
+            f"wrote {args.protocols} for "
+            f"{len(cap['protocols'])} protocols under "
+            f"{cap['environment']}"
+        )
+    elif os.path.exists(proto_path):
+        findings += P.diff_baseline(cap, P.load_protocols(proto_path))
+    else:
+        findings.append(
+            P._finding(
+                "baseline.missing.all",
+                f"{args.protocols} not found — generate it with "
+                "scripts/lint.py --write-protocols",
+            )
+        )
+    kept, suppressed, unused = apply_baseline(findings, entries)
+    return report("protolint", kept, suppressed, unused, verbose,
+                  args.allow_stale)
+
+
+def run_explain(topic: str) -> int:
+    from pumiumtally_tpu.analysis import astlint, protolint
+
+    text = astlint.explain(topic)
+    if text is None:
+        text = protolint.explain(topic)
+    if text is None:
+        print(
+            f"--explain: unknown rule or protocol {topic!r} (rules: "
+            f"{', '.join(sorted(astlint.RULES_BY_ID))}; 'protocol' "
+            "for the layer-4 overview, or a protocol name from "
+            "PROTOCOLS.json)",
+            file=sys.stderr,
+        )
+        return 2
+    print(text)
+    return 0
+
+
 def run_ruff():
     ruff = shutil.which("ruff")
     if ruff is None:
@@ -206,41 +281,68 @@ def main() -> int:
     ap.add_argument("--contracts-only", action="store_true")
     ap.add_argument("--perf-only", action="store_true",
                     help="run only the cost-model layer")
+    ap.add_argument("--protocols-only", action="store_true",
+                    help="run only the layer-4 protocol lint "
+                         "(durability & concurrency protocols of the "
+                         "crash-safety surface)")
     ap.add_argument("--no-perf", action="store_true",
                     help="skip the cost-model layer (CI runs it as its "
                          "own perf-contracts step; avoids compiling "
                          "the ladder twice)")
+    ap.add_argument("--no-protocols", action="store_true",
+                    help="skip the protocol layer (CI runs it as its "
+                         "own protocol-lint step)")
     ap.add_argument("--ruff-only", action="store_true")
     ap.add_argument("--write-contracts", action="store_true")
     ap.add_argument("--write-perf-contracts", action="store_true")
+    ap.add_argument("--write-protocols", action="store_true",
+                    help="regenerate PROTOCOLS.json from the current "
+                         "tree (intentional protocol drift only)")
+    ap.add_argument("--explain", metavar="RULE|PROTOCOL",
+                    help="print one rule's (or protocol's) rationale, "
+                         "an example finding, and the fix pattern, "
+                         "then exit")
     ap.add_argument("--allow-stale", action="store_true",
                     help="stale baseline entries warn instead of "
                          "failing (mid-refactor escape hatch)")
     ap.add_argument("--baseline", default="LINT_BASELINE.json")
     ap.add_argument("--contracts", default="CONTRACTS.json")
     ap.add_argument("--perf-contracts", default="PERF_CONTRACTS.json")
+    ap.add_argument("--protocols", default="PROTOCOLS.json")
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args()
 
+    if args.explain:
+        return run_explain(args.explain)
+
     only = [args.ast_only, args.contracts_only, args.perf_only,
-            args.ruff_only]
+            args.protocols_only, args.ruff_only]
     if sum(only) > 1:
-        ap.error("--ast-only/--contracts-only/--perf-only/--ruff-only "
-                 "are exclusive")
+        ap.error("--ast-only/--contracts-only/--perf-only/"
+                 "--protocols-only/--ruff-only are exclusive")
     if args.no_perf and args.perf_only:
         ap.error("--no-perf contradicts --perf-only")
+    if args.no_protocols and args.protocols_only:
+        ap.error("--no-protocols contradicts --protocols-only")
     do_ast = not any(
-        (args.contracts_only, args.perf_only, args.ruff_only)
+        (args.contracts_only, args.perf_only, args.protocols_only,
+         args.ruff_only)
     )
     do_contracts = not any(
-        (args.ast_only, args.perf_only, args.ruff_only)
+        (args.ast_only, args.perf_only, args.protocols_only,
+         args.ruff_only)
     )
     do_perf = not any(
-        (args.ast_only, args.contracts_only, args.ruff_only,
-         args.no_perf)
+        (args.ast_only, args.contracts_only, args.protocols_only,
+         args.ruff_only, args.no_perf)
+    )
+    do_protocols = not any(
+        (args.ast_only, args.contracts_only, args.perf_only,
+         args.ruff_only, args.no_protocols)
     )
     do_ruff = not any(
-        (args.ast_only, args.contracts_only, args.perf_only)
+        (args.ast_only, args.contracts_only, args.perf_only,
+         args.protocols_only)
     )
     # A write flag aimed at a disabled layer would exit 0 with the
     # baseline silently NOT regenerated — refuse the combination.
@@ -250,6 +352,9 @@ def main() -> int:
     if args.write_perf_contracts and not do_perf:
         ap.error("--write-perf-contracts needs the cost-model layer; "
                  "drop --no-perf / the --*-only flag that disables it")
+    if args.write_protocols and not do_protocols:
+        ap.error("--write-protocols needs the protocol layer; drop "
+                 "--no-protocols / the --*-only flag that disables it")
 
     baseline_path = os.path.join(ROOT, args.baseline)
     if os.path.exists(baseline_path):
@@ -262,11 +367,12 @@ def main() -> int:
     # like "UMI001") would suppress nothing AND dodge the stale-entry
     # failure, leaving a permanently dead hole in the baseline.
     for e in entries:
-        if not e["rule"].startswith(("PUMI", "CONTRACT", "COST")):
+        if not e["rule"].startswith(("PUMI", "CONTRACT", "COST",
+                                     "PROTO")):
             raise ValueError(
                 f"baseline entry rule {e['rule']!r} matches no lint "
-                "layer (PUMI* / CONTRACT* / COST*) — fix the rule "
-                "name or remove the entry"
+                "layer (PUMI* / CONTRACT* / COST* / PROTO*) — fix "
+                "the rule name or remove the entry"
             )
 
     # The contracts and cost layers analyze the SAME base-rung programs
@@ -277,14 +383,23 @@ def main() -> int:
         from pumiumtally_tpu.analysis import contracts as C
 
         traced = C.build_traced()
+    # Same sharing for the AST side: layers 1 and 4 walk the same
+    # parsed tree + call-graph fixpoint — build the index once.
+    index = None
+    if do_ast and do_protocols:
+        from pumiumtally_tpu.analysis import protolint as P
+
+        index = P.build_index(ROOT)
 
     rc = 0
     if do_ast:
-        rc |= run_ast(args, entries, args.verbose)
+        rc |= run_ast(args, entries, args.verbose, index=index)
     if do_contracts:
         rc |= run_contracts(args, entries, args.verbose, traced=traced)
     if do_perf:
         rc |= run_costmodel(args, entries, args.verbose, traced=traced)
+    if do_protocols:
+        rc |= run_protocols(args, entries, args.verbose, index=index)
     if do_ruff:
         rc |= run_ruff()
     return rc

@@ -161,8 +161,12 @@ def main() -> int:
     text = json.dumps(out, indent=1, sort_keys=True)
     print(text)
     if args.out:
-        with open(args.out, "w") as fh:
-            fh.write(text + "\n")
+        # Atomic write (PUMI008): the results file lands beside the
+        # journal a restart resumes from — a torn JSON under the real
+        # name would read as a corrupt run instead of a missing one.
+        from pumiumtally_tpu.utils.checkpoint import atomic_write_json
+
+        atomic_write_json(args.out, out)
     outcomes: dict = {}
     for row in out["per_job"]:
         outcomes[row["outcome"]] = outcomes.get(row["outcome"], 0) + 1

@@ -1,0 +1,783 @@
+"""Graft-check layer 4 tests: durability & concurrency protocol lint.
+
+Two halves, mirroring tests/test_static_analysis.py's contract:
+
+  * the four layer-4 AST rules (PUMI008 raw durable writes, PUMI009
+    signal-handler safety, PUMI010 unguarded thread-shared state,
+    PUMI011 swallowed retryables) each fire on a positive fixture and
+    stay quiet on the sanctioned idiom beside it;
+  * the effect-ordering protocol analyzer (analysis/protolint.py) is
+    exercised against the REAL tree with injected regressions — the
+    journal-commit/checkpoint-delete reorder, the stale-handler
+    clobber, an early manifest commit — and each produces its NAMED
+    finding; plus baseline routing, cross-env refusal, --explain, and
+    the repo-stays-clean pins.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from pumiumtally_tpu.analysis import apply_baseline, load_baseline
+from pumiumtally_tpu.analysis import protolint as P
+from pumiumtally_tpu.analysis.astlint import (
+    explain,
+    lint_package,
+    lint_sources,
+)
+
+ROOT = Path(__file__).resolve().parents[1]
+
+
+def at(findings, rule):
+    return [f for f in findings if f.rule == rule]
+
+
+# --------------------------------------------------------------------- #
+# PUMI008: raw durable writes
+# --------------------------------------------------------------------- #
+def test_raw_write_fires_outside_approved_modules():
+    src = """
+import json
+
+def persist(path, state):
+    with open(path, "w") as fh:
+        json.dump(state, fh)
+"""
+    fs = lint_sources({"pumiumtally_tpu/serving/fake.py": src})
+    found = at(fs, "PUMI008")
+    # ONE finding — the open; the json.dump through the open handle is
+    # the same write, not a second one.
+    assert len(found) == 1, [f.render() for f in found]
+    assert found[0].symbol == "persist"
+    assert 'open(..., "w")' in found[0].message
+
+
+def test_raw_write_quiet_in_approved_module():
+    src = """
+import json
+
+def flush(path, doc):
+    with open(path, "w") as fh:
+        json.dump(doc, fh)
+"""
+    fs = lint_sources({"pumiumtally_tpu/serving/journal.py": src})
+    assert at(fs, "PUMI008") == []
+
+
+def test_np_save_to_bytesio_is_in_memory_and_clean():
+    src = """
+import io
+import numpy as np
+
+def pack(arr):
+    buf = io.BytesIO()
+    np.save(buf, arr)
+    return buf.getvalue()
+"""
+    fs = lint_sources({"pumiumtally_tpu/serving/fake.py": src})
+    assert at(fs, "PUMI008") == []
+
+
+def test_np_save_to_path_and_write_text_fire():
+    src = """
+import numpy as np
+
+def persist(path, arr, meta):
+    np.save(path, arr)
+    path.write_text(meta)
+"""
+    fs = lint_sources({"pumiumtally_tpu/obs/fake.py": src})
+    assert len(at(fs, "PUMI008")) == 2
+
+
+def test_inline_open_oneliner_reports_once():
+    """``json.dump(obj, open(p, "w"))`` is ONE write, not two — the
+    inline open carries the finding and the dump is suppressed."""
+    src = """
+import json
+
+def persist(path, state):
+    json.dump(state, open(path, "w"))
+"""
+    fs = lint_sources({"pumiumtally_tpu/serving/fake.py": src})
+    found = at(fs, "PUMI008")
+    assert len(found) == 1, [f.render() for f in found]
+    assert 'open(..., "w")' in found[0].message
+
+
+def test_class_body_raw_write_fires():
+    """Import-time writes in class bodies are scanned too — they are
+    not covered by index.defs and would otherwise be a blind spot."""
+    src = """
+import json
+
+class Config:
+    _default = json.dump({"x": 1}, open("cfg.json", "w"))
+"""
+    fs = lint_sources({"pumiumtally_tpu/serving/fake.py": src})
+    assert len(at(fs, "PUMI008")) == 1, [f.render() for f in fs]
+
+
+def test_read_mode_open_is_clean():
+    src = """
+import json
+
+def load(path):
+    with open(path) as fh:
+        return json.load(fh)
+"""
+    fs = lint_sources({"pumiumtally_tpu/serving/fake.py": src})
+    assert at(fs, "PUMI008") == []
+
+
+def test_journal_scripts_get_durability_rule_other_scripts_dont():
+    src = """
+import json
+
+def dump(path, state):
+    with open(path, "w") as fh:
+        json.dump(state, fh)
+"""
+    fs = lint_sources({"scripts/serve.py": src})
+    assert len(at(fs, "PUMI008")) == 1
+    fs = lint_sources({"scripts/chaos_serve.py": src})
+    assert len(at(fs, "PUMI008")) == 1
+    # other scripts keep the value-safety subset only
+    fs = lint_sources({"scripts/teleview.py": src})
+    assert at(fs, "PUMI008") == []
+
+
+# --------------------------------------------------------------------- #
+# PUMI009: signal-handler safety
+# --------------------------------------------------------------------- #
+_HANDLER_TMPL = """
+from ..utils.signals import (
+    install_preemption_handlers,
+    uninstall_preemption_handlers,
+    resume_previous_handler,
+)
+
+class Supervisor:
+    def __init__(self):
+        self._in_step = False
+        self._pending_signal = None
+        self._prev = install_preemption_handlers(self._on_signal, "S")
+
+    def _flush_journal(self):
+        pass
+
+    def _on_signal(self, signum, frame):
+{guard}        self._flush(signum, frame)
+
+    def _flush(self, signum, frame):
+        self._flush_journal()
+        uninstall_preemption_handlers(self._prev, mine=self._on_signal)
+        resume_previous_handler(self._prev.get(signum), signum, frame)
+
+    def close(self):
+        uninstall_preemption_handlers(self._prev, mine=self._on_signal)
+"""
+
+_GUARD = (
+    "        if self._in_step:\n"
+    "            self._pending_signal = signum\n"
+    "            return\n"
+)
+
+
+def _signals_stub():
+    return {
+        "pumiumtally_tpu/utils/signals.py": (
+            (ROOT / "pumiumtally_tpu/utils/signals.py").read_text()
+        ),
+        "pumiumtally_tpu/utils/log.py": (
+            (ROOT / "pumiumtally_tpu/utils/log.py").read_text()
+        ),
+    }
+
+
+def test_handler_journal_flush_without_deferral_guard_fires():
+    src = _HANDLER_TMPL.format(guard="")
+    fs = lint_sources(
+        {**_signals_stub(), "pumiumtally_tpu/serving/fake.py": src}
+    )
+    found = at(fs, "PUMI009")
+    assert found, [f.render() for f in fs]
+    assert any("deferral guard" in f.message for f in found)
+
+
+def test_handler_journal_flush_with_deferral_guard_is_clean():
+    src = _HANDLER_TMPL.format(guard=_GUARD)
+    fs = lint_sources(
+        {**_signals_stub(), "pumiumtally_tpu/serving/fake.py": src}
+    )
+    assert at(fs, "PUMI009") == [], [f.render() for f in fs]
+
+
+def test_handler_taking_annotated_lock_fires():
+    src = """
+import threading
+
+from ..utils.signals import (
+    install_preemption_handlers,
+    uninstall_preemption_handlers,
+)
+
+class Supervisor:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._state = 0  # guarded by: self._lock
+        self._prev = install_preemption_handlers(self._on_signal, "S")
+
+    def _on_signal(self, signum, frame):
+        with self._lock:
+            self._state += 1
+
+    def close(self):
+        uninstall_preemption_handlers(self._prev, mine=self._on_signal)
+"""
+    fs = lint_sources(
+        {**_signals_stub(), "pumiumtally_tpu/obs/fake.py": src}
+    )
+    found = at(fs, "PUMI009")
+    assert any("deadlock" in f.message for f in found), [
+        f.render() for f in fs
+    ]
+
+
+def test_install_without_any_uninstall_fires():
+    src = """
+from ..utils.signals import install_preemption_handlers
+
+class Supervisor:
+    def __init__(self):
+        self._prev = install_preemption_handlers(self._on_signal, "S")
+
+    def _on_signal(self, signum, frame):
+        pass
+"""
+    fs = lint_sources(
+        {**_signals_stub(), "pumiumtally_tpu/obs/fake.py": src}
+    )
+    found = at(fs, "PUMI009")
+    assert any("matching uninstall" in f.message for f in found)
+
+
+def test_resume_without_uninstall_fires():
+    src = """
+from ..utils.signals import (
+    install_preemption_handlers,
+    uninstall_preemption_handlers,
+    resume_previous_handler,
+)
+
+class Supervisor:
+    def __init__(self):
+        self._prev = install_preemption_handlers(self._on_signal, "S")
+
+    def _on_signal(self, signum, frame):
+        resume_previous_handler(self._prev.get(signum), signum, frame)
+
+    def close(self):
+        uninstall_preemption_handlers(self._prev, mine=self._on_signal)
+"""
+    fs = lint_sources(
+        {**_signals_stub(), "pumiumtally_tpu/obs/fake.py": src}
+    )
+    found = at(fs, "PUMI009")
+    assert any("stale handler" in f.message for f in found)
+
+
+def test_real_scheduler_without_deferral_guard_fires():
+    """Injected regression on the REAL tree: strip the scheduler
+    handler's mid-quantum deferral — its journal flush must become a
+    named PUMI009 finding."""
+    sched = "pumiumtally_tpu/serving/scheduler.py"
+    srcs = {
+        p: (ROOT / p).read_text()
+        for p in (sched, "pumiumtally_tpu/utils/signals.py",
+                  "pumiumtally_tpu/utils/log.py")
+    }
+    guard = (
+        "        if self._in_step:\n"
+        "            # Mid-quantum: defer to the quantum boundary so the\n"
+        "            # flushed checkpoints are consistent post-dispatch states.\n"
+        "            self._pending_signal = signum\n"
+        "            return\n"
+    )
+    assert guard in srcs[sched]
+    bad = srcs[sched].replace(guard, "")
+    fs = lint_sources({**srcs, sched: bad})
+    found = [
+        f for f in at(fs, "PUMI009") if "deferral" in f.message
+    ]
+    assert found, [f.render() for f in at(fs, "PUMI009")]
+
+
+# --------------------------------------------------------------------- #
+# PUMI010: unguarded thread-shared state
+# --------------------------------------------------------------------- #
+def test_unannotated_attr_written_from_thread_target_fires():
+    src = """
+import threading
+
+class Watcher:
+    def __init__(self):
+        self._beat = 0
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        self._beat += 1
+"""
+    fs = lint_sources({"pumiumtally_tpu/obs/fake.py": src})
+    found = at(fs, "PUMI010")
+    assert len(found) == 1 and "_beat" in found[0].message
+
+
+def test_annotated_attr_written_from_thread_target_is_clean():
+    src = """
+import threading
+
+class Watcher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._beat = 0  # guarded by: self._lock
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        with self._lock:
+            self._beat += 1
+"""
+    fs = lint_sources({"pumiumtally_tpu/obs/fake.py": src})
+    assert at(fs, "PUMI010") == []
+
+
+def test_worker_closure_writing_shared_local_fires_unless_annotated():
+    bad = """
+import threading
+
+def run(fn):
+    outcome = {}
+
+    def target():
+        outcome["value"] = fn()
+
+    threading.Thread(target=target).start()
+    return outcome
+"""
+    fs = lint_sources({"pumiumtally_tpu/obs/fake.py": bad})
+    found = at(fs, "PUMI010")
+    assert len(found) == 1 and "outcome" in found[0].message
+
+    good = bad.replace(
+        "    outcome = {}",
+        "    finished = threading.Event()\n"
+        "    outcome = {}  # guarded by: finished (event)",
+    ).replace(
+        'outcome["value"] = fn()',
+        'outcome["value"] = fn()\n        finished.set()',
+    ).replace(
+        "    return outcome",
+        "    finished.wait(1.0)\n    return outcome",
+    )
+    fs = lint_sources({"pumiumtally_tpu/obs/fake.py": good})
+    assert at(fs, "PUMI010") == [], [f.render() for f in fs]
+
+
+def test_worker_shadowing_local_is_thread_confined_and_clean():
+    """A plain-name rebind in the worker creates a WORKER-LOCAL (no
+    nonlocal declared) — merely shadowing an enclosing-scope name
+    shares nothing and must not be flagged."""
+    src = """
+import threading
+
+def run(fn):
+    buf = None
+
+    def target():
+        buf = []
+        buf.append(fn())
+
+    threading.Thread(target=target).start()
+    return buf
+"""
+    fs = lint_sources({"pumiumtally_tpu/obs/fake.py": src})
+    assert at(fs, "PUMI010") == [], [f.render() for f in fs]
+
+
+def test_worker_nonlocal_rebind_fires():
+    src = """
+import threading
+
+def run(fn):
+    result = None
+
+    def target():
+        nonlocal result
+        result = fn()
+
+    threading.Thread(target=target).start()
+    return result
+"""
+    fs = lint_sources({"pumiumtally_tpu/obs/fake.py": src})
+    found = at(fs, "PUMI010")
+    assert len(found) == 1 and "result" in found[0].message
+
+
+def test_executor_worker_writing_attr_fires():
+    src = """
+from concurrent.futures import ThreadPoolExecutor
+
+class Sharder:
+    def write_all(self, n):
+        with ThreadPoolExecutor(max_workers=4) as ex:
+            list(ex.map(self._write_one, range(n)))
+
+    def _write_one(self, i):
+        self._last_written = i
+"""
+    fs = lint_sources({"pumiumtally_tpu/obs/fake.py": src})
+    found = at(fs, "PUMI010")
+    assert len(found) == 1 and "_last_written" in found[0].message
+
+
+# --------------------------------------------------------------------- #
+# PUMI011: swallowed retryables
+# --------------------------------------------------------------------- #
+def test_swallowed_retryable_fires():
+    src = """
+from ..resilience.faultinject import InjectedTransientFault
+
+def run(body):
+    try:
+        return body()
+    except InjectedTransientFault:
+        return None
+"""
+    fs = lint_sources({"pumiumtally_tpu/serving/fake.py": src})
+    found = at(fs, "PUMI011")
+    assert len(found) == 1
+    assert "InjectedTransientFault" in found[0].message
+
+
+@pytest.mark.parametrize(
+    "handler",
+    [
+        "        raise",
+        "        verdict = coordinator.classify(e)\n        return verdict",
+        "        counter.inc(cause='transient')\n        return None",
+    ],
+    ids=["reraise", "classify", "metric"],
+)
+def test_retryable_with_sanctioned_route_is_clean(handler):
+    src = f"""
+from ..resilience.faultinject import InjectedTransientFault
+
+def run(body, coordinator, counter):
+    try:
+        return body()
+    except InjectedTransientFault as e:
+{handler}
+"""
+    fs = lint_sources({"pumiumtally_tpu/serving/fake.py": src})
+    assert at(fs, "PUMI011") == [], [f.render() for f in fs]
+
+
+def test_nonretryable_except_is_not_flagged():
+    src = """
+def run(body):
+    try:
+        return body()
+    except (OSError, ValueError):
+        return None
+"""
+    fs = lint_sources({"pumiumtally_tpu/serving/fake.py": src})
+    assert at(fs, "PUMI011") == []
+
+
+# --------------------------------------------------------------------- #
+# Protocol analyzer: injected regressions on the real tree
+# --------------------------------------------------------------------- #
+SCHED = "pumiumtally_tpu/serving/scheduler.py"
+CKPT = "pumiumtally_tpu/utils/checkpoint.py"
+
+
+#: The protocol owners — indexing just the crash-safety modules keeps
+#: each injected-regression check fast while still exercising the REAL
+#: sources (every declared protocol lives in one of these files).
+_CRASH_SAFETY_MODULES = (
+    "pumiumtally_tpu/serving/scheduler.py",
+    "pumiumtally_tpu/serving/journal.py",
+    "pumiumtally_tpu/resilience/runner.py",
+    "pumiumtally_tpu/resilience/store.py",
+    "pumiumtally_tpu/utils/checkpoint.py",
+    "pumiumtally_tpu/utils/signals.py",
+    "pumiumtally_tpu/utils/log.py",
+)
+
+
+@pytest.fixture(scope="module")
+def real_sources():
+    return {p: (ROOT / p).read_text() for p in _CRASH_SAFETY_MODULES}
+
+
+def test_protocols_hold_on_the_real_tree(real_sources):
+    assert P.check_sources(real_sources) == []
+
+
+def test_reordered_finish_is_a_named_protocol_finding(real_sources):
+    """THE acceptance regression: swap _finish's terminal journal
+    flush and checkpoint delete — the exact ordering bug PR 14's
+    review caught by hand must now be a named, machine-checked
+    finding."""
+    good = (
+        "        self._flush_journal()\n"
+        "        self._remove_checkpoint(job)\n"
+    )
+    src = real_sources[SCHED]
+    assert good in src
+    bad = src.replace(
+        good,
+        "        self._remove_checkpoint(job)\n"
+        "        self._flush_journal()\n",
+    )
+    fs = P.check_sources({**real_sources, SCHED: bad})
+    assert "order.terminal-record-before-checkpoint-delete" in {
+        f.symbol for f in fs
+    }, [f.render() for f in fs]
+
+
+def test_stale_handler_clobber_is_a_named_protocol_finding(real_sources):
+    src = real_sources[SCHED]
+    pair = (
+        "        self._uninstall_signal_handlers()\n"
+        "        resume_previous_handler(prev, signum, frame)"
+    )
+    assert pair in src
+    bad = src.replace(
+        pair, "        resume_previous_handler(prev, signum, frame)"
+    )
+    fs = P.check_sources({**real_sources, SCHED: bad})
+    syms = {f.symbol for f in fs}
+    assert "order.scheduler-uninstall-before-resume" in syms or (
+        "require.scheduler-uninstall-before-resume" in syms
+    ), [f.render() for f in fs]
+
+
+def test_early_manifest_commit_is_a_named_protocol_finding(real_sources):
+    src = real_sources[CKPT]
+    anchor = "    from concurrent.futures import ThreadPoolExecutor"
+    assert anchor in src
+    bad = src.replace(
+        anchor,
+        "    atomic_write_bytes(\n"
+        "        manifest_path, json.dumps({}).encode()\n"
+        "    )\n" + anchor,
+    )
+    fs = P.check_sources({**real_sources, CKPT: bad})
+    assert "order.manifest-commit-last" in {f.symbol for f in fs}, [
+        f.render() for f in fs
+    ]
+
+
+def test_raw_journal_flush_is_a_named_protocol_finding(real_sources):
+    """Replace the journal document's atomic write with a raw one —
+    both the forbid (raw.write) and require (atomic.write) halves of
+    journal-document-atomic must fire."""
+    jr = "pumiumtally_tpu/serving/journal.py"
+    src = real_sources[jr]
+    atomic = "        atomic_write_json(self.path, doc)"
+    assert atomic in src
+    bad = src.replace(
+        atomic,
+        "        with open(self.path, \"w\") as fh:\n"
+        "            json.dump(doc, fh)",
+    )
+    fs = P.check_sources({**real_sources, jr: bad})
+    syms = {f.symbol for f in fs}
+    assert "forbid.journal-document-atomic" in syms, [
+        f.render() for f in fs
+    ]
+    assert "require.journal-document-atomic" in syms
+
+
+def test_path_explosion_is_flagged_not_silently_truncated(real_sources):
+    """A protocol owner whose CFG outgrows MAX_PATHS must produce a
+    named paths.* finding — the constraints were only checked on a
+    prefix, and 'partially verified' must never read as clean."""
+    branches = "".join(
+        "        if job:\n"
+        "            fsync_dir(self.dir)\n"
+        "        else:\n"
+        "            atomic_savez(self.dir)\n"
+        for _ in range(10)  # 2**10 distinct effect paths > MAX_PATHS
+    )
+    src = (
+        "import os\n\n"
+        "class TallyScheduler:\n"
+        "    def _finish(self, job, outcome):\n"
+        + branches
+        + "        self._flush_journal()\n"
+        "        self._remove_checkpoint(job)\n"
+    )
+    fs = P.check_sources(
+        {"pumiumtally_tpu/serving/scheduler.py": src}
+    )
+    assert "paths.terminal-record-before-checkpoint-delete" in {
+        f.symbol for f in fs
+    }, [f.render() for f in fs]
+
+
+def test_missing_owner_function_is_reported(real_sources):
+    bad = real_sources[SCHED].replace(
+        "    def _poison(", "    def _poison_renamed("
+    )
+    fs = P.check_sources({**real_sources, SCHED: bad})
+    assert "missing.poison-record-before-checkpoint-delete" in {
+        f.symbol for f in fs
+    }
+
+
+# --------------------------------------------------------------------- #
+# PROTOCOLS.json: capture, drift, cross-env refusal
+# --------------------------------------------------------------------- #
+def test_diff_baseline_names_drift_and_refuses_cross_env(real_sources):
+    index = P.index_from_sources(real_sources)
+    cap = P.capture(index)
+    base = json.loads(json.dumps(cap))
+    assert P.diff_baseline(cap, base) == []
+
+    tampered = json.loads(json.dumps(base))
+    name = "terminal-record-before-checkpoint-delete"
+    tampered["protocols"][name]["effects"]["checkpoint.delete"] = 7
+    syms = {f.symbol for f in P.diff_baseline(cap, tampered)}
+    assert f"drift.{name}" in syms
+
+    other_env = json.loads(json.dumps(base))
+    other_env["environment"]["n_devices"] = 1234
+    syms = {f.symbol for f in P.diff_baseline(cap, other_env)}
+    assert syms == {"environment.all"}
+
+    removed = json.loads(json.dumps(base))
+    del removed["protocols"][name]
+    syms = {f.symbol for f in P.diff_baseline(cap, removed)}
+    assert f"protocol.added.{name}" in syms
+
+
+def test_committed_protocols_json_matches_declarations():
+    """The committed capture must cover exactly the declared protocol
+    set (the env-sensitive diff itself runs in the canonical
+    subprocess below)."""
+    committed = json.loads((ROOT / "PROTOCOLS.json").read_text())
+    assert committed["schema"] == P.PROTOCOLS_SCHEMA
+    assert set(committed["protocols"]) == {p.name for p in P.PROTOCOLS}
+    for name, rec in committed["protocols"].items():
+        assert rec["effects"], f"{name} captured no effects"
+
+
+# --------------------------------------------------------------------- #
+# Runner integration: baseline routing, --explain, repo stays clean
+# --------------------------------------------------------------------- #
+def _run_lint(*flags, timeout=300):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # the runner pins its own
+    return subprocess.run(
+        [sys.executable, str(ROOT / "scripts" / "lint.py"), *flags],
+        capture_output=True, text=True, env=env, cwd=str(ROOT),
+        timeout=timeout,
+    )
+
+
+@pytest.mark.slow  # subprocess spawn; CI's dedicated protocol-lint /
+# static-analysis steps enforce the same gate on every run
+def test_protocols_only_runner_exits_clean():
+    """scripts/lint.py --protocols-only (fresh process, canonical
+    environment) must exit 0 against the committed PROTOCOLS.json."""
+    proc = _run_lint("--protocols-only")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "protolint: clean" in proc.stdout
+
+
+@pytest.mark.slow  # subprocess spawn; CI's dedicated protocol-lint /
+# static-analysis steps enforce the same gate on every run
+def test_stale_proto_baseline_entry_hard_fails(tmp_path):
+    committed = json.loads(
+        (ROOT / "LINT_BASELINE.json").read_text()
+    )["suppressions"]
+    stale = {"rule": "PROTO", "path": "PROTOCOLS.json",
+             "symbol": "order.long-gone-protocol",
+             "justification": "retired two PRs ago"}
+    p = tmp_path / "baseline.json"
+    p.write_text(json.dumps({"suppressions": committed + [stale]}))
+    proc = _run_lint("--protocols-only", "--baseline", str(p))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "stale baseline entry" in proc.stdout
+    assert "long-gone-protocol" in proc.stdout
+
+
+def test_proto_baseline_entry_routes_to_protocol_layer():
+    from pumiumtally_tpu.analysis import Finding
+
+    f = Finding("PROTO", "PROTOCOLS.json", 0,
+                "order.terminal-record-before-checkpoint-delete", "m")
+    entries = [{"rule": "PROTO", "path": "PROTOCOLS.json",
+                "symbol": "order.terminal-record-before-checkpoint-delete",
+                "justification": "test"}]
+    kept, suppressed, unused = apply_baseline([f], entries)
+    assert kept == [] and len(suppressed) == 1 and unused == []
+
+
+@pytest.mark.slow  # subprocess spawn; CI's dedicated protocol-lint /
+# static-analysis steps enforce the same gate on every run
+def test_explain_rule_and_protocol():
+    proc = _run_lint("--explain", "PUMI008")
+    assert proc.returncode == 0, proc.stderr
+    for token in ("Rationale", "Example finding", "Fix pattern"):
+        assert token in proc.stdout
+    proc = _run_lint(
+        "--explain", "terminal-record-before-checkpoint-delete"
+    )
+    assert proc.returncode == 0
+    assert "Rationale" in proc.stdout and "Constraints" in proc.stdout
+    proc = _run_lint("--explain", "protocol")
+    assert proc.returncode == 0
+    assert "manifest-commit-last" in proc.stdout
+    proc = _run_lint("--explain", "NOPE999")
+    assert proc.returncode == 2
+    assert "unknown rule" in proc.stderr
+
+
+def test_write_protocols_for_disabled_layer_is_rejected():
+    proc = _run_lint("--ast-only", "--write-protocols")
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert "needs the" in proc.stderr
+
+
+@pytest.mark.slow  # subprocess spawn; CI's dedicated protocol-lint /
+# static-analysis steps enforce the same gate on every run
+def test_repo_layer4_rules_clean_modulo_baseline():
+    findings = lint_package(ROOT)
+    entries = load_baseline(ROOT / "LINT_BASELINE.json")
+    kept, _, _ = apply_baseline(findings, entries)
+    layer4 = [
+        f for f in kept
+        if f.rule in ("PUMI008", "PUMI009", "PUMI010", "PUMI011")
+    ]
+    assert layer4 == [], "\n".join(f.render() for f in layer4)
+
+
+def test_explain_covers_every_rule():
+    for rule in (
+        "PUMI001", "PUMI002", "PUMI003", "PUMI004", "PUMI005",
+        "PUMI006", "PUMI007", "PUMI008", "PUMI009", "PUMI010",
+        "PUMI011",
+    ):
+        text = explain(rule)
+        assert text and rule in text
+    assert explain("PUMI999") is None
