@@ -26,7 +26,9 @@ detail.windows). BENCH_FAULTS=<PUMI_TPU_FAULTS spec> additionally runs
 a small supervised fault-mode probe and records the MTTR axes
 (detail.recovery_seconds / detail.lost_moves, tagged with
 detail.fault_spec — the BENCHMARKS.md recovery-overhead trajectory).
-Prints exactly ONE JSON line on stdout.
+BENCH_TRACE_SPANS=1 prices the serving span tracer's per-emission
+cost, enabled vs PUMI_TPU_TRACE=off (detail.trace_overhead — the
+zero-cost-to-physics receipt). Prints exactly ONE JSON line on stdout.
 """
 from __future__ import annotations
 
@@ -448,6 +450,11 @@ def run(
             int(os.environ["BENCH_SERVE"]), seed=seed
         )
 
+    # ---- span-tracing overhead probe (BENCH_TRACE_SPANS=1) -------------
+    trace_spans = {}
+    if os.environ.get("BENCH_TRACE_SPANS"):
+        trace_spans = run_trace_overhead()
+
     per_chip_baseline = 1e9 / 64.0
     return {
         "metric": "particle_segments_per_sec_per_chip",
@@ -526,8 +533,46 @@ def run(
             **event,
             **fault,
             **serve,
+            **trace_spans,
         },
     }
+
+
+def run_trace_overhead() -> dict:
+    """Span-tracing overhead probe (``BENCH_TRACE_SPANS=1``): price one
+    span/event emission on the serving tracer (obs/trace.py) — the
+    enabled ring+sink-less path the scheduler pays per quantum, the
+    disabled (``PUMI_TPU_TRACE=off``) no-op path, and the black-box
+    chrome render over a full ring.  Host-side only (no device work):
+    the number that matters for the zero-cost-to-physics claim is
+    nanoseconds per span against a multi-millisecond quantum.
+    ``BENCH_TRACE_N`` (default 200000) sets the sample count."""
+    import time as _time
+
+    from pumiumtally_tpu.obs import SpanTracer
+
+    n = int(os.environ.get("BENCH_TRACE_N", "200000"))
+    out: dict = {"spans_n": n}
+    for label, enabled in (("on", True), ("off", False)):
+        tr = SpanTracer(capacity=1024, enabled=enabled)
+        tid = SpanTracer.new_trace()
+        with tr.bind(tid, "bench-job", SpanTracer.root_id(tid)):
+            t0 = _time.perf_counter()
+            for i in range(n):
+                tr.span_record(
+                    "quantum", 1e-3, k=4, move_start=i,
+                    device_seconds=1e-3,
+                )
+            dt = _time.perf_counter() - t0
+        out[f"span_ns_{label}"] = round(dt / n * 1e9, 1)
+        if enabled:
+            t0 = _time.perf_counter()
+            doc = tr.chrome()
+            out["chrome_render_ms_full_ring"] = round(
+                (_time.perf_counter() - t0) * 1e3, 3
+            )
+            out["ring_records"] = len(doc["traceEvents"]) - 1
+    return {"trace_overhead": out}
 
 
 def run_fault_recovery(spec: str, n_groups: int, seed: int) -> dict:

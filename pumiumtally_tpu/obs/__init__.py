@@ -25,7 +25,14 @@ from .convergence import (
     reduce_chip_conv,
 )
 from .exporter import MetricsExporter, maybe_start_exporter
-from .recorder import FlightRecorder
+from .recorder import FLIGHT_SCHEMA, FlightRecorder
+from .trace import (
+    NO_PARENT,
+    TRACE_SCHEMA,
+    SpanTracer,
+    chrome_trace,
+    trace_enabled,
+)
 from .registry import (
     Counter,
     Gauge,
@@ -49,6 +56,12 @@ __all__ = [
     "MetricsRegistry",
     "default_registry",
     "FlightRecorder",
+    "FLIGHT_SCHEMA",
+    "SpanTracer",
+    "NO_PARENT",
+    "TRACE_SCHEMA",
+    "chrome_trace",
+    "trace_enabled",
     "TallyTelemetry",
     "MetricsExporter",
     "maybe_start_exporter",

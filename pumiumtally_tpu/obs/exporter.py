@@ -1,4 +1,4 @@
-"""Live Prometheus scrape endpoint for a MetricsRegistry.
+"""Live HTTP endpoint for a MetricsRegistry (plus trace/job surfaces).
 
 A multi-hour soak should be watchable without touching the JSONL metrics
 stream: this serves ``MetricsRegistry.render_prometheus()`` over plain
@@ -7,7 +7,18 @@ with the process).  Endpoints:
 
   * ``/metrics`` (and ``/``) — the registry's Prometheus text
     exposition, content-type ``text/plain; version=0.0.4``;
-  * ``/healthz`` — ``ok`` (liveness for scrapers/orchestrators).
+  * ``/healthz`` — ``ok`` (liveness for scrapers/orchestrators);
+  * ``/buildz`` — one JSON object identifying the serving process:
+    package version, backend, x64 flag, device count (so a scrape
+    target can be attributed to a build without shell access);
+  * optional EXTRA endpoints registered by the owner — the serving
+    scheduler mounts ``/jobs`` (live job-table JSON: state, outcome,
+    moves, device-seconds, trace id per job) and ``/trace`` (the span
+    tracer's ring as chrome://tracing JSON, loadable in Perfetto and
+    consumed by ``scripts/teleview.py --job`` against a live server).
+
+Unknown paths answer 404 with a body NAMING the valid endpoints —
+a misremembered path should teach, not stonewall.
 
 Started by the facades (and, for wrapped tallies that did not start
 one, by ``ResilientRunner``) when ``PUMI_TPU_PROM_PORT`` is set; port 0
@@ -17,6 +28,7 @@ and the run continues — observability must never take a run down.
 """
 from __future__ import annotations
 
+import json
 import os
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -28,22 +40,99 @@ PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 ENV_PORT = "PUMI_TPU_PROM_PORT"
 
 
-class MetricsExporter:
-    """One HTTP server serving one registry's Prometheus text."""
+def build_info() -> dict:
+    """The /buildz payload: package version + pinned environment axes
+    (best-effort — a half-initialized process still answers)."""
+    info = {
+        "package": "pumiumtally_tpu",
+        "version": None,
+        "backend": None,
+        "x64": None,
+        "n_devices": None,
+        "pid": os.getpid(),
+    }
+    try:
+        from importlib.metadata import version
 
-    def __init__(self, registry, port: int, host: str = "127.0.0.1"):
+        info["version"] = version("pumiumtally_tpu")
+    except Exception:  # pragma: no cover - metadata is environmental
+        pass
+    try:
+        from ..analysis.contracts import environment
+
+        env = environment()
+        info["backend"] = env.get("backend")
+        info["x64"] = env.get("x64")
+        info["n_devices"] = env.get("n_devices")
+    except Exception as e:  # pragma: no cover - jax not importable
+        info["error"] = f"{type(e).__name__}: {e}"[:200]
+    return info
+
+
+class MetricsExporter:
+    """One HTTP server serving one registry's Prometheus text plus the
+    optional extra JSON endpoints the owner registers."""
+
+    def __init__(self, registry, port: int, host: str = "127.0.0.1",
+                 endpoints: dict | None = None):
         self.registry = registry
+        # path -> zero-arg callable returning a JSON-able object.
+        self.endpoints = dict(endpoints or {})
         exporter = self
 
         class _Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 — http.server API
-                if self.path in ("/", "/metrics"):
-                    body = exporter.registry.render_prometheus().encode()
-                    ctype = PROM_CONTENT_TYPE
-                elif self.path == "/healthz":
-                    body, ctype = b"ok\n", "text/plain"
-                else:
-                    self.send_error(404)
+                path = self.path.split("?", 1)[0]
+                try:
+                    if path in ("/", "/metrics"):
+                        body = (
+                            exporter.registry.render_prometheus().encode()
+                        )
+                        ctype = PROM_CONTENT_TYPE
+                    elif path == "/healthz":
+                        body, ctype = b"ok\n", "text/plain"
+                    elif path == "/buildz":
+                        body = (
+                            json.dumps(build_info(), sort_keys=True)
+                            + "\n"
+                        ).encode()
+                        ctype = "application/json"
+                    elif path in exporter.endpoints:
+                        body = (
+                            json.dumps(
+                                exporter.endpoints[path](), default=str
+                            ) + "\n"
+                        ).encode()
+                        ctype = "application/json"
+                    else:
+                        known = ", ".join(
+                            ["/metrics", "/healthz", "/buildz"]
+                            + sorted(exporter.endpoints)
+                        )
+                        body = (
+                            f"unknown path {path!r}; valid endpoints: "
+                            f"{known}\n"
+                        ).encode()
+                        self.send_response(404)
+                        self.send_header("Content-Type", "text/plain")
+                        self.send_header(
+                            "Content-Length", str(len(body))
+                        )
+                        self.end_headers()
+                        self.wfile.write(body)
+                        return
+                except Exception as e:
+                    # An endpoint callable must never kill the scrape
+                    # thread — report the failure as the response.
+                    body = (
+                        f"endpoint {path!r} failed: "
+                        f"{type(e).__name__}: {e}\n"
+                    ).encode()
+                    self.send_response(500)
+                    self.send_header("Content-Type", "text/plain")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
                     return
                 self.send_response(200)
                 self.send_header("Content-Type", ctype)
@@ -91,7 +180,7 @@ class MetricsExporter:
         self._thread.join(timeout=5)
 
 
-def maybe_start_exporter(registry, port=None):
+def maybe_start_exporter(registry, port=None, endpoints=None):
     """Start an exporter when configured, else None.
 
     ``port`` defaults to the ``PUMI_TPU_PROM_PORT`` env var (unset →
@@ -109,7 +198,7 @@ def maybe_start_exporter(registry, port=None):
             )
             return None
     try:
-        exp = MetricsExporter(registry, port)
+        exp = MetricsExporter(registry, port, endpoints=endpoints)
     except OSError as e:
         log_warn(
             f"metrics endpoint could not bind port {port} ({e}); "

@@ -15,11 +15,20 @@ import threading
 
 from ..utils.log import emit_metric
 
+#: Version stamp for SERVING-PATH flight records (scheduler / journal /
+#: bank): recorders constructed with ``schema=FLIGHT_SCHEMA`` stamp
+#: every record, so JSONL streams written by mixed-version processes
+#: (a killed server and its restarted successor) stay distinguishable.
+#: Readers (scripts/teleview.py) tolerate unknown fields.
+FLIGHT_SCHEMA = 1
+
 
 class FlightRecorder:
-    def __init__(self, capacity: int = 512, sink: str | None = None):
+    def __init__(self, capacity: int = 512, sink: str | None = None,
+                 schema: int | None = None):
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self._schema = schema
         # Writers are not single-threaded: the integrity watchdog
         # dispatches from a worker thread and the Prometheus exporter
         # reads concurrently, so sequencing + the ring append happen
@@ -40,6 +49,8 @@ class FlightRecorder:
         sequence numbers."""
         with self._lock:
             rec = {"seq": self._seq, "kind": str(kind), **fields}
+            if self._schema is not None:
+                rec.setdefault("schema", self._schema)
             self._seq += 1
             self._records.append(rec)
         emit_metric(rec, path=self._sink)

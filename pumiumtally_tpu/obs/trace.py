@@ -1,0 +1,325 @@
+"""Per-job distributed tracing: spans, events, and the crash black box.
+
+PRs 13-15 built a crash-safe multi-tenant serving layer whose
+observability was still per-component: scheduler, journal, AOT bank and
+resilience coordinator each emitted their own flight records with no
+causal thread tying one job's life together.  This module is that
+thread — a lightweight span/event tracer the whole serving path shares:
+
+  * every job gets a ``trace_id`` at submission (persisted in the
+    JOBS.json journal, so a job recovered after a server crash
+    CONTINUES its trace — the two process lifetimes are linked by the
+    id and an explicit ``recovered`` span);
+  * every phase of the job's life is one span (``submit`` → ``queued``
+    → ``admit`` → ``quantum``/``dispatch`` per scheduling quantum →
+    ``retry``/``rollback``/``preempt``/``recovered`` → terminal
+    ``job``) with a ``span_id``, a ``parent_id``, wall-clock end
+    timestamps and monotonic-clock durations;
+  * the AOT bank (resolve/deserialize/compile) and the resilience
+    coordinator (classify/probe) emit spans into the SAME trace via the
+    ambient binding the scheduler sets around each dispatch, so "where
+    did job X's 40 seconds go" is answerable from one stream.
+
+Span records are flat JSON dicts (``schema``/``kind``/``name``/
+``trace_id``/``span_id``/``parent_id``/``job_id``/``pid``/``ts``/
+``seconds`` + attributes) appended to a bounded ring buffer and —
+when a ``sink`` is configured (the scheduler points it at
+``<journal_dir>/TRACE.jsonl``) — streamed one JSON line per record
+through the same best-effort channel as the flight recorder, so a
+crashed process leaves its span history on disk beside the journal
+recovery reads.
+
+The crash black box
+-------------------
+``dump()`` writes the ring's last-N records as one self-contained
+postmortem document through the approved atomic-write path
+(``utils/checkpoint.atomic_write_json`` — PUMI008).  The scheduler
+dumps it on job poisoning, on fatal classification, and from the
+SIGTERM/SIGINT boundary flush.  Because that last caller is
+signal-handler-reachable (PUMI009), the dump path NEVER takes the
+tracer's lock: it snapshots the ring with a plain ``list(deque)``
+(atomic under the GIL) so an interrupted appender cannot deadlock it.
+
+Zero cost to physics: the tracer only wraps HOST-side control flow —
+it never touches device state, RNG keys, or dispatch arguments — so
+served fluxes are bitwise identical with tracing on or off (pinned by
+tests/test_obs_trace.py).  ``PUMI_TPU_TRACE=off`` disables emission
+entirely for overhead-sensitive runs; the per-span cost is priced in
+bench.py's ``BENCH_TRACE_SPANS`` probe.
+"""
+from __future__ import annotations
+
+import collections
+import contextlib
+import os
+import threading
+import time
+import uuid
+
+from ..utils.log import emit_metric
+
+#: Version stamp carried by every span/event record and every black-box
+#: document, so JSONL streams from mixed-version processes stay
+#: distinguishable (readers tolerate unknown fields; see teleview.py).
+TRACE_SCHEMA = 1
+
+#: Env knob: "off"/"0" disables span emission (records() stays empty,
+#: span()/event() become near-zero-cost no-ops).
+ENV_TRACE = "PUMI_TPU_TRACE"
+
+#: Explicit "this span has no parent" marker: pass as ``parent=`` when
+#: an emit must NOT inherit the ambient binding's parent (the terminal
+#: root span of a trace, emitted while the trace is still bound).
+NO_PARENT = "__no_parent__"
+
+
+def trace_enabled() -> bool:
+    return os.environ.get(ENV_TRACE, "").strip().lower() not in (
+        "off", "0", "false",
+    )
+
+
+class SpanTracer:
+    """Bounded-ring span/event tracer with ambient job binding.
+
+    Single logical writer (the scheduler's serving loop; a watchdog
+    worker thread dispatching on its behalf is serialized by the
+    blocked caller), concurrent readers (the exporter's ``/trace``
+    scrape threads, the signal-path black-box dump).  Appends take
+    ``_lock``; the dump path deliberately does not (module docstring).
+    """
+
+    def __init__(self, capacity: int = 1024, sink: str | None = None,
+                 enabled: bool | None = None):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.enabled = trace_enabled() if enabled is None else bool(enabled)
+        self._lock = threading.Lock()
+        self._ring = collections.deque(maxlen=capacity)  # guarded by: self._lock
+        self._seq = 0  # guarded by: self._lock
+        # None defers to PUMI_TPU_METRICS at emission time (same
+        # convention as the flight recorder's sink).
+        self._sink = sink
+        # Ambient (trace_id, job_id, parent_id) the serving loop binds
+        # around each phase so bank/coordinator spans land in the
+        # right trace without threading ids through every call.
+        self._ctx: tuple | None = None
+
+    # -- identity ------------------------------------------------------- #
+    @staticmethod
+    def new_trace() -> str:
+        """A fresh 16-hex trace id (one per job, for its lifetime
+        across every process that serves it)."""
+        return uuid.uuid4().hex[:16]
+
+    @staticmethod
+    def root_id(trace_id: str) -> str:
+        """The DETERMINISTIC id of a trace's root ``job`` span: phases
+        emitted by different process lifetimes parent onto the same
+        root without coordination."""
+        return f"{trace_id}/root"
+
+    def next_id(self) -> str:
+        """Allocate one span id — unique across process lifetimes (the
+        pid disambiguates two processes appending to one TRACE.jsonl)."""
+        with self._lock:
+            n = self._seq
+            self._seq += 1
+        return f"{os.getpid():x}-{n}"
+
+    # -- ambient binding ------------------------------------------------ #
+    @contextlib.contextmanager
+    def bind(self, trace_id: str, job_id: str | None = None,
+             parent_id: str | None = None):
+        """Set the ambient trace context for the duration of one
+        serving phase; spans emitted without explicit ids (the bank,
+        the coordinator) inherit it."""
+        prev, self._ctx = self._ctx, (trace_id, job_id, parent_id)
+        try:
+            yield
+        finally:
+            self._ctx = prev
+
+    @property
+    def current(self) -> tuple:
+        """(trace_id, job_id, parent_id) of the ambient binding, or
+        (None, None, None)."""
+        return self._ctx if self._ctx is not None else (None, None, None)
+
+    # -- emission ------------------------------------------------------- #
+    def _emit(self, kind: str, name: str, seconds: float, *,
+              trace_id=None, parent=None, job_id=None, span_id=None,
+              end_ts=None, attrs=None) -> dict | None:
+        if not self.enabled:
+            return None
+        ctx_trace, ctx_job, ctx_parent = self.current
+        parent_id = parent if parent is not None else ctx_parent
+        if parent_id == NO_PARENT:
+            parent_id = None
+        rec = {
+            "schema": TRACE_SCHEMA,
+            "kind": kind,
+            "name": str(name),
+            "trace_id": trace_id if trace_id is not None else ctx_trace,
+            "span_id": span_id if span_id is not None else self.next_id(),
+            "parent_id": parent_id,
+            "job_id": job_id if job_id is not None else ctx_job,
+            "pid": os.getpid(),
+            "ts": round(end_ts if end_ts is not None else time.time(), 6),
+            "seconds": round(float(seconds), 6),
+        }
+        if attrs:
+            for k, v in attrs.items():
+                rec.setdefault(k, v)
+        with self._lock:
+            rec["seq"] = self._seq
+            self._seq += 1
+            self._ring.append(rec)
+        emit_metric(rec, path=self._sink)
+        return rec
+
+    def event(self, name: str, *, trace_id=None, parent=None,
+              job_id=None, **attrs) -> dict | None:
+        """One zero-duration point event in a trace."""
+        return self._emit(
+            "event", name, 0.0, trace_id=trace_id, parent=parent,
+            job_id=job_id, attrs=attrs,
+        )
+
+    def span_record(self, name: str, seconds: float, *, trace_id=None,
+                    parent=None, job_id=None, span_id=None,
+                    **attrs) -> dict | None:
+        """One completed span of known duration ending now.  Use
+        ``span_id=`` to emit onto a pre-allocated id (a parent whose
+        children were emitted while it was open) or a deterministic one
+        (``root_id``)."""
+        return self._emit(
+            "span", name, seconds, trace_id=trace_id, parent=parent,
+            job_id=job_id, span_id=span_id, attrs=attrs,
+        )
+
+    @contextlib.contextmanager
+    def span(self, name: str, *, trace_id=None, parent=None,
+             job_id=None, **attrs):
+        """Context-managed span around a code block.  Yields the
+        mutable attribute dict — set result attributes before exit
+        (``sp["verdict"] = ...``).  The span is emitted on BOTH normal
+        and exception exit (the error is named), so a failing phase
+        still appears in the postmortem."""
+        if not self.enabled:
+            yield attrs
+            return
+        t0 = time.perf_counter()
+        sid = self.next_id()
+        try:
+            yield attrs
+        except BaseException as e:
+            attrs.setdefault("error", f"{type(e).__name__}: {e}"[:200])
+            raise
+        finally:
+            self._emit(
+                "span", name, time.perf_counter() - t0,
+                trace_id=trace_id, parent=parent, job_id=job_id,
+                span_id=sid, attrs=attrs,
+            )
+
+    # -- read surfaces -------------------------------------------------- #
+    def records(self) -> list[dict]:
+        with self._lock:
+            return list(self._ring)
+
+    def tail(self, n: int) -> list[dict]:
+        if n <= 0:
+            return []
+        with self._lock:
+            return list(self._ring)[-n:]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    # -- the crash black box -------------------------------------------- #
+    def dump(self, path: str, *, reason: str, meta: dict | None = None,
+             ) -> dict:
+        """Write the ring's records as one atomic postmortem document.
+
+        Signal-handler-reachable (the scheduler's SIGTERM/SIGINT
+        boundary flush calls this after the deferral guard admits the
+        flush) — so NO lock here: ``list(deque)`` snapshots atomically
+        under the GIL, and the write rides the approved atomic-write
+        path (tmp+fsync+rename; PUMI008/PUMI009)."""
+        from ..utils.checkpoint import atomic_write_json
+
+        doc = {
+            "schema": TRACE_SCHEMA,
+            "kind": "blackbox",
+            "reason": str(reason),
+            "pid": os.getpid(),
+            "ts": round(time.time(), 6),
+            "meta": dict(meta or {}),
+            "records": list(self._ring),
+        }
+        atomic_write_json(path, doc)
+        return doc
+
+    # -- chrome://tracing export ---------------------------------------- #
+    def chrome(self, records: list[dict] | None = None) -> dict:
+        """The ring (or the given records) as a Chrome-trace JSON
+        document (``chrome://tracing`` / Perfetto).  Each job gets its
+        own track; each span one complete ("X") slice ending at its
+        wall timestamp; events become instant ("i") marks.  The FULL
+        raw record rides in ``args`` so consumers (teleview.py --job
+        over the live endpoint) can reconstruct the causal chain."""
+        recs = self.records() if records is None else records
+        return chrome_trace(recs)
+
+
+def chrome_trace(records: list[dict]) -> dict:
+    """Span/event records -> Chrome-trace JSON (module docstring
+    contract: lossless — raw records ride in each event's ``args``)."""
+    spans = [
+        r for r in records
+        if isinstance(r, dict)
+        and r.get("kind") in ("span", "event")
+        and isinstance(r.get("ts"), (int, float))
+    ]
+    if not spans:
+        return {"traceEvents": [], "displayTimeUnit": "ms"}
+    t0 = min(r["ts"] - float(r.get("seconds") or 0.0) for r in spans)
+    tracks = sorted({
+        str(r.get("job_id") or r.get("trace_id") or "untraced")
+        for r in spans
+    })
+    tid = {k: i + 1 for i, k in enumerate(tracks)}
+    events: list[dict] = [
+        {
+            "name": "thread_name",
+            "ph": "M",
+            "pid": 1,
+            "tid": tid[k],
+            "cat": "__metadata",
+            "args": {"name": k},
+        }
+        for k in tracks
+    ]
+    for r in spans:
+        track = str(r.get("job_id") or r.get("trace_id") or "untraced")
+        sec = float(r.get("seconds") or 0.0)
+        args = {
+            k: v for k, v in r.items()
+            if isinstance(v, (int, float, str, bool)) or v is None
+        }
+        ev = {
+            "name": str(r.get("name", r["kind"])),
+            "pid": 1,
+            "tid": tid[track],
+            "args": args,
+        }
+        if r["kind"] == "span" and sec > 0:
+            ev.update(
+                ph="X", ts=(r["ts"] - sec - t0) * 1e6, dur=sec * 1e6
+            )
+        else:
+            ev.update(ph="i", ts=(r["ts"] - t0) * 1e6, s="t")
+        events.append(ev)
+    return {"traceEvents": events, "displayTimeUnit": "ms"}

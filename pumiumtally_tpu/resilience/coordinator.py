@@ -67,9 +67,19 @@ VERDICTS = ("transient", "chip-lost", "preempted", "persistent")
 
 
 class ResilienceCoordinator:
-    def __init__(self, tally, faults: FaultInjector | None = None):
+    def __init__(self, tally, faults: FaultInjector | None = None,
+                 tracer=None):
         self.tally = tally
         self.faults = faults if faults is not None else FaultInjector()
+        # Span tracer (obs/trace.py): the serving scheduler passes its
+        # own so classify/probe spans land in the failing job's trace
+        # via the ambient binding; standalone use gets a private
+        # (ring-only) tracer.
+        if tracer is None:
+            from ..obs import SpanTracer
+
+            tracer = SpanTracer()
+        self.tracer = tracer
         r = tally.metrics
         self.c_rollbacks = r.counter(
             "pumi_rollbacks_total",
@@ -97,6 +107,13 @@ class ResilienceCoordinator:
         """Point at the post-reshard tally (the registry travels with
         the telemetry transplant, so the counters keep counting)."""
         self.tally = tally
+
+    def note_rollback(self, cause: str) -> None:
+        """Count one coordinated rollback and mark it in the current
+        trace (the runner calls this as it restores the last good
+        generation)."""
+        self.c_rollbacks.inc(cause=cause)
+        self.tracer.event("rollback", cause=cause)
 
     # ------------------------------------------------------------------ #
     def devices(self) -> list:
@@ -135,19 +152,22 @@ class ResilienceCoordinator:
         import jax
 
         health: dict[int, bool] = {}
-        for i, dev in enumerate(self.devices()):
-            if dev in self.downed_devices:
-                ok = False
-            else:
-                try:
-                    probe = jax.device_put(
-                        np.ones(2, np.float32), dev
-                    )
-                    ok = float(np.asarray(probe).sum()) == 2.0
-                except Exception:
+        with self.tracer.span("probe") as sp:
+            for i, dev in enumerate(self.devices()):
+                if dev in self.downed_devices:
                     ok = False
-            health[i] = ok
-            self._g_health.set(1.0 if ok else 0.0, chip=str(i))
+                else:
+                    try:
+                        probe = jax.device_put(
+                            np.ones(2, np.float32), dev
+                        )
+                        ok = float(np.asarray(probe).sum()) == 2.0
+                    except Exception:
+                        ok = False
+                health[i] = ok
+                self._g_health.set(1.0 if ok else 0.0, chip=str(i))
+            sp["chips"] = len(health)
+            sp["dead"] = sum(1 for ok in health.values() if not ok)
         return health
 
     # ------------------------------------------------------------------ #
@@ -156,6 +176,14 @@ class ResilienceCoordinator:
         runtime errors — a hung dispatch, a JAX runtime error — are
         resolved by PROBING: a dead chip behind them upgrades the
         verdict to chip-lost; all chips answering means transient."""
+        with self.tracer.span(
+            "classify", exc=type(exc).__name__,
+        ) as sp:
+            verdict = self._classify(exc)
+            sp["verdict"] = verdict
+        return verdict
+
+    def _classify(self, exc: BaseException) -> str:
         # A probe is retained ONLY for a chip-lost verdict it just
         # produced (consumed by the recovery that follows); anything
         # older is stale — a later failure must probe afresh, or a

@@ -403,7 +403,7 @@ class ResilientRunner:
                     )
                     restore_state(self.tally, self._good)
                     self._dirty = False
-                    self.coordinator.c_rollbacks.inc(cause="transient")
+                    self.coordinator.note_rollback("transient")
                     self.recovery_stats["rollbacks"] += 1
                     if rearm is not None:
                         rearm()
@@ -423,7 +423,7 @@ class ResilientRunner:
             return
         restore_state(self.tally, self._good)
         self._dirty = False
-        self.coordinator.c_rollbacks.inc(cause=cause)
+        self.coordinator.note_rollback(cause)
         self.recovery_stats["rollbacks"] += 1
         try:
             path = self.checkpoint()
@@ -477,7 +477,7 @@ class ResilientRunner:
             # is bitwise.
             restore_state(old, self._good)
             self._dirty = False
-            self.coordinator.c_rollbacks.inc(cause="chip-lost")
+            self.coordinator.note_rollback("chip-lost")
             self.recovery_stats["rollbacks"] += 1
             return
         log_warn(
@@ -491,7 +491,7 @@ class ResilientRunner:
         self.tally = new
         self._dirty = False
         self.coordinator.rebind(new)
-        self.coordinator.c_rollbacks.inc(cause="chip-lost")
+        self.coordinator.note_rollback("chip-lost")
         self.coordinator.c_reshards.inc()
         self.recovery_stats["rollbacks"] += 1
         self.recovery_stats["reshards"] += 1
@@ -628,7 +628,7 @@ class ResilientRunner:
             try:
                 restore_state(self.tally, self._good)
                 self._dirty = False
-                self.coordinator.c_rollbacks.inc(cause="preempted")
+                self.coordinator.note_rollback("preempted")
                 self.recovery_stats["rollbacks"] += 1
             except Exception as e:  # pragma: no cover - best-effort
                 log_warn(f"preemption rollback failed: {e}")

@@ -272,8 +272,9 @@ class ProgramBank:
     program_bank=bank)``; the facade then routes its packed-walk and
     megastep dispatches through :meth:`dispatch`."""
 
-    def __init__(self, root: str, *, registry=None, recorder=None):
-        from ..obs import FlightRecorder, MetricsRegistry
+    def __init__(self, root: str, *, registry=None, recorder=None,
+                 tracer=None):
+        from ..obs import FlightRecorder, MetricsRegistry, SpanTracer
 
         self.root = str(root)
         self.env = environment()
@@ -285,6 +286,11 @@ class ProgramBank:
         self.recorder = (
             recorder if recorder is not None else FlightRecorder()
         )
+        # Span tracer (obs/trace.py): the scheduler passes its own so
+        # resolve/deserialize/compile spans land in the CURRENT job's
+        # trace via the ambient binding; a standalone bank gets a
+        # private (ring-only) tracer.
+        self.tracer = tracer if tracer is not None else SpanTracer()
         r = self.registry
         self._hits = r.counter(
             "pumi_aot_hits_total",
@@ -400,6 +406,19 @@ class ProgramBank:
         import jax
 
         key = entry_key(fam.name, args, dyn, statics)
+        with self.tracer.span(
+            "aot_resolve", family=fam.name, key=key
+        ) as sp:
+            prog = self._acquire_inner(
+                fam, memo_key, args, dyn, statics, shape_key, key
+            )
+            sp["outcome"] = prog.provenance
+        return prog
+
+    def _acquire_inner(self, fam, memo_key, args, dyn, statics,
+                       shape_key, key):
+        import jax
+
         traced = fam.jit.trace(*args, **dyn, **statics)
         lowered = traced.lower()
         in_tree = jax.tree_util.tree_flatten(lowered.args_info)[1]
@@ -432,7 +451,7 @@ class ProgramBank:
             self._programs[memo_key] = prog
         self.recorder.record(
             "aot", family=fam.name, key=key, outcome=provenance,
-            shape_key=shape_key,
+            shape_key=shape_key, job_id=self.tracer.current[1],
         )
         return prog
 
@@ -493,7 +512,13 @@ class ProgramBank:
             )
             return (None, "stale")
         try:
-            compiled = deserialize_and_load(payload, in_tree, out_tree)
+            with self.tracer.span(
+                "aot_deserialize", family=fam.name, key=key,
+                bytes=len(payload),
+            ):
+                compiled = deserialize_and_load(
+                    payload, in_tree, out_tree
+                )
         except Exception as e:
             self._note_rewrite(
                 fam, key, "corrupt", f"deserialization failed: {e}"
@@ -530,7 +555,7 @@ class ProgramBank:
         )
         self.recorder.record(
             "aot_rewrite", family=fam.name, key=key, cause=cause,
-            message=message,
+            message=message, job_id=self.tracer.current[1],
         )
         log_warn(
             f"program bank: rewriting entry {key} ({cause}): {message}"
@@ -559,7 +584,8 @@ class ProgramBank:
             # load-time validator to catch.
             twin = jax.jit(fam.impl, static_argnames=tuple(statics))
             lowered = twin.trace(*args, **dyn, **statics).lower()
-        compiled = fresh_compile(lowered)
+        with self.tracer.span("aot_compile", family=fam.name, key=key):
+            compiled = fresh_compile(lowered)
         dt = time.perf_counter() - t0
         self._compile_s.inc(dt)
         try:

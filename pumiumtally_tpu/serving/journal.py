@@ -23,13 +23,21 @@ Layout — one directory per scheduler::
                                      (atomic), so results survive the
                                      process that computed them
 
-Document format (schema 1)::
+Document format (schema 2; schema-1 documents from pre-tracing
+processes still load — the added fields default)::
 
-  {"schema": 1, "quantum_moves": K,
+  {"schema": 2, "quantum_moves": K,
    "jobs": {job_id: {id, index, state: "pending"|"done", outcome,
                      error, shape_key, n, padded_n, moves_done,
                      preemptions, retries, checkpoint, flux,
+                     trace_id, device_seconds,
                      request: {...}}}}
+
+Schema 2 persists each job's ``trace_id`` (so a recovered job
+CONTINUES its distributed trace across the crash — obs/trace.py) and
+its accumulated ``device_seconds`` attribution.  The span stream
+itself goes to ``<journal_dir>/TRACE.jsonl`` (append-only JSONL,
+best-effort: a torn tail line is skipped by readers).
 
 Write-ahead discipline: the journal is flushed AFTER every state
 transition (submit/reject/quantum/preempt/finish/poison) and each
@@ -63,8 +71,12 @@ import numpy as np
 
 from ..utils.checkpoint import atomic_write_bytes, atomic_write_json
 
-JOURNAL_SCHEMA = 1
+JOURNAL_SCHEMA = 2
+#: Schemas this reader accepts (older documents lack trace fields,
+#: which recovery defaults).
+JOURNAL_SCHEMAS_READABLE = (1, 2)
 JOURNAL_FILE = "JOBS.json"
+TRACE_FILE = "TRACE.jsonl"
 
 # Journaled job ids become filenames — refuse anything that cannot be
 # one (path separators, parent-dir tricks) before it is persisted.
@@ -157,6 +169,17 @@ class SchedulerJournal:
     def flux_path(self, job_id: str) -> str:
         return os.path.join(self.dir, f"{job_id}.flux.npy")
 
+    def trace_path(self) -> str:
+        """The journal-local span sink (TRACE.jsonl): every process
+        lifetime serving this journal appends to the same stream, so
+        teleview can reconstruct a cross-crash trace from one dir."""
+        return os.path.join(self.dir, TRACE_FILE)
+
+    def blackbox_path(self, tag: str) -> str:
+        """Where a postmortem black box for ``tag`` (a job id or a
+        shutdown reason) lands inside the journal dir."""
+        return os.path.join(self.dir, f"{tag}.blackbox.json")
+
     def write_flux(self, job_id: str, arr: np.ndarray) -> str:
         """Persist one finished job's raw flux atomically; returns the
         journal-relative name the document records."""
@@ -198,10 +221,11 @@ class SchedulerJournal:
             return None
         with open(self.path) as fh:
             doc = json.load(fh)
-        if not isinstance(doc, dict) or doc.get("schema") != JOURNAL_SCHEMA:
+        if (not isinstance(doc, dict)
+                or doc.get("schema") not in JOURNAL_SCHEMAS_READABLE):
             raise ValueError(
                 f"journal {self.path}: schema "
                 f"{doc.get('schema') if isinstance(doc, dict) else doc!r}"
-                f" != {JOURNAL_SCHEMA}"
+                f" not in {JOURNAL_SCHEMAS_READABLE}"
             )
         return doc

@@ -65,6 +65,7 @@ def run_saturation(
     job_retries: int = 2,
     quantum_deadline_s: float | None = None,
     journal_dir: str | None = None,
+    blackbox_dir: str | None = None,
     resume: bool = False,
     faults=None,
 ) -> dict:
@@ -92,6 +93,7 @@ def run_saturation(
         max_queued=max_queued,
         job_retries=job_retries,
         quantum_deadline_s=quantum_deadline_s,
+        blackbox_dir=blackbox_dir,
         faults=faults,
     )
     if (
@@ -138,6 +140,8 @@ def run_saturation(
                 "preemptions": j.preemptions,
                 "retries": j.retries,
                 "recovery_seconds": round(j.recovery_seconds, 4),
+                "device_seconds": round(j.device_seconds, 4),
+                "trace_id": j.trace_id,
                 "error": j.error,
             }
             for j in (sched.job(i) for i in ids)

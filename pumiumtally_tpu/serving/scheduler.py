@@ -76,6 +76,28 @@ wait histogram, the bank's ``pumi_aot_hits_total`` /
 registry), per-job and per-quantum flight records plus
 journal/recovery records, and the live Prometheus endpoint via
 ``PUMI_TPU_PROM_PORT``.
+
+Per-job distributed tracing (obs/trace.py) threads a causal spine
+through all of it: every job carries a ``trace_id`` from submission to
+its terminal ``job`` root span — ``submit`` → ``queued`` → ``admit`` →
+one ``quantum`` span per scheduling quantum (with ``retry`` events
+parented on the failing quantum) → ``preempted``/``recovered``/
+terminal — and the ambient binding the loop sets around each phase
+pulls the bank's resolve/deserialize/compile spans and the
+coordinator's classify/probe spans into the SAME trace.  The journal
+persists each job's trace_id (schema 2), so a recovered job CONTINUES
+its trace across a server crash; spans stream to
+``<journal_dir>/TRACE.jsonl``.  Device-time attribution: the
+wall-clock around each blocked dispatch accumulates into
+``pumi_job_device_seconds{job}`` and ``Job.device_seconds``; SLO
+histograms ``pumi_job_e2e_seconds`` and
+``pumi_job_time_to_first_quantum_seconds`` time the full job arc and
+the admission latency.  The crash black box dumps the tracer's ring
+(atomic JSON, PUMI008) on poison and from the signal flush/close
+paths, and the exporter gains ``/jobs`` + ``/trace`` endpoints —
+``scripts/teleview.py --job`` renders either surface.  Tracing is
+zero-cost to physics: spans wrap HOST control flow only, so served
+fluxes are bitwise identical with ``PUMI_TPU_TRACE=off``.
 """
 from __future__ import annotations
 
@@ -89,7 +111,13 @@ import types
 import numpy as np
 
 from ..integrity.watchdog import DispatchTimeoutError
-from ..obs import FlightRecorder, MetricsRegistry, maybe_start_exporter
+from ..obs import (
+    FLIGHT_SCHEMA,
+    FlightRecorder,
+    MetricsRegistry,
+    SpanTracer,
+    maybe_start_exporter,
+)
 from ..resilience.coordinator import ResilienceCoordinator
 from ..resilience.faultinject import FaultInjector, InjectedKill
 from ..tuning.shapes import bucket, classify
@@ -164,6 +192,12 @@ class Job:
         self.submitted_s = time.perf_counter()
         self.enqueued_s = self.submitted_s
         self.finished_s: float | None = None
+        # Distributed-trace identity + device-time attribution
+        # (obs/trace.py; persisted in the schema-2 journal so both
+        # survive a server crash).
+        self.trace_id: str = SpanTracer.new_trace()
+        self.device_seconds = 0.0  # wall around blocked dispatches
+        self.first_dispatch_s: float | None = None
 
     @property
     def terminal(self) -> bool:
@@ -218,6 +252,10 @@ class TallyScheduler:
       journal_dir: the JOBS.json write-ahead journal directory
         (serving/journal.py); enables ``recover`` and the
         SIGTERM/SIGINT flush.
+      blackbox_dir: where crash-postmortem black boxes land
+        (``<tag>.blackbox.json`` — the tracer ring dumped atomically
+        on poison, on the signal flush, and at close).  Defaults to
+        the journal directory; None without a journal disables dumps.
       faults: the scheduler-level FaultInjector driving the per-job
         fault hooks (poison_job / transient_quantum /
         kill_server_at_quantum); default: one built from
@@ -240,6 +278,7 @@ class TallyScheduler:
         backoff_max: float = 2.0,
         quantum_deadline_s: float | None = None,
         journal_dir: str | None = None,
+        blackbox_dir: str | None = None,
         faults: FaultInjector | None = None,
         handle_signals: bool = True,
         registry: MetricsRegistry | None = None,
@@ -302,10 +341,27 @@ class TallyScheduler:
         self.registry = (
             registry if registry is not None else MetricsRegistry()
         )
-        self.recorder = FlightRecorder()
+        self.recorder = FlightRecorder(schema=FLIGHT_SCHEMA)
+        # One tracer for the whole serving path (scheduler + bank +
+        # coordinator share it via the ambient binding); journaled
+        # schedulers stream spans to <journal_dir>/TRACE.jsonl so both
+        # process lifetimes of a crashed server append to one stream.
+        self.tracer = SpanTracer(
+            sink=(
+                self.journal.trace_path()
+                if self.journal is not None else None
+            ),
+        )
+        self.blackbox_dir = (
+            blackbox_dir if blackbox_dir is not None
+            else (self.journal.dir if self.journal is not None else None)
+        )
+        if self.blackbox_dir is not None:
+            os.makedirs(self.blackbox_dir, exist_ok=True)
         if isinstance(bank, str):
             bank = ProgramBank(
-                bank, registry=self.registry, recorder=self.recorder
+                bank, registry=self.registry, recorder=self.recorder,
+                tracer=self.tracer,
             )
         self.bank = bank
         r = self.registry
@@ -352,12 +408,29 @@ class TallyScheduler:
             "(labeled by source: checkpoint = resumed mid-run, "
             "scratch = request replayed from move 0)",
         )
+        self._device_seconds = r.counter(
+            "pumi_job_device_seconds",
+            "wall seconds spent inside blocked quantum dispatches, "
+            "attributed per job (labeled by job id) — the device-time "
+            "share of each job's end-to-end latency",
+        )
+        self._e2e_seconds = r.histogram(
+            "pumi_job_e2e_seconds",
+            "SLO: wall seconds from submission to terminal state "
+            "(completed/converged/poisoned/rejected)",
+        )
+        self._ttfq_seconds = r.histogram(
+            "pumi_job_time_to_first_quantum_seconds",
+            "SLO: wall seconds from submission to the first quantum "
+            "dispatch (queue wait + admission + staging)",
+        )
         # The PR 11 failure taxonomy, shared with ResilientRunner: one
         # coordinator on the SCHEDULER registry, rebound to the failing
         # job's facade at classification time (the probe needs the
         # job's device set; the counters belong to the server).
         self._coordinator = ResilienceCoordinator(
-            types.SimpleNamespace(metrics=r), faults=self.faults
+            types.SimpleNamespace(metrics=r), faults=self.faults,
+            tracer=self.tracer,
         )
         # Per-class FIFO queues + a rotation pointer: admission takes
         # one job per class in turn, so a burst in one shape bucket
@@ -375,7 +448,13 @@ class TallyScheduler:
         self._prev_handlers: dict = {}
         if self.journal is not None and handle_signals:
             self._install_signal_handlers()
-        self._exporter = maybe_start_exporter(self.registry)
+        self._exporter = maybe_start_exporter(
+            self.registry,
+            endpoints={
+                "/jobs": self._jobs_json,
+                "/trace": self.tracer.chrome,
+            },
+        )
 
     # ------------------------------------------------------------------ #
     # Submission
@@ -427,6 +506,14 @@ class TallyScheduler:
         job.request_json = request_json
         self._n_submitted += 1
         self._jobs[job_id] = job
+        # The trace starts at submission for EVERY outcome — a
+        # rejected job's (short) trace still reads submit → job.
+        self.tracer.event(
+            "submit", trace_id=job.trace_id,
+            parent=SpanTracer.root_id(job.trace_id), job_id=job_id,
+            shape_key=job.shape_key, n=n, padded_n=padded_n,
+            n_moves=int(request.n_moves),
+        )
         if (
             self.max_queued is not None
             and self.queue_depth >= self.max_queued
@@ -440,15 +527,20 @@ class TallyScheduler:
             self._jobs_total.inc(outcome="rejected")
             self._job_seconds.observe(job.finished_s - job.submitted_s)
             self.recorder.record(
-                "job_rejected", job=job_id, shape_key=job.shape_key,
+                "job_rejected", job=job_id, job_id=job_id,
+                shape_key=job.shape_key,
                 queue_depth=self.queue_depth,
                 max_queued=self.max_queued,
+            )
+            self._trace_terminal(
+                job, "rejected", queue_depth=self.queue_depth
             )
             self._flush_journal()
             return job_id
         self._enqueue(job)
         self.recorder.record(
-            "job_submitted", job=job_id, shape_key=job.shape_key,
+            "job_submitted", job=job_id, job_id=job_id,
+            shape_key=job.shape_key,
             n=n, padded_n=padded_n, n_moves=int(request.n_moves),
         )
         self._flush_journal()
@@ -510,6 +602,10 @@ class TallyScheduler:
                 if job.checkpoint is not None and not done else None
             ),
             "flux": job.flux_name,
+            # Schema-2 trace fields: the id lets the NEXT process
+            # continue this job's distributed trace after a crash.
+            "trace_id": job.trace_id,
+            "device_seconds": round(job.device_seconds, 6),
             "request": job.request_json,
         }
 
@@ -602,6 +698,13 @@ class TallyScheduler:
         job.preemptions = int(entry.get("preemptions", 0))
         job.retries = int(entry.get("retries", 0))
         job.error = entry.get("error")
+        # Continue the crashed process's trace: same trace_id, new
+        # spans (schema-1 journals predate tracing — those jobs start
+        # a fresh trace here).  Device-time attribution accumulates
+        # across lifetimes.
+        if entry.get("trace_id"):
+            job.trace_id = str(entry["trace_id"])
+        job.device_seconds = float(entry.get("device_seconds", 0.0))
         self._jobs[job.id] = job
         if entry["state"] == "done":
             job.state = DONE
@@ -632,8 +735,17 @@ class TallyScheduler:
         self._enqueue(job)
         self._n_recovered += 1
         self._recovered_total.inc(source=source)
+        # The explicit cross-lifetime link: this span's pid differs
+        # from every span the crashed process emitted, and both parent
+        # onto the same deterministic root id.
+        self.tracer.event(
+            "recovered", trace_id=job.trace_id,
+            parent=SpanTracer.root_id(job.trace_id), job_id=job.id,
+            source=source, moves_done=job.moves_done,
+        )
         self.recorder.record(
-            "journal_recovered", job=job.id, shape_key=job.shape_key,
+            "journal_recovered", job=job.id, job_id=job.id,
+            shape_key=job.shape_key,
             source=source, moves_done=job.moves_done,
         )
 
@@ -676,6 +788,11 @@ class TallyScheduler:
             )
         except Exception as e:  # pragma: no cover - flush best-effort
             log_warn(f"scheduler preemption flush failed: {e}")
+        # Black box last (the journal is the recovery-critical write):
+        # the tracer ring dumped atomically, lock-free — this path is
+        # signal-handler-reachable (PUMI009), and the dump must not
+        # block on a lock an interrupted appender still holds.
+        self._blackbox("shutdown", reason=f"signal-{signum}")
         prev = self._prev_handlers.get(signum)
         self._uninstall_signal_handlers()
         resume_previous_handler(prev, signum, frame)
@@ -713,103 +830,138 @@ class TallyScheduler:
     def _admit(self, job: Job) -> bool:
         from ..api import PumiTally
 
-        self._queue_seconds.observe(
-            time.perf_counter() - job.enqueued_s
+        root = SpanTracer.root_id(job.trace_id)
+        wait = time.perf_counter() - job.enqueued_s
+        self._queue_seconds.observe(wait)
+        # The queue wait as a closed span (it just ended), then the
+        # admission itself with a PRE-allocated span id: the ambient
+        # binding parents everything emitted during admission — the
+        # bank's resolve/deserialize/compile spans, the coordinator's
+        # classify on failure — onto the admit span.
+        self.tracer.span_record(
+            "queued", wait, trace_id=job.trace_id, parent=root,
+            job_id=job.id, preempted=job.checkpoint is not None,
         )
+        aid = self.tracer.next_id()
+        a0 = time.perf_counter()
         tally = None
+        attrs: dict = {}
         try:
-            with _quiet_exporter():
-                tally = PumiTally(
-                    self.mesh, job.padded_n, self.config,
-                    program_bank=self.bank,
-                )
-            restored = False
-            if job.checkpoint is not None:
-                # Preempted/recovered job: restore the exact megastep
-                # boundary it was parked at — the move counter keys the
-                # RNG stream, so the continuation is bitwise the
-                # uninterrupted run.  An unusable checkpoint falls back
-                # to a from-scratch replay (also bitwise) instead of
-                # failing the job.
+            with self.tracer.bind(job.trace_id, job.id, aid):
                 try:
-                    tally.restore_checkpoint(job.checkpoint)
-                    restored = True
+                    with _quiet_exporter():
+                        tally = PumiTally(
+                            self.mesh, job.padded_n, self.config,
+                            program_bank=self.bank,
+                        )
+                    restored = False
+                    if job.checkpoint is not None:
+                        # Preempted/recovered job: restore the exact
+                        # megastep boundary it was parked at — the move
+                        # counter keys the RNG stream, so the
+                        # continuation is bitwise the uninterrupted
+                        # run.  An unusable checkpoint falls back to a
+                        # from-scratch replay (also bitwise) instead of
+                        # failing the job.
+                        try:
+                            tally.restore_checkpoint(job.checkpoint)
+                            restored = True
+                        except Exception as e:
+                            log_warn(
+                                f"checkpoint restore for {job.id} failed "
+                                f"({e}); replaying from move 0"
+                            )
+                            job.checkpoint = None
+                            job.moves_done = 0
+                    if restored:
+                        # The checkpoint's own counter is the truth — a
+                        # journal written just before a crash may lag
+                        # it by one quantum.
+                        job.moves_done = int(tally.iter_count)
+                        job.needs_stage = False
+                    else:
+                        origins_p, _, _, _ = self._padded_inputs(job)
+                        tally.initialize_particle_location(
+                            origins_p.reshape(-1).copy()
+                        )
+                        job.needs_stage = True
+                except InjectedKill:
+                    raise
                 except Exception as e:
-                    log_warn(
-                        f"checkpoint restore for {job.id} failed "
-                        f"({e}); replaying from move 0"
-                    )
-                    job.checkpoint = None
-                    job.moves_done = 0
-            if restored:
-                # The checkpoint's own counter is the truth — a journal
-                # written just before a crash may lag it by one quantum.
-                job.moves_done = int(tally.iter_count)
-                job.needs_stage = False
-            else:
-                origins_p, _, _, _ = self._padded_inputs(job)
-                tally.initialize_particle_location(
-                    origins_p.reshape(-1).copy()
-                )
-                job.needs_stage = True
-        except InjectedKill:
-            raise
-        except Exception as e:
-            if tally is not None:
-                # Constructed but never handed to the job: release its
-                # device buffers before deciding the job's fate.
-                try:
-                    tally.close()
-                except Exception:  # pragma: no cover - best-effort
-                    pass
-            # Admission failures go through the SAME taxonomy as
-            # quantum failures: a transient verdict (retryable runtime
-            # error, timeout with healthy chips) re-queues the job
-            # against its bounded retry budget instead of permanently
-            # poisoning work one replay would have saved.
-            self._coordinator.rebind(types.SimpleNamespace())
-            verdict = self._coordinator.classify(e)
-            if verdict == "transient" and job.retries < self.job_retries:
-                job.retries += 1
-                cause = (
-                    "timeout"
-                    if isinstance(e, DispatchTimeoutError)
-                    else "transient"
-                )
-                self._retries_total.inc(cause=cause)
-                log_warn(
-                    f"admission of {job.id} failed transiently ({e}); "
-                    f"re-queueing (attempt {job.retries}/"
-                    f"{self.job_retries})"
-                )
+                    if tally is not None:
+                        # Constructed but never handed to the job:
+                        # release its device buffers before deciding
+                        # the job's fate.
+                        try:
+                            tally.close()
+                        except Exception:  # pragma: no cover - best-effort
+                            pass
+                    # Admission failures go through the SAME taxonomy
+                    # as quantum failures: a transient verdict
+                    # (retryable runtime error, timeout with healthy
+                    # chips) re-queues the job against its bounded
+                    # retry budget instead of permanently poisoning
+                    # work one replay would have saved.
+                    attrs["error"] = f"{type(e).__name__}: {e}"[:200]
+                    self._coordinator.rebind(types.SimpleNamespace())
+                    verdict = self._coordinator.classify(e)
+                    if (
+                        verdict == "transient"
+                        and job.retries < self.job_retries
+                    ):
+                        job.retries += 1
+                        cause = (
+                            "timeout"
+                            if isinstance(e, DispatchTimeoutError)
+                            else "transient"
+                        )
+                        self._retries_total.inc(cause=cause)
+                        log_warn(
+                            f"admission of {job.id} failed transiently "
+                            f"({e}); re-queueing (attempt "
+                            f"{job.retries}/{self.job_retries})"
+                        )
+                        self.tracer.event(
+                            "retry", cause=cause, attempt=job.retries,
+                            at="admission",
+                        )
+                        self.recorder.record(
+                            "job_retry", job=job.id, job_id=job.id,
+                            shape_key=job.shape_key,
+                            cause=cause, attempt=job.retries,
+                            at="admission", error=str(e)[:200],
+                        )
+                        self._sleep(min(
+                            self.backoff_base * 2 ** (job.retries - 1),
+                            self.backoff_max,
+                        ))
+                        self._enqueue(job)
+                    else:
+                        self._poison(
+                            job, e,
+                            cause=(
+                                "retries-exhausted"
+                                if verdict == "transient" else verdict
+                            ),
+                        )
+                    return False
+                job.tally = tally
+                job.quanta = 0
+                job.state = RESIDENT
+                self._resident.append(job)
+                attrs["restored"] = not job.needs_stage
                 self.recorder.record(
-                    "job_retry", job=job.id, shape_key=job.shape_key,
-                    cause=cause, attempt=job.retries, at="admission",
-                    error=str(e)[:200],
+                    "job_admitted", job=job.id, job_id=job.id,
+                    shape_key=job.shape_key,
+                    restored=job.checkpoint is not None,
                 )
-                self._sleep(min(
-                    self.backoff_base * 2 ** (job.retries - 1),
-                    self.backoff_max,
-                ))
-                self._enqueue(job)
-            else:
-                self._poison(
-                    job, e,
-                    cause=(
-                        "retries-exhausted" if verdict == "transient"
-                        else verdict
-                    ),
-                )
-            return False
-        job.tally = tally
-        job.quanta = 0
-        job.state = RESIDENT
-        self._resident.append(job)
-        self.recorder.record(
-            "job_admitted", job=job.id, shape_key=job.shape_key,
-            restored=job.checkpoint is not None,
-        )
-        return True
+                return True
+        finally:
+            self.tracer.span_record(
+                "admit", time.perf_counter() - a0,
+                trace_id=job.trace_id, parent=root, job_id=job.id,
+                span_id=aid, **attrs,
+            )
 
     def _quantum(self, job: Job) -> None:
         """One scheduling quantum: up to ``quantum_moves`` fused moves
@@ -841,63 +993,114 @@ class TallyScheduler:
             snapshot_state(job.tally)
             if self.job_retries > 0 else None
         )
+        # Pre-allocated quantum span id: retry events and the
+        # coordinator's classify spans emitted mid-quantum parent onto
+        # the quantum span via the ambient binding (the span itself is
+        # emitted when the quantum closes — including by poison).
+        qid = self.tracer.next_id()
+        qattrs: dict = {"k": k, "move_start": job.moves_done}
         t0 = time.perf_counter()
         fail_t0 = None
         attempt = 0
-        while True:
-            try:
-                self.faults.maybe_poison_job(job.index)
-                self.faults.maybe_transient_quantum(job.index)
-                totals = job.tally.run_source_moves(
-                    k, job.request.source, **kw
+        disp_s = 0.0  # wall inside blocked dispatches (device time)
+        poison: tuple | None = None
+        try:
+            with self.tracer.bind(
+                job.trace_id, job.id, qid
+            ):
+                while True:
+                    d0 = time.perf_counter()
+                    try:
+                        self.faults.maybe_poison_job(job.index)
+                        self.faults.maybe_transient_quantum(job.index)
+                        totals = job.tally.run_source_moves(
+                            k, job.request.source, **kw
+                        )
+                        disp_s += time.perf_counter() - d0
+                        qattrs["moves"] = int(totals["moves"])
+                        qattrs["alive"] = int(totals["alive"])
+                        break
+                    except InjectedKill:
+                        raise
+                    except Exception as e:
+                        # A failed attempt still held the device — its
+                        # wall time stays attributed to this job.
+                        disp_s += time.perf_counter() - d0
+                        if fail_t0 is None:
+                            fail_t0 = time.perf_counter()
+                        self._coordinator.rebind(job.tally)
+                        verdict = self._coordinator.classify(e)
+                        if (
+                            verdict != "transient"
+                            or attempt >= self.job_retries
+                            or snap is None
+                        ):
+                            cause = (
+                                "retries-exhausted"
+                                if verdict == "transient" else verdict
+                            )
+                            qattrs["error"] = (
+                                f"{type(e).__name__}: {e}"[:200]
+                            )
+                            # Deferred past the finally so the failing
+                            # quantum's span is in the ring BEFORE the
+                            # poison black box snapshots it.
+                            poison = (e, cause)
+                            break
+                        attempt += 1
+                        job.retries += 1
+                        cause = (
+                            "timeout"
+                            if isinstance(e, DispatchTimeoutError)
+                            else "transient"
+                        )
+                        self._retries_total.inc(cause=cause)
+                        log_warn(
+                            f"job {job.id} quantum failed transiently "
+                            f"({e}); replaying from its snapshot "
+                            f"(attempt {attempt}/{self.job_retries})"
+                        )
+                        # Bitwise replay anchor: the snapshot is the
+                        # same payload the checkpoint subsystem
+                        # persists, and the restore rebuilds every
+                        # donated buffer from host copies — a
+                        # half-consumed dispatch leaves nothing behind.
+                        restore_state(job.tally, snap)
+                        self.tracer.event(
+                            "retry", cause=cause, attempt=attempt,
+                            error=str(e)[:200],
+                        )
+                        self.recorder.record(
+                            "job_retry", job=job.id, job_id=job.id,
+                            shape_key=job.shape_key,
+                            cause=cause, attempt=attempt,
+                            error=str(e)[:200],
+                        )
+                        self._sleep(min(
+                            self.backoff_base * 2 ** (attempt - 1),
+                            self.backoff_max,
+                        ))
+        finally:
+            # Device-time attribution survives every exit path
+            # (success, poison return, injected kill unwinding).
+            job.device_seconds += disp_s
+            if disp_s > 0:
+                self._device_seconds.inc(disp_s, job=job.id)
+            if job.first_dispatch_s is None and disp_s > 0:
+                job.first_dispatch_s = time.perf_counter()
+                self._ttfq_seconds.observe(
+                    job.first_dispatch_s - job.submitted_s
                 )
-                break
-            except InjectedKill:
-                raise
-            except Exception as e:
-                if fail_t0 is None:
-                    fail_t0 = time.perf_counter()
-                self._coordinator.rebind(job.tally)
-                verdict = self._coordinator.classify(e)
-                if (
-                    verdict != "transient"
-                    or attempt >= self.job_retries
-                    or snap is None
-                ):
-                    cause = (
-                        "retries-exhausted"
-                        if verdict == "transient" else verdict
-                    )
-                    self._poison(job, e, cause=cause)
-                    return
-                attempt += 1
-                job.retries += 1
-                cause = (
-                    "timeout"
-                    if isinstance(e, DispatchTimeoutError)
-                    else "transient"
-                )
-                self._retries_total.inc(cause=cause)
-                log_warn(
-                    f"job {job.id} quantum failed transiently ({e}); "
-                    f"replaying from its snapshot (attempt "
-                    f"{attempt}/{self.job_retries})"
-                )
-                # Bitwise replay anchor: the snapshot is the same
-                # payload the checkpoint subsystem persists, and the
-                # restore rebuilds every donated buffer from host
-                # copies — a half-consumed dispatch leaves nothing
-                # behind.
-                restore_state(job.tally, snap)
-                self.recorder.record(
-                    "job_retry", job=job.id, shape_key=job.shape_key,
-                    cause=cause, attempt=attempt,
-                    error=str(e)[:200],
-                )
-                self._sleep(min(
-                    self.backoff_base * 2 ** (attempt - 1),
-                    self.backoff_max,
-                ))
+            self.tracer.span_record(
+                "quantum", time.perf_counter() - t0,
+                trace_id=job.trace_id,
+                parent=SpanTracer.root_id(job.trace_id),
+                job_id=job.id, span_id=qid, retries=attempt,
+                device_seconds=round(disp_s, 6), **qattrs,
+            )
+        if poison is not None:
+            self._poison(job, poison[0], cause=poison[1])
+            return
         if fail_t0 is not None:
             job.recovery_seconds += time.perf_counter() - fail_t0
         job.needs_stage = False
@@ -908,9 +1111,11 @@ class TallyScheduler:
         job.totals["alive"] = totals["alive"]
         self._quanta_total.inc()
         self.recorder.record(
-            "quantum", job=job.id, shape_key=job.shape_key,
+            "quantum", job=job.id, job_id=job.id,
+            shape_key=job.shape_key,
             moves=int(totals["moves"]), move_total=job.moves_done,
             alive=int(totals["alive"]), retries=attempt,
+            device_seconds=round(disp_s, 6),
             seconds=round(time.perf_counter() - t0, 6),
         )
         if totals["alive"] == 0 or job.moves_done >= job.request.n_moves:
@@ -923,6 +1128,41 @@ class TallyScheduler:
             self._journal_checkpoint(job)
             self._flush_journal()
 
+    def _trace_terminal(self, job: Job, outcome: str, **attrs) -> None:
+        """Emit the trace's ROOT span (deterministic id — spans from
+        every process lifetime already parent onto it) and observe the
+        end-to-end SLO histogram.  ``parent=NO_PARENT`` because this
+        is usually emitted inside a bind whose parent the root must
+        not inherit."""
+        from ..obs import NO_PARENT
+
+        e2e = max(0.0, (job.finished_s or time.perf_counter())
+                  - job.submitted_s)
+        self._e2e_seconds.observe(e2e)
+        self.tracer.span_record(
+            "job", e2e, trace_id=job.trace_id, parent=NO_PARENT,
+            job_id=job.id, span_id=SpanTracer.root_id(job.trace_id),
+            outcome=outcome, moves=job.moves_done,
+            device_seconds=round(job.device_seconds, 6),
+            preemptions=job.preemptions, retries=job.retries,
+            **attrs,
+        )
+
+    def _blackbox(self, tag: str, *, reason: str,
+                  meta: dict | None = None) -> str | None:
+        """Dump the tracer ring as a postmortem black box (atomic
+        write).  Best-effort by design — a failed dump must never take
+        the serving loop (or the signal path) down with it."""
+        if self.blackbox_dir is None:
+            return None
+        path = os.path.join(self.blackbox_dir, f"{tag}.blackbox.json")
+        try:
+            self.tracer.dump(path, reason=reason, meta=meta)
+        except Exception as e:  # pragma: no cover - dump best-effort
+            log_warn(f"black-box dump {path} failed: {e}")
+            return None
+        return path
+
     def _finish(self, job: Job, outcome: str) -> None:
         job.result = job.tally.raw_flux.copy()
         job.tally.close()
@@ -934,14 +1174,17 @@ class TallyScheduler:
         job.finished_s = time.perf_counter()
         self._jobs_total.inc(outcome=outcome)
         self._job_seconds.observe(job.finished_s - job.submitted_s)
+        self._trace_terminal(job, outcome)
         if self.journal is not None:
             # Results survive the process: flux first, then the journal
             # record that references it.
             job.flux_name = self.journal.write_flux(job.id, job.result)
         self.recorder.record(
-            "job_done", job=job.id, shape_key=job.shape_key,
+            "job_done", job=job.id, job_id=job.id,
+            shape_key=job.shape_key,
             outcome=outcome, moves=job.moves_done,
             preemptions=job.preemptions, retries=job.retries,
+            device_seconds=round(job.device_seconds, 6),
             seconds=round(job.finished_s - job.submitted_s, 6),
         )
         # Write-ahead order: commit the terminal record (with its
@@ -983,10 +1226,24 @@ class TallyScheduler:
             f"job {job.id} poisoned ({cause}): {job.error} — slot "
             "freed, remaining jobs unaffected"
         )
+        self._trace_terminal(
+            job, "poisoned", cause=cause, error=job.error[:200],
+        )
         self.recorder.record(
-            "job_poisoned", job=job.id, shape_key=job.shape_key,
+            "job_poisoned", job=job.id, job_id=job.id,
+            shape_key=job.shape_key,
             cause=cause, error=job.error[:200], moves=job.moves_done,
             retries=job.retries,
+        )
+        # The postmortem: the ring now holds the job's terminal root
+        # span and its final quanta/retries/classify spans — dump it
+        # before the journal commits the poisoned state.
+        self._blackbox(
+            job.id, reason=f"poisoned:{cause}",
+            meta={
+                "job_id": job.id, "trace_id": job.trace_id,
+                "cause": cause, "error": job.error[:200],
+            },
         )
         self._flush_journal()
         self._remove_checkpoint(job)
@@ -1008,8 +1265,14 @@ class TallyScheduler:
         job.preemptions += 1
         self._resident.remove(job)
         self._preempt_total.inc()
+        self.tracer.event(
+            "preempted", trace_id=job.trace_id,
+            parent=SpanTracer.root_id(job.trace_id), job_id=job.id,
+            moves=job.moves_done, quanta=job.quanta,
+        )
         self.recorder.record(
-            "job_preempted", job=job.id, shape_key=job.shape_key,
+            "job_preempted", job=job.id, job_id=job.id,
+            shape_key=job.shape_key,
             moves=job.moves_done, quanta=job.quanta,
         )
         self._enqueue(job)
@@ -1078,6 +1341,35 @@ class TallyScheduler:
     def jobs(self) -> list[Job]:
         return list(self._jobs.values())
 
+    def _jobs_json(self) -> dict:
+        """The live job table for the exporter's ``/jobs`` endpoint
+        (and teleview): one JSON row per job with its trace identity
+        and device-time attribution."""
+        return {
+            "schema": FLIGHT_SCHEMA,
+            "queue_depth": self.queue_depth,
+            "resident": len(self._resident),
+            "jobs": [
+                {
+                    "id": j.id,
+                    "state": j.state,
+                    "outcome": j.outcome,
+                    "error": j.error,
+                    "shape_key": j.shape_key,
+                    "n": j.n,
+                    "n_moves": int(j.request.n_moves),
+                    "moves_done": j.moves_done,
+                    "preemptions": j.preemptions,
+                    "retries": j.retries,
+                    "trace_id": j.trace_id,
+                    "device_seconds": round(j.device_seconds, 6),
+                }
+                for j in sorted(
+                    self._jobs.values(), key=lambda j: j.index
+                )
+            ],
+        }
+
     def result(self, job_id: str) -> np.ndarray:
         """Raw flux [ntet, n_groups, 2] of one finished job."""
         job = self._jobs[job_id]
@@ -1112,6 +1404,9 @@ class TallyScheduler:
                 self.journal.dir if self.journal is not None else None
             ),
             "quanta": int(self._quanta_total.value()),
+            "device_seconds": round(
+                sum(j.device_seconds for j in self._jobs.values()), 6
+            ),
             "quantum_moves": self.quantum,
             "max_resident": self.max_resident,
             "max_queued": self.max_queued,
@@ -1163,6 +1458,10 @@ class TallyScheduler:
                 job.tally = None
             self._resident.remove(job)
         self._flush_journal()
+        # Every serving campaign leaves a postmortem artifact, crashed
+        # or not — a graceful close dumps the same black box a signal
+        # or a poison would have.
+        self._blackbox("shutdown", reason="close")
         self._uninstall_signal_handlers()
         if self._exporter is not None:
             self._exporter.stop()

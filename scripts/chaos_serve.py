@@ -18,7 +18,16 @@ contracts:
     a ``--resume`` restart loses ZERO jobs: every job reaches a
     terminal outcome, unaffected fluxes are bitwise vs the fault-free
     reference, and the restarted process compiles NO program family
-    (the AOT bank is warm — summary ``aot.misses == 0``).
+    (the AOT bank is warm — summary ``aot.misses == 0``);
+  * **postmortem trace** — every scenario leaves at least one readable
+    black-box dump (obs/trace.py span ring, atomically written), and
+    in kill_restart EVERY job — including the poisoned one — passes
+    ``teleview.py --job <id> --check`` against the journal directory:
+    a single causally-ordered trace spanning BOTH process lifetimes,
+    stitched by the persisted trace_id + ``recovered`` link.  The
+    kill_restart reference run serves with ``PUMI_TPU_TRACE=off``, so
+    its bitwise flux comparison doubles as the tracing-on-vs-off
+    physics-parity gate.
 
 Scenarios (run all by default; ``--only NAME`` to pick one,
 ``--list`` to enumerate):
@@ -39,6 +48,9 @@ import sys
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
+sys.path.insert(1, os.path.join(ROOT, "scripts"))
+
+from teleview import check_job_trace, job_trace, load_trace_records
 
 import numpy as np
 
@@ -74,12 +86,36 @@ def fleet(mesh, cfg, n_jobs, **kw):
     )
 
 
+def readable_postmortems(dirpath: str) -> list[str]:
+    """Names of the readable black-box dumps in ``dirpath`` (valid
+    JSON, ``kind == "blackbox"``, a ``records`` list) — the
+    "each scenario produced a readable postmortem" gate."""
+    found = []
+    if not os.path.isdir(dirpath):
+        return found
+    for fname in sorted(os.listdir(dirpath)):
+        if not fname.endswith(".blackbox.json"):
+            continue
+        try:
+            with open(os.path.join(dirpath, fname)) as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError):
+            continue
+        if doc.get("kind") == "blackbox" and isinstance(
+            doc.get("records"), list
+        ):
+            found.append(fname)
+    return found
+
+
 def check_in_process(name, mesh, cfg, ref, plan, n_jobs,
-                     poisoned: set) -> bool:
+                     poisoned: set, workdir: str) -> bool:
     """One in-process scenario: run the fleet under the chaos plan and
-    assert poisoned-set exactness + survivor bitwise parity."""
+    assert poisoned-set exactness + survivor bitwise parity + a
+    readable black-box postmortem in ``workdir``."""
     out = fleet(
         mesh, cfg, n_jobs, faults=ChaosInjector(plan), job_retries=2,
+        blackbox_dir=workdir,
     )
     rows = {r["job"]: r for r in out["per_job"]}
     got_poisoned = {j for j, r in rows.items() if r["outcome"] == "poisoned"}
@@ -99,10 +135,21 @@ def check_in_process(name, mesh, cfg, ref, plan, n_jobs,
     retries = out["scheduler"]["retries"]
     if plan.transient_quantum is not None:
         ok = ok and retries >= 1
+    # Every scenario must leave a readable postmortem: poison paths
+    # dump the poisoned job's span ring, and close() always dumps the
+    # shutdown black box, so even the fault-absorbed scenarios
+    # (transient_replay) leave one.
+    dumps = readable_postmortems(workdir)
+    ok = ok and len(dumps) >= 1
+    if want_poisoned:
+        ok = ok and any(
+            f.startswith(tuple(want_poisoned)) for f in dumps
+        )
     print(
         f"[chaos-serve] {name}: {plan.describe()} | "
         f"poisoned={sorted(got_poisoned)} retries={retries} "
         f"survivors_bitwise={survivors_bitwise} "
+        f"postmortems={dumps} "
         f"{'OK' if ok else 'FAIL'}",
         flush=True,
     )
@@ -123,7 +170,8 @@ def serve_cmd(journal, bank, n_jobs, resume=False):
     return cmd
 
 
-def run_serve(journal, bank, n_jobs, faults="", resume=False):
+def run_serve(journal, bank, n_jobs, faults="", resume=False,
+              trace=None):
     env = {
         k: v for k, v in os.environ.items()
         if not k.startswith("PUMI_TPU_")
@@ -131,6 +179,10 @@ def run_serve(journal, bank, n_jobs, faults="", resume=False):
     env["JAX_PLATFORMS"] = "cpu"
     if faults:
         env["PUMI_TPU_FAULTS"] = faults
+    if trace is not None:
+        # The reference run serves with tracing off so its flux
+        # comparison doubles as the tracing-on/off bitwise gate.
+        env["PUMI_TPU_TRACE"] = trace
     proc = subprocess.run(
         serve_cmd(journal, bank, n_jobs, resume=resume),
         capture_output=True, text=True, timeout=900, env=env, cwd=ROOT,
@@ -154,8 +206,10 @@ def check_kill_restart(name, tmpdir, n_jobs) -> bool:
     ref_j = os.path.join(tmpdir, "ref-journal")
     j = os.path.join(tmpdir, "journal")
     # Fault-free reference: also populates the AOT bank and persists
-    # per-job fluxes beside its own journal.
-    ref_proc, ref_sum = run_serve(ref_j, bank, n_jobs)
+    # per-job fluxes beside its own journal.  Tracing is OFF here —
+    # the bitwise comparison below is then the tracing-on-vs-off
+    # physics-parity acceptance gate too.
+    ref_proc, ref_sum = run_serve(ref_j, bank, n_jobs, trace="off")
     if ref_proc.returncode != 0:
         print(f"[chaos-serve] {name}: reference run failed "
               f"rc={ref_proc.returncode}\n{ref_proc.stderr[-2000:]}")
@@ -195,17 +249,31 @@ def check_kill_restart(name, tmpdir, n_jobs) -> bool:
             bitwise = False
             break
         n_compared += 1
+    # The postmortem/trace acceptance gate: from the journal dir alone
+    # (TRACE.jsonl + black-box dumps), EVERY job — the poisoned one
+    # included — must reconstruct as one causally-ordered trace
+    # spanning both process lifetimes (teleview --job <id> --check).
+    dumps = readable_postmortems(j)
+    records = load_trace_records(j)
+    trace_problems = []
+    for jid in jobs:
+        for p in check_job_trace(job_trace(records, jid), jid):
+            trace_problems.append(f"{jid}: {p}")
+    traced = not trace_problems
     ok = (
         killed and terminal and zero_compiles and recovered
         and bitwise and poisoned == {"sat-0001"}
-        and len(jobs) == n_jobs
+        and len(jobs) == n_jobs and traced and len(dumps) >= 1
     )
+    for p in trace_problems:
+        print(f"[chaos-serve] {name}: trace check: {p}", flush=True)
     print(
         f"[chaos-serve] {name}: {storm} | killed={killed} "
         f"jobs={len(jobs)} poisoned={sorted(poisoned)} "
         f"recovered={res_sum.get('recovered')} "
         f"aot_misses={(res_sum['aot'] or {}).get('misses')} "
         f"bitwise({n_compared} survivors)={bitwise} "
+        f"traces({len(jobs)} jobs)={traced} postmortems={dumps} "
         f"{'OK' if ok else 'FAIL'}",
         flush=True,
     )
@@ -249,8 +317,11 @@ def main() -> int:
                 if ref is None:
                     ref = fleet(mesh, cfg, n_jobs)
                 plan, poisoned = SCENARIOS[name]
+                workdir = os.path.join(tmpdir, name)
+                os.makedirs(workdir, exist_ok=True)
                 ok = check_in_process(
-                    name, mesh, cfg, ref, plan, n_jobs, poisoned
+                    name, mesh, cfg, ref, plan, n_jobs, poisoned,
+                    workdir,
                 )
             fails += 0 if ok else 1
     print(

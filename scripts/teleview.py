@@ -1,6 +1,6 @@
 #!/usr/bin/env python
 """Summarize a ``PUMI_TPU_METRICS=jsonl:`` stream and optionally emit a
-Chrome-trace timeline.
+Chrome-trace timeline — or render one job's distributed trace.
 
 The flight recorder streams one JSON line per record (moves, initial
 searches, quarantine/rewalk/integrity/audit events, per-batch
@@ -16,17 +16,40 @@ turns a stream (possibly from a crashed or still-running soak) into:
     each kind gets its own track, each record one complete ("X") slice
     ending at its stream timestamp.
 
+Per-job trace mode (``--job <id>``) renders ONE job's causal timeline
+from the span records the serving stack emits (obs/trace.py).  The
+source may be any of:
+
+  * a scheduler JOURNAL DIRECTORY — reads ``TRACE.jsonl`` plus every
+    ``*.blackbox.json`` postmortem dump in it (deduplicated), so a
+    trace spanning a server crash renders from one directory;
+  * a black-box dump (``*.json`` with a ``records`` list) or a raw
+    span JSONL stream;
+  * a live endpoint URL (``http://host:port/trace`` — the exporter's
+    chrome-trace surface carries the raw records in each event's
+    ``args``).
+
+``--check`` (with ``--job``) exits non-zero unless the job's trace is
+single and causally ordered — one trace_id, a submit, a terminal
+``job`` root span, every parent resolvable — and, when spans come
+from more than one process lifetime, an explicit ``recovered`` link.
+The chaos campaign drives this as its postmortem acceptance gate.
+
 Usage:
     python scripts/teleview.py run.metrics.jsonl
     python scripts/teleview.py run.metrics.jsonl --trace run.trace.json
+    python scripts/teleview.py <journal_dir> --job job-00001
+    python scripts/teleview.py http://127.0.0.1:9200/trace --job sat-0003
 
-Pure stdlib; malformed lines (a crash mid-write leaves at most one) are
-counted and skipped, never fatal.
+Pure stdlib; malformed lines (a crash mid-write leaves at most one) and
+unknown record fields (newer schema versions) are tolerated, never
+fatal.
 """
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 
@@ -168,17 +191,200 @@ def chrome_trace(records: list[dict]) -> dict:
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 
+# --------------------------------------------------------------------- #
+# Per-job distributed-trace rendering (obs/trace.py records)
+# --------------------------------------------------------------------- #
+def _records_from_doc(doc) -> list[dict]:
+    """Span records out of a parsed JSON document: a black-box dump
+    (``records`` list) or a chrome-trace export (raw records ride in
+    each event's ``args``)."""
+    if not isinstance(doc, dict):
+        return []
+    if isinstance(doc.get("records"), list):
+        return [r for r in doc["records"] if isinstance(r, dict)]
+    if isinstance(doc.get("traceEvents"), list):
+        return [
+            e["args"] for e in doc["traceEvents"]
+            if isinstance(e, dict)
+            and isinstance(e.get("args"), dict)
+            and e["args"].get("span_id") is not None
+        ]
+    return []
+
+
+def load_trace_records(source: str) -> list[dict]:
+    """Span records from any supported source (module docstring),
+    deduplicated across overlapping surfaces (the same span can sit in
+    TRACE.jsonl AND a black-box dump)."""
+    out: list[dict] = []
+    if source.startswith(("http://", "https://")):
+        from urllib.request import urlopen
+
+        with urlopen(source, timeout=10) as resp:
+            out = _records_from_doc(json.loads(resp.read()))
+    elif os.path.isdir(source):
+        jsonl = os.path.join(source, "TRACE.jsonl")
+        if os.path.exists(jsonl):
+            out.extend(read_records(jsonl)[0])
+        for name in sorted(os.listdir(source)):
+            if not name.endswith(".blackbox.json"):
+                continue
+            try:
+                with open(os.path.join(source, name)) as f:
+                    out.extend(_records_from_doc(json.load(f)))
+            except (OSError, ValueError):
+                continue  # a torn dump must not hide the others
+    elif source.endswith(".json"):
+        with open(source) as f:
+            out = _records_from_doc(json.load(f))
+    else:
+        out = read_records(source)[0]
+    seen: set = set()
+    deduped = []
+    for r in out:
+        key = (r.get("pid"), r.get("span_id"), r.get("seq"))
+        if r.get("span_id") is not None and key in seen:
+            continue
+        seen.add(key)
+        deduped.append(r)
+    return deduped
+
+
+def job_trace(records: list[dict], job_id: str) -> list[dict]:
+    """One job's span/event records in causal (end-timestamp, then
+    sequence) order.  Unknown fields ride along untouched."""
+    mine = [
+        r for r in records
+        if r.get("job_id") == job_id and r.get("span_id") is not None
+    ]
+    return sorted(
+        mine,
+        key=lambda r: (
+            r.get("ts") if isinstance(r.get("ts"), (int, float)) else 0,
+            r.get("seq", 0) if isinstance(r.get("seq"), int) else 0,
+        ),
+    )
+
+
+def check_job_trace(trace: list[dict], job_id: str) -> list[str]:
+    """Causal-integrity problems with one job's trace (empty = good):
+    a single trace id; a submit record; a terminal ``job`` root span;
+    every parent resolvable; an explicit ``recovered`` link whenever
+    spans come from more than one process lifetime."""
+    problems = []
+    if not trace:
+        return [f"no span records for job {job_id}"]
+    trace_ids = {r.get("trace_id") for r in trace} - {None}
+    if len(trace_ids) != 1:
+        problems.append(
+            f"expected one trace_id, found {sorted(map(str, trace_ids))}"
+        )
+    names = [r.get("name") for r in trace]
+    if "submit" not in names:
+        problems.append("no submit record")
+    roots = [r for r in trace if r.get("name") == "job"]
+    if not roots:
+        problems.append("no terminal 'job' root span")
+    ids = {r.get("span_id") for r in trace}
+    dangling = {
+        str(r.get("parent_id")) for r in trace
+        if r.get("parent_id") is not None
+        and r.get("parent_id") not in ids
+    }
+    if dangling:
+        problems.append(f"unresolvable parent span(s): {sorted(dangling)}")
+    pids = {r.get("pid") for r in trace} - {None}
+    if len(pids) > 1 and "recovered" not in names:
+        problems.append(
+            f"spans from {len(pids)} process lifetimes but no "
+            "'recovered' link"
+        )
+    return problems
+
+
+def print_job_trace(trace: list[dict], job_id: str) -> None:
+    """Indented causal timeline: children render under their parent,
+    offsets are relative to the earliest span start."""
+    if not trace:
+        print(f"no span records for job {job_id}")
+        return
+    t0 = min(
+        r["ts"] - float(r.get("seconds") or 0.0)
+        for r in trace if isinstance(r.get("ts"), (int, float))
+    )
+    by_parent: dict = {}
+    by_id = {r["span_id"]: r for r in trace}
+    for r in trace:
+        p = r.get("parent_id")
+        by_parent.setdefault(p if p in by_id else None, []).append(r)
+    trace_id = next(
+        (r["trace_id"] for r in trace if r.get("trace_id")), "?"
+    )
+    pids = sorted({r.get("pid") for r in trace if r.get("pid")})
+    print(f"job {job_id}  trace {trace_id}  "
+          f"({len(trace)} records, pids {pids})")
+
+    core = ("schema", "kind", "name", "trace_id", "span_id",
+            "parent_id", "job_id", "ts", "seconds", "seq")
+
+    def render(rec, depth):
+        off = (rec.get("ts", t0) - float(rec.get("seconds") or 0.0)
+               - t0)
+        dur = float(rec.get("seconds") or 0.0)
+        extra = " ".join(
+            f"{k}={v}" for k, v in rec.items()
+            if k not in core and isinstance(v, (int, float, str, bool))
+        )
+        tag = (f"+{off:9.4f}s {'│ ' * depth}{rec.get('name')}"
+               f" [{dur:.4f}s pid={rec.get('pid')}]")
+        print(f"{tag}  {extra}" if extra else tag)
+        for child in by_parent.get(rec["span_id"], []):
+            render(child, depth + 1)
+
+    for top in by_parent.get(None, []):
+        render(top, 0)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
-        description="Summarize a PUMI_TPU_METRICS jsonl stream"
+        description="Summarize a PUMI_TPU_METRICS jsonl stream or "
+        "render one job's distributed trace"
     )
-    ap.add_argument("stream", help="path to the jsonl metrics file")
+    ap.add_argument(
+        "stream",
+        help="jsonl metrics file; with --job: a journal dir, "
+        "black-box dump, span jsonl, or live /trace URL",
+    )
     ap.add_argument(
         "--trace",
         metavar="OUT.json",
         help="also write a chrome://tracing / Perfetto timeline",
     )
+    ap.add_argument(
+        "--job",
+        metavar="JOB_ID",
+        help="render this job's causal span timeline instead of the "
+        "per-kind summary",
+    )
+    ap.add_argument(
+        "--check",
+        action="store_true",
+        help="with --job: exit non-zero unless the trace is single "
+        "and causally ordered (the chaos-campaign gate)",
+    )
     args = ap.parse_args(argv)
+    if args.check and not args.job:
+        ap.error("--check requires --job")
+    if args.job:
+        records = load_trace_records(args.stream)
+        trace = job_trace(records, args.job)
+        print_job_trace(trace, args.job)
+        if args.check:
+            problems = check_job_trace(trace, args.job)
+            for p in problems:
+                print(f"CHECK FAILED: {p}", file=sys.stderr)
+            return 1 if problems else 0
+        return 0 if trace else 1
     records, bad = read_records(args.stream)
     if not records:
         print(f"no metric records in {args.stream}", file=sys.stderr)

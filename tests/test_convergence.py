@@ -588,6 +588,64 @@ def test_metrics_lint_help_text(mesh64, monkeypatch):
     from pumiumtally_tpu.resilience.runner import ResilientRunner  # noqa: F401
 
 
+def test_metrics_lint_no_orphan_serving_registry(tmp_path):
+    """Orphan-registry bug class: a serving-path module that registers
+    a ``pumi_*`` metric on its OWN registry (instead of the one the
+    scheduler's facade/exporter scrapes) increments counters nobody can
+    see.  AST-harvest every pumi_* family the serving path declares and
+    require each to be reachable from one constructed scheduler's
+    registry."""
+    import ast
+    import os
+
+    from pumiumtally_tpu.serving import TallyScheduler
+
+    pkg = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "pumiumtally_tpu",
+    )
+    modules = [
+        os.path.join(pkg, "serving", "scheduler.py"),
+        os.path.join(pkg, "serving", "bank.py"),
+        os.path.join(pkg, "resilience", "coordinator.py"),
+    ]
+    declared: dict[str, str] = {}
+    for path in modules:
+        with open(path) as fh:
+            tree = ast.parse(fh.read(), filename=path)
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("counter", "gauge", "histogram")
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+                and node.args[0].value.startswith("pumi_")
+            ):
+                declared[node.args[0].value] = os.path.basename(path)
+    # The harvest must see the real serving surface (a refactor that
+    # breaks the walk would pass vacuously otherwise).
+    assert len(declared) >= 12, sorted(declared)
+    mesh = build_box(1.0, 1.0, 1.0, 2, 2, 2)
+    sched = TallyScheduler(
+        mesh, TallyConfig(tolerance=1e-6),
+        bank=str(tmp_path / "bank"), handle_signals=False,
+    )
+    try:
+        reachable = set(sched.registry.snapshot())
+    finally:
+        sched.close()
+    orphans = {
+        name: src for name, src in declared.items()
+        if name not in reachable
+    }
+    assert not orphans, (
+        f"pumi_* metrics registered on a registry the scheduler's "
+        f"scrape endpoint cannot reach: {orphans}"
+    )
+
+
 def test_registry_render_safe_under_concurrent_registration():
     """The scrape thread renders while the move loop lazily registers
     (e.g. the fault counters on first injection): iteration must run

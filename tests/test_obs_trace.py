@@ -1,0 +1,537 @@
+"""Per-job distributed tracing contracts (pumiumtally_tpu/obs/trace.py
++ the serving-stack integration, the observability tentpole).
+
+Contracts pinned here:
+
+  * SPAN MODEL — span/event records carry the schema stamp, ids,
+    parentage and timing; pre-allocated span ids let children nest
+    under a parent emitted at close; ``NO_PARENT`` keeps the terminal
+    root span from inheriting the ambient binding; disabled tracers
+    are no-ops (records stay empty, context managers still run).
+  * LIFECYCLE — a served job's trace reads submit → queued → admit →
+    quantum... → terminal ``job`` root span, every parent resolvable,
+    one trace_id, with per-quantum device-time attribution summing
+    into the job's ``device_seconds`` and the
+    ``pumi_job_device_seconds`` / SLO histogram metrics.
+  * CRASH CONTINUITY — the journal persists ``trace_id`` (schema 2),
+    so a subprocess ``--resume`` recovery CONTINUES the trace: spans
+    from both process lifetimes stitch into one causally-ordered
+    timeline through the deterministic root id and an explicit
+    ``recovered`` link (teleview --job --check is the gate).
+  * BLACK BOX — poisoning a job dumps the span ring atomically; the
+    dump is readable and contains the poisoned job's final spans.
+  * ZERO COST TO PHYSICS — served fluxes are bitwise identical with
+    tracing on vs ``PUMI_TPU_TRACE=off``.
+  * ENDPOINTS — /jobs and /trace render from a live scheduler
+    exporter; /buildz names the build; 404 bodies name the valid
+    endpoints; teleview's checker flags each causal defect class.
+
+Compile budget: the fast core (-m 'not slow') drives only the tracer,
+the exporter, the rejection path (no dispatch) and teleview's pure
+functions; everything dispatching real programs or launching
+subprocesses is marked slow.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pumiumtally_tpu import TallyConfig, build_box
+from pumiumtally_tpu.obs import (
+    FLIGHT_SCHEMA,
+    NO_PARENT,
+    SpanTracer,
+    TRACE_SCHEMA,
+    trace_enabled,
+)
+from pumiumtally_tpu.obs.exporter import MetricsExporter, build_info
+from pumiumtally_tpu.obs.registry import MetricsRegistry
+from pumiumtally_tpu.resilience.faultinject import ChaosInjector, ChaosPlan
+from pumiumtally_tpu.serving import (
+    JobRequest,
+    TallyScheduler,
+    run_saturation,
+    synthetic_requests,
+)
+from pumiumtally_tpu.serving.journal import (
+    JOURNAL_SCHEMA,
+    JOURNAL_SCHEMAS_READABLE,
+)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(ROOT, "scripts"))
+
+from teleview import (  # noqa: E402
+    check_job_trace,
+    job_trace,
+    load_trace_records,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_env(monkeypatch):
+    """Tracing contracts drive the knobs explicitly — scrub any CI
+    sweep's env overrides (incl. PUMI_TPU_TRACE: the tracer reads it
+    at construction)."""
+    for var in (
+        "PUMI_TPU_MEGASTEP", "PUMI_TPU_KERNEL", "PUMI_TPU_IO_PIPELINE",
+        "PUMI_TPU_TUNING", "PUMI_TPU_AOT_FAULT", "PUMI_TPU_PROM_PORT",
+        "PUMI_TPU_FAULTS", "PUMI_TPU_TRACE", "PUMI_TPU_METRICS",
+    ):
+        monkeypatch.delenv(var, raising=False)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return build_box(1.0, 1.0, 1.0, 2, 2, 2)
+
+
+def _cfg(**kw):
+    return TallyConfig(tolerance=1e-6, **kw)
+
+
+# --------------------------------------------------------------------- #
+# Fast core: the span model
+# --------------------------------------------------------------------- #
+def test_span_nesting_and_ordering():
+    tr = SpanTracer(enabled=True)
+    tid = SpanTracer.new_trace()
+    root = SpanTracer.root_id(tid)
+    assert root == f"{tid}/root" == SpanTracer.root_id(tid)
+    tr.event("submit", trace_id=tid, parent=root, job_id="j1", n=4)
+    qid = tr.next_id()
+    with tr.bind(tid, "j1", qid):
+        assert tr.current == (tid, "j1", qid)
+        # A child span emitted while the parent is still open inherits
+        # the ambient parent (the bank/coordinator pattern).
+        with tr.span("aot_resolve", key="k") as sp:
+            sp["outcome"] = "hit"
+    tr.span_record("quantum", 0.25, trace_id=tid, parent=root,
+                   job_id="j1", span_id=qid, k=4)
+    tr.span_record("job", 1.0, trace_id=tid, parent=NO_PARENT,
+                   job_id="j1", span_id=root, outcome="completed")
+    recs = tr.records()
+    assert [r["name"] for r in recs] == [
+        "submit", "aot_resolve", "quantum", "job",
+    ]
+    assert all(r["schema"] == TRACE_SCHEMA for r in recs)
+    assert all(r["trace_id"] == tid for r in recs)
+    seqs = [r["seq"] for r in recs]
+    assert seqs == sorted(seqs)
+    by_name = {r["name"]: r for r in recs}
+    # The child nests under the pre-allocated quantum id, the quantum
+    # under the root, and the root span itself has NO parent (the
+    # NO_PARENT sentinel beats any ambient binding).
+    assert by_name["aot_resolve"]["parent_id"] == qid
+    assert by_name["aot_resolve"]["outcome"] == "hit"
+    assert by_name["quantum"]["span_id"] == qid
+    assert by_name["quantum"]["parent_id"] == root
+    assert by_name["job"]["span_id"] == root
+    assert by_name["job"]["parent_id"] is None
+    # Outside the bind the ambient context is gone.
+    assert tr.current == (None, None, None)
+    # And the whole thing passes the causal checker.
+    assert check_job_trace(job_trace(recs, "j1"), "j1") == []
+
+
+def test_span_emitted_on_exception():
+    tr = SpanTracer(enabled=True)
+    with pytest.raises(RuntimeError, match="boom"):
+        with tr.span("classify") as sp:
+            sp["verdict"] = "pending"
+            raise RuntimeError("boom")
+    (rec,) = tr.records()
+    assert rec["name"] == "classify"
+    assert rec["error"].startswith("RuntimeError: boom")
+
+
+def test_disabled_tracer_is_noop(monkeypatch):
+    assert trace_enabled()
+    monkeypatch.setenv("PUMI_TPU_TRACE", "off")
+    assert not trace_enabled()
+    tr = SpanTracer()  # picks the env up at construction
+    assert tr.event("submit") is None
+    with tr.span("quantum") as sp:
+        sp["k"] = 1  # the context manager still runs the body
+    assert tr.span_record("job", 1.0) is None
+    assert len(tr) == 0 and tr.records() == []
+
+
+def test_ring_bound_and_blackbox_dump(tmp_path):
+    tr = SpanTracer(capacity=8, enabled=True)
+    for i in range(20):
+        tr.event("tick", job_id="j", i=i)
+    assert len(tr) == 8
+    assert [r["i"] for r in tr.records()] == list(range(12, 20))
+    path = str(tmp_path / "j.blackbox.json")
+    doc = tr.dump(path, reason="poisoned:persistent", meta={"job_id": "j"})
+    with open(path) as fh:
+        on_disk = json.load(fh)
+    assert on_disk == json.loads(json.dumps(doc))
+    assert on_disk["kind"] == "blackbox"
+    assert on_disk["schema"] == TRACE_SCHEMA
+    assert on_disk["reason"] == "poisoned:persistent"
+    assert on_disk["meta"] == {"job_id": "j"}
+    assert [r["i"] for r in on_disk["records"]] == list(range(12, 20))
+    with pytest.raises(ValueError, match="capacity"):
+        SpanTracer(capacity=0)
+
+
+def test_trace_jsonl_sink_streams_records(tmp_path):
+    sink = str(tmp_path / "TRACE.jsonl")
+    tr = SpanTracer(sink=sink, enabled=True)
+    tid = SpanTracer.new_trace()
+    tr.event("submit", trace_id=tid, job_id="j1")
+    tr.span_record("job", 0.5, trace_id=tid, job_id="j1",
+                   span_id=SpanTracer.root_id(tid), parent=NO_PARENT)
+    lines = [
+        json.loads(x)
+        for x in open(sink).read().splitlines() if x.strip()
+    ]
+    assert [r["name"] for r in lines] == ["submit", "job"]
+    # The loader reads the stream back and dedups against a dump of
+    # the same ring.
+    tr.dump(str(tmp_path / "x.blackbox.json"), reason="shutdown")
+    recs = load_trace_records(str(tmp_path))
+    assert len(recs) == 2
+
+
+def test_chrome_trace_export_is_lossless():
+    tr = SpanTracer(enabled=True)
+    tid = SpanTracer.new_trace()
+    tr.event("submit", trace_id=tid, job_id="j1")
+    tr.span_record("quantum", 0.5, trace_id=tid, job_id="j1", k=4)
+    doc = tr.chrome()
+    events = [e for e in doc["traceEvents"] if e.get("ph") in ("X", "i")]
+    assert len(events) == 2
+    phases = {e["args"]["name"]: e["ph"] for e in events}
+    assert phases == {"submit": "i", "quantum": "X"}
+    # The raw record rides in args — teleview reconstructs from it.
+    args = [e["args"] for e in events]
+    assert all(a["trace_id"] == tid and "span_id" in a for a in args)
+
+
+# --------------------------------------------------------------------- #
+# Fast core: teleview causal checker
+# --------------------------------------------------------------------- #
+def _mk(name, *, kind="span", tid="t1", sid, parent=None, pid=1, ts=1.0,
+        seq=0, **attrs):
+    return dict(
+        schema=TRACE_SCHEMA, kind=kind, name=name, trace_id=tid,
+        span_id=sid, parent_id=parent, job_id="jX", pid=pid, ts=ts,
+        seconds=0.0, seq=seq, **attrs,
+    )
+
+
+def test_teleview_check_flags_each_defect_class():
+    root = "t1/root"
+    good = [
+        _mk("submit", kind="event", sid="a", parent=root, seq=0),
+        _mk("quantum", sid="b", parent=root, seq=1),
+        _mk("job", sid=root, seq=2),
+    ]
+    assert check_job_trace(job_trace(good, "jX"), "jX") == []
+    assert check_job_trace([], "jX") == ["no span records for job jX"]
+    # Two trace ids in one job's records.
+    forked = good + [_mk("retry", kind="event", tid="t2", sid="z", seq=3)]
+    assert any(
+        "one trace_id" in p
+        for p in check_job_trace(job_trace(forked, "jX"), "jX")
+    )
+    # Missing submit / missing terminal root span.
+    assert any(
+        "no submit" in p
+        for p in check_job_trace(job_trace(good[1:], "jX"), "jX")
+    )
+    assert any(
+        "root span" in p
+        for p in check_job_trace(job_trace(good[:2], "jX"), "jX")
+    )
+    # A dangling parent id.
+    torn = good + [_mk("probe", sid="c", parent="gone", seq=4)]
+    assert any(
+        "unresolvable" in p
+        for p in check_job_trace(job_trace(torn, "jX"), "jX")
+    )
+    # Two process lifetimes without an explicit recovered link...
+    split = good + [_mk("quantum", sid="d", parent=root, pid=2, seq=5)]
+    assert any(
+        "recovered" in p
+        for p in check_job_trace(job_trace(split, "jX"), "jX")
+    )
+    # ...and with one: clean.
+    healed = split + [
+        _mk("recovered", kind="event", sid="e", parent=root, pid=2, seq=6)
+    ]
+    assert check_job_trace(job_trace(healed, "jX"), "jX") == []
+    # Unknown fields from a newer schema ride along untouched.
+    future = [dict(r, schema=99, new_field="x") for r in good]
+    assert check_job_trace(job_trace(future, "jX"), "jX") == []
+
+
+# --------------------------------------------------------------------- #
+# Fast core: exporter endpoints
+# --------------------------------------------------------------------- #
+def _get(url):
+    with urllib.request.urlopen(url, timeout=5) as resp:
+        return resp.status, resp.read().decode()
+
+
+def test_exporter_buildz_and_extra_endpoints():
+    reg = MetricsRegistry()
+    reg.counter("demo_total", "demo").inc()
+    exp = MetricsExporter(
+        reg, port=0, endpoints={"/jobs": lambda: {"jobs": [1, 2]}},
+    )
+    base = exp.url.replace("/metrics", "")
+    try:
+        status, body = _get(base + "/buildz")
+        build = json.loads(body)
+        assert status == 200
+        for key in ("package", "version", "backend", "x64",
+                    "n_devices", "pid"):
+            assert key in build, key
+        assert build["package"] == "pumiumtally_tpu"
+        status, body = _get(base + "/jobs")
+        assert status == 200 and json.loads(body) == {"jobs": [1, 2]}
+        # The 404 body names every valid endpoint.
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(base + "/nope")
+        err_body = ei.value.read().decode()
+        assert ei.value.code == 404
+        for ep in ("/metrics", "/healthz", "/buildz", "/jobs"):
+            assert ep in err_body, err_body
+    finally:
+        exp.stop()
+    # build_info never raises, whatever the backend state.
+    assert isinstance(build_info(), dict)
+
+
+def test_exporter_endpoint_exception_is_500_not_crash():
+    reg = MetricsRegistry()
+
+    def broken():
+        raise RuntimeError("collector died")
+
+    exp = MetricsExporter(reg, port=0, endpoints={"/jobs": broken})
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(exp.url.replace("/metrics", "/jobs"))
+        assert ei.value.code == 500
+        # The exporter thread survived — /healthz still answers.
+        status, body = _get(exp.url.replace("/metrics", "/healthz"))
+        assert status == 200 and body == "ok\n"
+    finally:
+        exp.stop()
+
+
+# --------------------------------------------------------------------- #
+# Fast core: scheduler integration without dispatch (rejection path)
+# --------------------------------------------------------------------- #
+def test_rejection_path_traced_and_flight_schema(mesh, tmp_path,
+                                                 monkeypatch):
+    monkeypatch.setenv("PUMI_TPU_PROM_PORT", "0")
+    sched = TallyScheduler(
+        mesh, _cfg(), max_resident=1, max_queued=1,
+        journal_dir=str(tmp_path / "j"), handle_signals=False,
+    )
+    try:
+        for i in range(3):
+            sched.submit(JobRequest(
+                origins=np.full((4, 3), 0.5), n_moves=2, job_id=f"q{i}",
+            ))
+        # Every serving-path flight record carries the schema stamp and
+        # a job id (satellite: ride-along attribution).
+        recs = sched.recorder.records()
+        assert recs and all(r["schema"] == FLIGHT_SCHEMA for r in recs)
+        assert all("job_id" in r for r in recs)
+        # The rejected job got a full (if short) trace: submit +
+        # terminal root span with outcome=rejected.
+        trace = job_trace(sched.tracer.records(), "q2")
+        assert check_job_trace(trace, "q2") == []
+        job_span = [r for r in trace if r["name"] == "job"][0]
+        assert job_span["outcome"] == "rejected"
+        # trace_id is journaled (schema 2) for crash continuity.
+        assert JOURNAL_SCHEMA == 2 and 1 in JOURNAL_SCHEMAS_READABLE
+        doc = sched.journal.load()
+        assert doc["schema"] == JOURNAL_SCHEMA
+        assert doc["jobs"]["q2"]["trace_id"] == sched.job("q2").trace_id
+        # /jobs and /trace render live from the exporter.
+        base = sched._exporter.url.replace("/metrics", "")
+        status, body = _get(base + "/jobs")
+        rows = json.loads(body)
+        assert status == 200 and rows["schema"] == FLIGHT_SCHEMA
+        byid = {r["id"]: r for r in rows["jobs"]}
+        assert byid["q2"]["outcome"] == "rejected"
+        assert byid["q2"]["trace_id"] == sched.job("q2").trace_id
+        status, body = _get(base + "/trace")
+        chrome = json.loads(body)
+        assert status == 200 and any(
+            e.get("args", {}).get("job_id") == "q2"
+            for e in chrome["traceEvents"]
+        )
+        # The SLO histogram saw the terminal transitions.
+        text = sched.registry.render_prometheus()
+        assert "pumi_job_e2e_seconds" in text
+    finally:
+        sched.close()
+    # close() leaves the shutdown black box beside the journal.
+    bb = os.path.join(str(tmp_path / "j"), "shutdown.blackbox.json")
+    with open(bb) as fh:
+        assert json.load(fh)["kind"] == "blackbox"
+
+
+# --------------------------------------------------------------------- #
+# Slow: full lifecycle, poison black box, bitwise parity, recovery
+# --------------------------------------------------------------------- #
+@pytest.mark.slow
+def test_full_lifecycle_trace_and_device_attribution(mesh, tmp_path):
+    jdir = str(tmp_path / "j")
+    out = run_saturation(
+        mesh, _cfg(), n_jobs=2, class_sizes=(40,), n_moves=4,
+        max_resident=1, quantum_moves=2, journal_dir=jdir,
+    )
+    recs = load_trace_records(jdir)
+    for row in out["per_job"]:
+        jid = row["job"]
+        trace = job_trace(recs, jid)
+        assert check_job_trace(trace, jid) == [], jid
+        names = [r["name"] for r in trace]
+        for expected in ("submit", "queued", "admit", "quantum", "job"):
+            assert expected in names, (jid, names)
+        assert names.index("submit") < names.index("admit") \
+            < names.index("quantum") < names.index("job")
+        # Device-time attribution: each quantum span carries its
+        # blocked-dispatch seconds; they sum into the job row and the
+        # terminal span.
+        q_dev = sum(
+            r["device_seconds"] for r in trace if r["name"] == "quantum"
+        )
+        assert q_dev > 0
+        assert row["device_seconds"] == pytest.approx(q_dev, abs=1e-3)
+        job_span = [r for r in trace if r["name"] == "job"][0]
+        assert job_span["outcome"] == "completed"
+        assert job_span["device_seconds"] == pytest.approx(
+            q_dev, abs=1e-3
+        )
+    sched_stats = out["scheduler"]
+    assert sched_stats["device_seconds"] > 0
+
+
+@pytest.mark.slow
+def test_poison_blackbox_contains_final_spans(mesh, tmp_path):
+    bdir = str(tmp_path / "bb")
+    out = run_saturation(
+        mesh, _cfg(), n_jobs=2, class_sizes=(40,), n_moves=4,
+        max_resident=1, quantum_moves=2, blackbox_dir=bdir,
+        faults=ChaosInjector(ChaosPlan(poison_job=1)), job_retries=1,
+    )
+    rows = {r["job"]: r for r in out["per_job"]}
+    assert rows["sat-0001"]["outcome"] == "poisoned"
+    path = os.path.join(bdir, "sat-0001.blackbox.json")
+    with open(path) as fh:
+        doc = json.load(fh)
+    assert doc["kind"] == "blackbox"
+    assert doc["reason"].startswith("poisoned:")
+    assert doc["meta"]["job_id"] == "sat-0001"
+    assert doc["meta"]["trace_id"] == rows["sat-0001"]["trace_id"]
+    # The ring holds the poisoned job's final moments: its failing
+    # quantum and its terminal span are both in the dump.
+    mine = job_trace(doc["records"], "sat-0001")
+    names = [r["name"] for r in mine]
+    assert "job" in names
+    job_span = [r for r in mine if r["name"] == "job"][0]
+    assert job_span["outcome"] == "poisoned"
+    quantum = [r for r in mine if r["name"] == "quantum"]
+    assert quantum and "error" in quantum[-1]
+
+
+@pytest.mark.slow
+def test_bitwise_parity_tracing_on_vs_off(mesh, monkeypatch):
+    kw = dict(
+        n_jobs=2, class_sizes=(40,), n_moves=4, max_resident=1,
+        quantum_moves=2, seed=9,
+    )
+    on = run_saturation(mesh, _cfg(), **kw)
+    monkeypatch.setenv("PUMI_TPU_TRACE", "off")
+    off = run_saturation(mesh, _cfg(), **kw)
+    assert sorted(on["results"]) == sorted(off["results"])
+    for jid in on["results"]:
+        assert on["results"][jid].tobytes() == \
+            off["results"][jid].tobytes(), jid
+
+
+@pytest.mark.slow
+def test_trace_id_survives_subprocess_recovery(mesh, tmp_path):
+    """The crash-continuity pin: interrupt a journaled fleet, recover
+    it in a FRESH process, and reconstruct every job's single
+    causally-ordered trace — spanning both pids, stitched by the
+    persisted trace_id + recovered link — from the journal dir alone."""
+    jdir = str(tmp_path / "journal")
+    sched = TallyScheduler(
+        mesh, _cfg(), max_resident=1, quantum_moves=2,
+        journal_dir=jdir, handle_signals=False,
+    )
+    for r in synthetic_requests(
+        mesh, 3, class_sizes=(40,), n_moves=4, seed=5
+    ):
+        sched.submit(r)
+    for _ in range(3):
+        sched.step()
+    assert any(j.moves_done > 0 and j.outcome is None
+               for j in sched.jobs())
+    trace_ids = {j.id: j.trace_id for j in sched.jobs()}
+    kill_pid = os.getpid()
+    del sched
+
+    env = {
+        k: v for k, v in os.environ.items()
+        if not k.startswith("PUMI_TPU_")
+    }
+    env["JAX_PLATFORMS"] = "cpu"
+    script = (
+        "import sys; sys.path.insert(0, {root!r})\n"
+        "from pumiumtally_tpu import TallyConfig, build_box\n"
+        "from pumiumtally_tpu.serving import run_saturation\n"
+        "mesh = build_box(1.0, 1.0, 1.0, 2, 2, 2)\n"
+        "out = run_saturation(\n"
+        "    mesh, TallyConfig(tolerance=1e-6), n_jobs=3,\n"
+        "    class_sizes=(40,), n_moves=4, seed=5, max_resident=1,\n"
+        "    quantum_moves=2, journal_dir={journal!r}, resume=True,\n"
+        ")\n"
+        "assert out['scheduler']['recovered'] >= 1\n"
+    ).format(root=ROOT, journal=jdir)
+    proc = subprocess.run(
+        [sys.executable, "-c", script],
+        capture_output=True, text=True, timeout=600, env=env, cwd=ROOT,
+    )
+    assert proc.returncode == 0, proc.stderr
+    recs = load_trace_records(jdir)
+    for jid, tid in trace_ids.items():
+        trace = job_trace(recs, jid)
+        assert check_job_trace(trace, jid) == [], jid
+        assert {r["trace_id"] for r in trace} == {tid}, jid
+        pids = {r["pid"] for r in trace}
+        if len(pids) > 1:
+            # A recovered job's trace spans both lifetimes, linked.
+            assert kill_pid in pids
+            assert "recovered" in [r["name"] for r in trace]
+    # At least one job actually crossed the process boundary.
+    assert any(
+        len({r["pid"] for r in job_trace(recs, jid)}) > 1
+        for jid in trace_ids
+    )
+    # The teleview CLI gate agrees (the chaos campaign's driver).
+    cli = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "scripts", "teleview.py"),
+         jdir, "--job", "sat-0001", "--check"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert cli.returncode == 0, cli.stdout + cli.stderr
