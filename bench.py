@@ -450,6 +450,13 @@ def run(
             int(os.environ["BENCH_SERVE"]), seed=seed
         )
 
+    # ---- serving fleet probe (BENCH_FLEET=<n_members>) -----------------
+    fleet = {}
+    if os.environ.get("BENCH_FLEET"):
+        fleet = run_fleet_bench(
+            int(os.environ["BENCH_FLEET"]), seed=seed
+        )
+
     # ---- span-tracing overhead probe (BENCH_TRACE_SPANS=1) -------------
     trace_spans = {}
     if os.environ.get("BENCH_TRACE_SPANS"):
@@ -533,6 +540,7 @@ def run(
             **event,
             **fault,
             **serve,
+            **fleet,
             **trace_spans,
         },
     }
@@ -809,6 +817,80 @@ def run_serve_saturation(n_jobs: int, seed: int) -> dict:
             "runs": rows,
         }
     }
+
+
+def run_fleet_bench(n_members: int, seed: int) -> dict:
+    """Serving-fleet probe (``BENCH_FLEET=<n_members>``): drive the
+    SAME job mix as the BENCH_SERVE probe through the multi-chip
+    ``FleetRouter`` (serving/fleet.py — one journaled TallyScheduler
+    per member over one shared warm bank) and record fleet
+    ``jobs_per_sec`` plus per-member placement counts, so the fleet
+    row prices the routing + FLEET.json write-ahead overhead directly
+    against the single-scheduler ``aot=hit`` row.  Jobs are submitted
+    in-process (``via_http=False``) — the HTTP gateway's wire cost is
+    a serving concern, not a scheduling one, and keeping it out makes
+    jobs_per_sec comparable.  Reuses the BENCH_SERVE_* knobs for the
+    workload shape; BENCH_FLEET_JOBS (default 8) sets the job count."""
+    import shutil
+    import tempfile
+
+    from pumiumtally_tpu import TallyConfig, build_box
+    from pumiumtally_tpu.serving import run_fleet_saturation
+
+    cells = int(os.environ.get("BENCH_SERVE_CELLS", "4"))
+    classes = tuple(
+        int(x) for x in os.environ.get(
+            "BENCH_SERVE_CLASSES", "96,192"
+        ).split(",")
+    )
+    moves = int(os.environ.get("BENCH_SERVE_MOVES", "8"))
+    quantum = int(os.environ.get("BENCH_SERVE_QUANTUM", "4"))
+    resident = int(os.environ.get("BENCH_SERVE_RESIDENT", "2"))
+    n_jobs = int(os.environ.get("BENCH_FLEET_JOBS", "8"))
+    mesh = build_box(1.0, 1.0, 1.0, cells, cells, cells)
+    cfg = TallyConfig(
+        n_groups=int(os.environ.get("BENCH_GROUPS", "2")),
+        tolerance=1e-6,
+    )
+    tmp = tempfile.mkdtemp(prefix="pumi_fleet_bench_")
+    bank_dir = os.path.join(tmp, "bank")
+    try:
+        # Warm the shared bank first (one single-member pass), so the
+        # fleet row measures steady-state routing, not compiles.
+        run_fleet_saturation(
+            mesh, cfg, fleet_dir=os.path.join(tmp, "warmup"),
+            n_members=1, bank=bank_dir, n_jobs=len(classes),
+            class_sizes=classes, n_moves=moves, seed=seed,
+            via_http=False, max_resident=resident,
+            quantum_moves=quantum,
+        )
+        out = run_fleet_saturation(
+            mesh, cfg, fleet_dir=os.path.join(tmp, "fleet"),
+            n_members=n_members, bank=bank_dir, n_jobs=n_jobs,
+            class_sizes=classes, n_moves=moves, seed=seed,
+            via_http=False, max_resident=resident,
+            quantum_moves=quantum,
+        )
+        st = out["fleet"]
+        return {
+            "fleet": {
+                "n_members": n_members,
+                "n_jobs": n_jobs,
+                "classes": list(classes),
+                "n_moves": moves,
+                "quantum_moves": quantum,
+                "max_resident": resident,
+                "jobs_per_sec": out["jobs_per_sec"],
+                "elapsed_s": out["elapsed_s"],
+                "placements": st["placements"],
+                "migrations": st["migrations"],
+                "outcomes": st["outcomes"],
+                "aot_hits": (st["aot"] or {}).get("hits", 0),
+                "aot_misses": (st["aot"] or {}).get("misses", 0),
+            }
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
 
 
 def run_event_loop(

@@ -150,7 +150,10 @@ SCRIPT_RULES = frozenset({"PUMI001", "PUMI003", "PUMI004", "PUMI005"})
 # kill/restart campaign around signal-sensitive subprocesses — they
 # additionally get the durability + signal-handler rules on top of the
 # value-safety subset.
-JOURNAL_SCRIPTS = frozenset({"scripts/serve.py", "scripts/chaos_serve.py"})
+JOURNAL_SCRIPTS = frozenset({
+    "scripts/serve.py", "scripts/chaos_serve.py",
+    "scripts/chaos_fleet.py",
+})
 JOURNAL_SCRIPT_RULES = SCRIPT_RULES | frozenset({"PUMI008", "PUMI009"})
 
 

@@ -20,7 +20,9 @@ silently reorders.  This module makes them machine-checked:
     ``TallyScheduler._finish``/``._poison``/``._quantum``/``._preempt``
     /``._signal_flush``, ``SchedulerJournal.flush``/``write_flux``,
     ``save_sharded_checkpoint``, ``CheckpointStore.save``/``._rotate``,
-    ``ResilientRunner._on_signal`` — and are verified along ALL paths
+    ``ResilientRunner._on_signal``, ``FleetRouter.submit``/``._place``
+    (the fleet's idempotency-record-before-accept and
+    assignment-record-before-dispatch) — and are verified along ALL paths
     of the function's CFG (if/else branches, loops at 0/1 iterations,
     try bodies and handlers; a path that ends in return/raise stops).
   * Constraint kinds: ``before`` (on any path containing the *after*
@@ -101,6 +103,11 @@ _SIMPLE_EFFECTS = {
     "fsync_dir": "dir.fsync",
     "atomic_savez": "atomic.write",
     "atomic_write_json": "atomic.write",
+    # Fleet routing (serving/fleet.py): the FLEET.json flush and the
+    # two router actions its write-ahead orderings fence.
+    "_flush_fleet": "fleet.record",
+    "_place": "job.place",
+    "_dispatch_job": "job.dispatch",
 }
 
 #: fully-dotted deletion heads (``remove`` alone would match
@@ -333,6 +340,47 @@ PROTOCOLS: tuple[Protocol, ...] = (
             "atomic tmp+fsync+rename writer; any raw write path here "
             "reintroduces torn-journal states the whole design rules "
             "out."
+        ),
+    ),
+    Protocol(
+        name="idempotency-record-before-accept",
+        path=f"{PACKAGE}/serving/fleet.py",
+        function="FleetRouter.submit",
+        constraints=(
+            {"kind": "require", "effect": "fleet.record"},
+            {"kind": "before", "before": "fleet.record",
+             "after": "job.place", "required": True},
+        ),
+        rationale=(
+            "The FLEET.json acceptance record (idempotency key map + "
+            "request payload) is flushed BEFORE the job is placed on "
+            "any member.  Placed first, a crash in between runs a job "
+            "the router never journaled accepting — the client's "
+            "retried POST then starts a SECOND execution of the same "
+            "work, the exact double-run the idempotent ingress exists "
+            "to rule out."
+        ),
+    ),
+    Protocol(
+        name="assignment-record-before-dispatch",
+        path=f"{PACKAGE}/serving/fleet.py",
+        function="FleetRouter._place",
+        constraints=(
+            {"kind": "require", "effect": "fleet.record"},
+            {"kind": "require", "effect": "job.dispatch"},
+            {"kind": "before", "before": "fleet.record",
+             "after": "job.dispatch", "required": True},
+        ),
+        rationale=(
+            "The FLEET.json assignment record is flushed BEFORE the "
+            "member's scheduler sees the job.  A crash between the "
+            "two leaves an assignment whose member journal does not "
+            "know the job — recovery re-dispatches it from the "
+            "journaled request.  Reversed, the crash window leaves a "
+            "job some member owns that the router cannot attribute: "
+            "on restart the router would place it AGAIN elsewhere "
+            "(double-run), and migration's adopt-before-drop overlap "
+            "would have no arbiter naming which copy survives."
         ),
     ),
     Protocol(

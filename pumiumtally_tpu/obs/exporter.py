@@ -15,7 +15,10 @@ with the process).  Endpoints:
     scheduler mounts ``/jobs`` (live job-table JSON: state, outcome,
     moves, device-seconds, trace id per job) and ``/trace`` (the span
     tracer's ring as chrome://tracing JSON, loadable in Perfetto and
-    consumed by ``scripts/teleview.py --job`` against a live server).
+    consumed by ``scripts/teleview.py --job`` against a live server);
+    a fleet router additionally mounts ``/fleet`` (per-member routing
+    + liveness JSON).  ``/buildz`` and 404 bodies enumerate whatever
+    is mounted.
 
 Unknown paths answer 404 with a body NAMING the valid endpoints —
 a misremembered path should teach, not stonewall.
@@ -92,9 +95,19 @@ class MetricsExporter:
                     elif path == "/healthz":
                         body, ctype = b"ok\n", "text/plain"
                     elif path == "/buildz":
+                        # The build payload also names every mounted
+                        # route (extra endpoints like /jobs or /fleet
+                        # included), so one probe discovers the whole
+                        # scrape surface.
+                        info = dict(
+                            build_info(),
+                            endpoints=(
+                                ["/metrics", "/healthz", "/buildz"]
+                                + sorted(exporter.endpoints)
+                            ),
+                        )
                         body = (
-                            json.dumps(build_info(), sort_keys=True)
-                            + "\n"
+                            json.dumps(info, sort_keys=True) + "\n"
                         ).encode()
                         ctype = "application/json"
                     elif path in exporter.endpoints:

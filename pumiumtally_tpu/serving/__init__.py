@@ -1,5 +1,5 @@
 """Tally-as-a-service: the AOT program bank + shape-bucketed scheduler
-(ROADMAP item 3).
+plus the multi-chip fleet layer on top (ROADMAP item 3).
 
 ``ProgramBank`` persists compiled walk/megastep executables to disk per
 (shape class x environment section) so a warm server process serves
@@ -9,20 +9,36 @@ early eviction, checkpoint preemption, per-job failure isolation
 (transient quanta replay bitwise, persistent failures poison exactly
 one job), admission backpressure, and a crash-safe ``JOBS.json``
 write-ahead journal (``SchedulerJournal``, ``TallyScheduler.recover``)
-so a killed server resumes every job bitwise; ``run_saturation`` is
-the shared many-job workload driver behind scripts/serve.py and
-bench.py's ``BENCH_SERVE`` probe.
+so a killed server resumes every job bitwise; ``FleetRouter`` owns one
+scheduler per device behind a write-ahead ``FLEET.json`` routing
+journal (idempotent acceptance, crash-safe placement, cross-chip
+migration, member-death absorption); ``TallyGateway`` is the network
+ingress in front of it; ``run_saturation`` / ``run_fleet_saturation``
+are the shared many-job workload drivers behind scripts/serve.py and
+bench.py's ``BENCH_SERVE`` / ``BENCH_FLEET`` probes.
 """
 from .bank import ProgramBank, validate_loaded
+from .fleet import FleetJournal, FleetMember, FleetRouter
+from .gateway import TallyGateway, decode_result
 from .journal import SchedulerJournal
-from .saturate import run_saturation, synthetic_requests
+from .saturate import (
+    run_fleet_saturation,
+    run_saturation,
+    synthetic_requests,
+)
 from .scheduler import JobRequest, TallyScheduler
 
 __all__ = [
+    "FleetJournal",
+    "FleetMember",
+    "FleetRouter",
     "JobRequest",
     "ProgramBank",
     "SchedulerJournal",
+    "TallyGateway",
     "TallyScheduler",
+    "decode_result",
+    "run_fleet_saturation",
     "run_saturation",
     "synthetic_requests",
     "validate_loaded",

@@ -1,0 +1,860 @@
+"""Multi-chip serving fleet: crash-safe routing over member schedulers.
+
+ROADMAP item 3's fleet half: one ``FleetRouter`` owns N journaled
+``TallyScheduler`` members (one per device slot — CPU-testable on the
+8-device mesh, one chip each on real hardware), places every job by
+shape-class bucket, queue depth, and AOT-bank warmth, and survives any
+member (or its own) death without losing or double-running a job.
+
+Layout — one directory per fleet::
+
+  <fleet_dir>/FLEET.json          the write-ahead ROUTING journal
+                                  (atomic tmp+fsync+rename, like
+                                  JOBS.json)
+  <fleet_dir>/TRACE.jsonl         the shared span stream (one tracer
+                                  for every member, so a migrated
+                                  job's trace reads as one spine)
+  <fleet_dir>/member-K/           member K's own crash-safe scheduler
+                                  journal (serving/journal.py layout)
+
+FLEET.json document (schema 1)::
+
+  {"schema": 1, "members": N, "n_submitted": M,
+   "accepted":    {idempotency_key: job_id},
+   "requests":    {job_id: request_json},   # journaled, not yet
+                                            # dispatched to a member
+   "assignments": {job_id: {"member": K, "migrations": J}}}
+
+Write-ahead orderings (machine-checked by analysis/protolint.py, not
+chaos-only):
+
+  * **idempotency-record-before-accept** (``FleetRouter.submit``): the
+    ``accepted[key] = job_id`` record and the request payload are
+    flushed to FLEET.json BEFORE the job is placed on any member.  A
+    client retrying a POST after any crash therefore maps to the SAME
+    job id — the retry can never start a second execution, because
+    acceptance is only ever decided by the journaled map.
+  * **assignment-record-before-dispatch** (``FleetRouter._place``):
+    the ``assignments[job_id] = member`` record is flushed BEFORE the
+    job is handed to that member's scheduler.  A crash between the
+    two leaves a journaled assignment whose member journal does not
+    know the job — recovery re-dispatches it (the request payload is
+    still journaled).  Reversed, a crash after dispatch but before
+    the record would leave a job some member owns that the router
+    cannot attribute — double-run fodder on restart.
+
+The assignment record is also the DUPLICATE arbiter: migration adopts
+a job on member B before dropping it from member A (so a crash between
+the two loses nothing), which briefly leaves the job in two member
+journals — recovery keeps only the copy the assignment names and drops
+the stale one.
+
+Cross-chip migration rides the existing checkpoint subsystem:
+checkpoint-preempt on member A (megastep boundary), copy the side
+files, ``adopt_job`` on member B — bitwise vs the uninterrupted run,
+because the megastep RNG is keyed by the persistent move counter the
+checkpoint carries, and every member shares one mesh/config/bank.  The
+trace continues across the hop with a ``migrated`` link event (PR 16's
+``recovered``, but across members instead of process lifetimes).
+
+Member death (``absorb_member_kills=True``, or an explicit
+``kill_member``) is absorbed by re-placing the dead member's JOURNALED
+jobs onto survivors — the on-disk write-ahead journal is the authority
+for what the member owned; its in-memory table died with it.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+
+import numpy as np
+
+from ..obs import (
+    FLIGHT_SCHEMA,
+    FlightRecorder,
+    MetricsRegistry,
+    SpanTracer,
+    maybe_start_exporter,
+)
+from ..resilience.faultinject import FaultInjector, InjectedKill
+from ..tuning.shapes import bucket, classify
+from ..utils.checkpoint import atomic_write_json
+from ..utils.log import log_info, log_warn
+from .bank import ProgramBank
+from .journal import (
+    JOURNAL_FILE,
+    TRACE_FILE,
+    SchedulerJournal,
+    check_job_id,
+    request_from_json,
+    request_to_json,
+)
+from .scheduler import JobRequest, TallyScheduler, _quiet_exporter
+
+FLEET_SCHEMA = 1
+FLEET_FILE = "FLEET.json"
+
+
+class FleetJournal:
+    """The atomic FLEET.json routing journal (module docstring format).
+    The router is the single writer; recovery is the single reader."""
+
+    def __init__(self, dirname: str):
+        self.dir = str(dirname)
+        os.makedirs(self.dir, exist_ok=True)
+        self.path = os.path.join(self.dir, FLEET_FILE)
+
+    def member_dir(self, index: int) -> str:
+        return os.path.join(self.dir, f"member-{int(index):02d}")
+
+    def trace_path(self) -> str:
+        """The fleet-wide span sink: every member (and every process
+        lifetime of the router) appends to one TRACE.jsonl, so a
+        migrated job's trace reconstructs from one directory."""
+        return os.path.join(self.dir, TRACE_FILE)
+
+    def flush(self, doc: dict) -> None:
+        atomic_write_json(self.path, {"schema": FLEET_SCHEMA, **doc})
+
+    def load(self) -> dict | None:
+        """The committed routing document, or None before the first
+        flush.  A parse failure is REJECTED loudly: the atomic writer
+        cannot tear this file, so an unreadable document means someone
+        else wrote it — recovering over it could silently re-run or
+        drop accepted jobs."""
+        if not os.path.exists(self.path):
+            return None
+        with open(self.path) as fh:
+            try:
+                doc = json.load(fh)
+            except ValueError as e:
+                raise ValueError(
+                    f"fleet journal {self.path} is not valid JSON "
+                    f"({e}) — the atomic writer cannot tear it, so "
+                    "this document was written by something else; "
+                    "refusing to recover over it"
+                ) from e
+        if not isinstance(doc, dict) or doc.get("schema") != FLEET_SCHEMA:
+            raise ValueError(
+                f"fleet journal {self.path}: schema "
+                f"{doc.get('schema') if isinstance(doc, dict) else doc!r}"
+                f" != {FLEET_SCHEMA}"
+            )
+        return doc
+
+
+class FleetMember:
+    """One device slot: a journaled TallyScheduler plus the router's
+    placement view of it (liveness, lifetime placements, which shape
+    classes it has already served — the warmth signal)."""
+
+    def __init__(self, index: int, scheduler: TallyScheduler):
+        self.index = index
+        self.scheduler = scheduler
+        self.alive = True
+        self.placed = 0            # jobs dispatched here (lifetime)
+        self.warm: set[str] = set()  # shape classes served here
+
+    @property
+    def load(self) -> int:
+        return (
+            self.scheduler.queue_depth + self.scheduler.resident_count
+        )
+
+
+class FleetRouter:
+    """Crash-safe job routing over ``n_members`` schedulers sharing one
+    mesh, config, AOT bank, metrics registry, tracer, and recorder.
+
+    Thread model: the router's scheduling loop (``step``/``run``) and
+    the gateway's HTTP handler threads (serving/gateway.py) serialize
+    on ``self.lock`` — every public method takes it, so member
+    schedulers only ever run single-threaded.
+    """
+
+    def __init__(
+        self,
+        mesh,
+        config=None,
+        *,
+        fleet_dir: str,
+        n_members: int = 2,
+        bank: ProgramBank | str | None = None,
+        registry: MetricsRegistry | None = None,
+        faults: FaultInjector | None = None,
+        absorb_member_kills: bool = False,
+        _recover: bool = False,
+        **member_kwargs,
+    ):
+        if int(n_members) < 1:
+            raise ValueError(f"n_members must be >= 1: {n_members}")
+        self.mesh = mesh
+        self.config = config
+        self.journal = FleetJournal(fleet_dir)
+        self.registry = (
+            registry if registry is not None else MetricsRegistry()
+        )
+        self.recorder = FlightRecorder(schema=FLIGHT_SCHEMA)
+        self.tracer = SpanTracer(sink=self.journal.trace_path())
+        self.absorb_member_kills = bool(absorb_member_kills)
+        self.lock = threading.RLock()
+        if isinstance(bank, str):
+            bank = ProgramBank(
+                bank, registry=self.registry, recorder=self.recorder,
+                tracer=self.tracer,
+            )
+        self.bank = bank
+        r = self.registry
+        self._members_gauge = r.gauge(
+            "pumi_fleet_members",
+            "alive fleet members (schedulers accepting dispatch)",
+        )
+        self._migrations_total = r.counter(
+            "pumi_fleet_migrations_total",
+            "jobs re-placed across members (explicit cross-chip "
+            "migration + dead-member re-placement onto survivors)",
+        )
+        self._fleet_queue_depth = r.gauge(
+            "pumi_fleet_queue_depth",
+            "per-member scheduler queue depth (labeled by member; "
+            "dead members report 0)",
+        )
+        # Routing state — the in-memory mirror of FLEET.json.  All of
+        # it is only touched under self.lock (class docstring).
+        self._accepted: dict[str, str] = {}     # idempotency key -> id
+        self._requests: dict[str, dict] = {}    # journaled, undispatched
+        self._pending: dict[str, JobRequest] = {}
+        self._assignments: dict[str, dict] = {}
+        self._n_submitted = 0
+        # Members never bind the scrape port (the ROUTER's exporter
+        # owns it, with the fleet endpoints mounted) and never install
+        # signal handlers (their write-ahead journals are flushed at
+        # every transition; recovery needs no graceful flush).
+        self.members: list[FleetMember] = []
+        for i in range(int(n_members)):
+            mdir = self.journal.member_dir(i)
+            mkw = dict(
+                member_kwargs,
+                bank=self.bank,
+                registry=self.registry,
+                tracer=self.tracer,
+                recorder=self.recorder,
+                blackbox_dir=self.journal.dir,
+                faults=faults,
+                handle_signals=False,
+            )
+            with _quiet_exporter():
+                if _recover and os.path.exists(
+                    os.path.join(mdir, JOURNAL_FILE)
+                ):
+                    sched = TallyScheduler.recover(
+                        mdir, mesh, config, **mkw
+                    )
+                else:
+                    sched = TallyScheduler(
+                        mesh, config, journal_dir=mdir, **mkw
+                    )
+            member = FleetMember(i, sched)
+            for j in sched.jobs():
+                member.warm.add(j.shape_key)
+            # A recovered member's journaled jobs count as placements
+            # here — the per-member placement stats must reflect
+            # ownership, not just this lifetime's dispatches.
+            member.placed = len(sched.jobs())
+            self.members.append(member)
+        self._exporter = maybe_start_exporter(
+            self.registry,
+            endpoints={
+                "/jobs": self._jobs_json,
+                "/trace": self.tracer.chrome,
+                "/fleet": self.fleet_json,
+            },
+        )
+        self._update_gauges()
+
+    # ------------------------------------------------------------------ #
+    # The routing journal
+    # ------------------------------------------------------------------ #
+    def _flush_fleet(self) -> None:
+        self.journal.flush({
+            "members": len(self.members),
+            "n_submitted": self._n_submitted,
+            "accepted": dict(self._accepted),
+            "requests": dict(self._requests),
+            "assignments": {
+                k: dict(v) for k, v in self._assignments.items()
+            },
+        })
+
+    # ------------------------------------------------------------------ #
+    # Submission (network-facing: serving/gateway.py calls this)
+    # ------------------------------------------------------------------ #
+    def submit(self, request: JobRequest, *,
+               idempotency_key: str | None = None) -> str:
+        """Accept one job and place it on a member.  With an
+        ``idempotency_key``, acceptance is decided by the JOURNALED
+        key map: a key seen before returns the original job id without
+        touching any scheduler (a retried POST never double-runs), and
+        a new key is journaled BEFORE the job is placed
+        (idempotency-record-before-accept, protolint-verified)."""
+        with self.lock:
+            if idempotency_key is not None:
+                try:
+                    check_job_id(idempotency_key)
+                except ValueError:
+                    raise ValueError(
+                        f"idempotency key {idempotency_key!r} is not "
+                        "journal-safe (allowed: 1-128 chars of "
+                        "[A-Za-z0-9._-])"
+                    ) from None
+                known = self._accepted.get(idempotency_key)
+                if known is not None:
+                    self.recorder.record(
+                        "fleet_dedup", job=known, job_id=known,
+                        idempotency_key=idempotency_key,
+                    )
+                    return known
+            # Validation happens BEFORE the acceptance record: a bad
+            # request must be rejected without journaling a key that
+            # maps to a job no member will ever run.
+            origins = np.asarray(
+                request.origins, np.float64
+            ).reshape(-1, 3)
+            n = origins.shape[0]
+            if n < 1:
+                raise ValueError("a job needs at least one particle")
+            if request.n_moves < 1:
+                raise ValueError(
+                    f"n_moves must be >= 1: {request.n_moves}"
+                )
+            for name, arr in (
+                ("weights", request.weights),
+                ("groups", request.groups),
+            ):
+                if (
+                    arr is not None
+                    and np.asarray(arr).reshape(-1).size != n
+                ):
+                    raise ValueError(
+                        f"{name} has "
+                        f"{np.asarray(arr).reshape(-1).size} entries "
+                        f"for {n} particles"
+                    )
+            job_id = request.job_id or f"fleet-{self._n_submitted:05d}"
+            check_job_id(job_id)
+            if job_id in self._assignments or job_id in self._requests:
+                raise ValueError(f"duplicate job id {job_id!r}")
+            request = dataclasses.replace(request, job_id=job_id)
+            shape_key = self._shape_key(n)
+            self._n_submitted += 1
+            if idempotency_key is not None:
+                self._accepted[idempotency_key] = job_id
+            self._requests[job_id] = request_to_json(request)
+            self._pending[job_id] = request
+            # Idempotency-record-before-accept: the key map + request
+            # payload are durable before ANY member sees the job.
+            self._flush_fleet()
+            self._place(job_id, shape_key)
+            return job_id
+
+    def _shape_key(self, n: int) -> str:
+        cfg = self.members[0].scheduler.config
+        return classify(
+            self.mesh.ntet, bucket(n), cfg.n_groups, cfg.dtype,
+            getattr(self.mesh, "geo20", None) is not None,
+        ).key()
+
+    # ------------------------------------------------------------------ #
+    # Placement
+    # ------------------------------------------------------------------ #
+    def _choose(self, shape_key: str,
+                exclude: tuple = ()) -> FleetMember | None:
+        """Least-loaded alive member, warm members first: a member
+        that has already served this shape class holds the deserialized
+        programs resident (the shared on-disk bank makes the first
+        touch cheap everywhere, but warm re-use is free), so warmth
+        wins until queue depth tips the balance."""
+        best = None
+        best_score = None
+        for m in self.members:
+            if not m.alive or m.index in exclude:
+                continue
+            score = (
+                m.load,
+                0 if shape_key in m.warm else 1,
+                m.placed,
+                m.index,
+            )
+            if best_score is None or score < best_score:
+                best, best_score = m, score
+        return best
+
+    def _place(self, job_id: str, shape_key: str, *, entry: dict | None = None,
+               src_dir: str | None = None, member: int | None = None,
+               exclude: tuple = ()) -> int:
+        """Assign ``job_id`` to a member and dispatch it there — in
+        that order: the FLEET.json assignment record is flushed BEFORE
+        the member's scheduler sees the job
+        (assignment-record-before-dispatch, protolint-verified).  A
+        fresh submission dispatches its pending request; a migration
+        (``entry``/``src_dir``) adopts the journaled entry."""
+        if member is not None:
+            target = self.members[member]
+            if not target.alive:
+                raise ValueError(f"member {member} is not alive")
+        else:
+            target = self._choose(shape_key, exclude)
+        if target is None:
+            raise RuntimeError(
+                f"no alive fleet member to place {job_id} on"
+            )
+        prev = self._assignments.get(job_id)
+        self._assignments[job_id] = {
+            "member": target.index,
+            "migrations": (
+                int(prev["migrations"]) + 1 if prev is not None else 0
+            ),
+        }
+        self._flush_fleet()
+        self._dispatch_job(target, job_id, entry=entry, src_dir=src_dir)
+        return target.index
+
+    def _dispatch_job(self, member: FleetMember, job_id: str, *,
+                      entry: dict | None = None,
+                      src_dir: str | None = None) -> None:
+        if entry is not None:
+            member.scheduler.adopt_job(entry, src_dir=src_dir)
+            self._migrations_total.inc()
+        else:
+            member.scheduler.submit(self._pending.pop(job_id))
+            # The member journal now holds the request — the router's
+            # pre-dispatch copy has served its crash window (pruned
+            # from FLEET.json at the next flush).
+            self._requests.pop(job_id, None)
+        member.placed += 1
+        member.warm.add(member.scheduler.job(job_id).shape_key)
+        self.recorder.record(
+            "fleet_placed", job=job_id, job_id=job_id,
+            member=member.index, migrated=entry is not None,
+        )
+        self._update_gauges()
+
+    # ------------------------------------------------------------------ #
+    # Cross-chip migration + member death
+    # ------------------------------------------------------------------ #
+    def migrate(self, job_id: str, to_member: int | None = None) -> int:
+        """Move one non-terminal job to another member: checkpoint-
+        preempt on the current owner (megastep boundary), re-journal
+        the assignment, adopt on the target from the copied side files
+        (bitwise — the checkpoint's move counter keys the RNG), then
+        drop the source copy (adopt-before-drop: a crash in between
+        leaves two journaled copies, and the assignment record names
+        the one recovery keeps).  Returns the new member index."""
+        with self.lock:
+            assignment = self._assignments[job_id]
+            src = self.members[assignment["member"]]
+            if not src.alive:
+                raise ValueError(
+                    f"job {job_id} is on dead member {src.index}"
+                )
+            job = src.scheduler.job(job_id)
+            if job.terminal:
+                raise ValueError(
+                    f"job {job_id} is terminal ({job.outcome}) — "
+                    "nothing to migrate"
+                )
+            src.scheduler.preempt_job(job_id)
+            fleet_entry = src.scheduler.export_entry(job_id)
+            new_index = self._place(
+                job_id, job.shape_key, entry=fleet_entry,
+                src_dir=src.scheduler.journal.dir,
+                member=to_member, exclude=(src.index,),
+            )
+            src.scheduler.drop_job(job_id)
+            log_info(
+                f"fleet migration: {job_id} member {src.index} -> "
+                f"{new_index} at move {job.moves_done}"
+            )
+            return new_index
+
+    def kill_member(self, index: int, reason: str = "killed") -> None:
+        """Chaos hook: model member ``index`` dying NOW (crash-model
+        teardown, no journal writes) and absorb the death by
+        re-placing its journaled jobs onto survivors."""
+        with self.lock:
+            member = self.members[index]
+            if not member.alive:
+                return
+            self._absorb_death(member, reason=reason)
+
+    def _absorb_death(self, member: FleetMember, *, reason: str) -> None:
+        member.scheduler.abandon()
+        member.alive = False
+        self._update_gauges()
+        log_warn(
+            f"fleet member {member.index} died ({reason}); re-placing "
+            "its journaled jobs onto survivors"
+        )
+        if not any(m.alive for m in self.members):
+            raise RuntimeError(
+                f"fleet member {member.index} died ({reason}) and no "
+                "members survive"
+            )
+        # The dead member's WRITE-AHEAD journal on disk is the
+        # authority for what it owned — its in-memory table died with
+        # it.  Terminal jobs re-place too (their persisted fluxes ride
+        # along), so every accepted job stays owned by an alive member.
+        mdir = self.journal.member_dir(member.index)
+        doc = SchedulerJournal(mdir).load() or {"jobs": {}}
+        moved = 0
+        for entry in sorted(
+            doc.get("jobs", {}).values(), key=lambda e: e["index"]
+        ):
+            jid = entry["id"]
+            assignment = self._assignments.get(jid)
+            if assignment is not None and (
+                assignment["member"] != member.index
+            ):
+                continue  # stale copy; the assignment names the owner
+            self._place(
+                jid, entry["shape_key"], entry=entry, src_dir=mdir,
+                exclude=(member.index,),
+            )
+            moved += 1
+        self.recorder.record(
+            "member_death", member=member.index, reason=reason,
+            replaced=moved,
+        )
+        log_info(
+            f"fleet member {member.index}: {moved} journaled jobs "
+            "re-placed onto survivors"
+        )
+
+    # ------------------------------------------------------------------ #
+    # The scheduling loop
+    # ------------------------------------------------------------------ #
+    def step(self) -> bool:
+        """One round over every alive member.  An ``InjectedKill``
+        from a member's quantum is the chaos campaign's member-death
+        model: with ``absorb_member_kills`` the router absorbs it
+        (abandon + re-place onto survivors) and keeps serving; without
+        it the kill propagates — the whole-process crash model the
+        router-kill scenario exercises."""
+        with self.lock:
+            pending = False
+            for member in list(self.members):
+                if not member.alive:
+                    continue
+                try:
+                    pending = member.scheduler.step() or pending
+                except InjectedKill:
+                    if not self.absorb_member_kills:
+                        raise
+                    self._absorb_death(member, reason="injected-kill")
+                    pending = True
+            self._update_gauges()
+            return pending
+
+    def run(self, max_rounds: int = 100000) -> None:
+        for _ in range(max_rounds):
+            if not self.step():
+                return
+        raise RuntimeError(
+            f"fleet did not drain within {max_rounds} rounds"
+        )
+
+    # ------------------------------------------------------------------ #
+    # Recovery (the router-kill half of the chaos campaign)
+    # ------------------------------------------------------------------ #
+    @classmethod
+    def recover(cls, fleet_dir: str, mesh, config=None, **kwargs):
+        """Rebuild a fleet over an existing FLEET.json + member
+        journals: every member recovers its own job table
+        (``TallyScheduler.recover`` — checkpoint resumes are bitwise),
+        then the router reconciles the routing journal against what
+        the members actually know, closing both crash windows the
+        write-ahead order leaves open (module docstring)."""
+        journal = FleetJournal(fleet_dir)
+        doc = journal.load()
+        if doc is None:
+            raise ValueError(
+                f"no fleet journal at {journal.path} — nothing to "
+                "recover"
+            )
+        router = cls(
+            mesh, config, fleet_dir=fleet_dir,
+            n_members=int(doc["members"]), _recover=True, **kwargs,
+        )
+        try:
+            with router.lock:
+                router._accepted = {
+                    str(k): str(v)
+                    for k, v in doc.get("accepted", {}).items()
+                }
+                router._requests = dict(doc.get("requests", {}))
+                router._assignments = {
+                    k: {"member": int(v["member"]),
+                        "migrations": int(v.get("migrations", 0))}
+                    for k, v in doc.get("assignments", {}).items()
+                }
+                router._n_submitted = int(doc.get("n_submitted", 0))
+                router._reconcile()
+        except BaseException:
+            router.abandon()
+            raise
+        return router
+
+    def _reconcile(self) -> None:
+        """Close the write-ahead crash windows after recovery: drop
+        stale duplicate copies a mid-migration crash left behind, then
+        re-dispatch every journaled-accepted job no alive member
+        knows."""
+        # (i) A job in a member journal whose assignment names another
+        # member is the stale half of an interrupted migration — the
+        # adopted copy (journaled before the drop) is the real one.
+        for m in self.members:
+            if not m.alive:
+                continue
+            for j in list(m.scheduler.jobs()):
+                assignment = self._assignments.get(j.id)
+                if assignment is None:
+                    # A member knows a job the router never recorded:
+                    # impossible under the write-ahead order; heal by
+                    # adopting the member's view rather than orphaning
+                    # the work.
+                    self._assignments[j.id] = {
+                        "member": m.index, "migrations": 0,
+                    }
+                elif assignment["member"] != m.index:
+                    log_warn(
+                        f"fleet recovery: dropping stale copy of "
+                        f"{j.id} from member {m.index} (assigned to "
+                        f"member {assignment['member']})"
+                    )
+                    m.scheduler.drop_job(j.id)
+        # (ii) Journaled-accepted jobs nobody knows: the crash landed
+        # between the acceptance/assignment record and the dispatch —
+        # the journaled request payload replays it.
+        owned = {
+            j.id for m in self.members if m.alive
+            for j in m.scheduler.jobs()
+        }
+        for jid in sorted(set(self._assignments) | set(self._requests)):
+            if jid in owned:
+                self._requests.pop(jid, None)
+                continue
+            req_json = self._requests.get(jid)
+            if req_json is None:  # pragma: no cover - defensive
+                log_warn(
+                    f"fleet recovery: {jid} assigned but neither "
+                    "dispatched nor journaled as a request — lost to "
+                    "a pre-journal crash window that should not exist"
+                )
+                continue
+            self._pending[jid] = request_from_json(req_json)
+            assignment = self._assignments.get(jid)
+            n = np.asarray(req_json["origins"]).reshape(-1, 3).shape[0]
+            self._place(
+                jid, self._shape_key(n),
+                member=(
+                    assignment["member"]
+                    if assignment is not None
+                    and self.members[assignment["member"]].alive
+                    else None
+                ),
+            )
+        self._flush_fleet()
+        log_info(
+            f"fleet recovery: {len(self.members)} members, "
+            f"{len(owned)} jobs owned, "
+            f"{len(self._accepted)} idempotency keys restored"
+        )
+
+    # ------------------------------------------------------------------ #
+    # Introspection (gateway + exporter surfaces)
+    # ------------------------------------------------------------------ #
+    def owner_of(self, job_id: str) -> FleetMember | None:
+        assignment = self._assignments.get(job_id)
+        if assignment is None:
+            return None
+        member = self.members[assignment["member"]]
+        return member if member.alive else None
+
+    def job(self, job_id: str):
+        with self.lock:
+            member = self.owner_of(job_id)
+            if member is None:
+                raise KeyError(job_id)
+            return member.scheduler.job(job_id)
+
+    def jobs(self) -> list:
+        with self.lock:
+            return [
+                j for m in self.members if m.alive
+                for j in m.scheduler.jobs()
+            ]
+
+    def result(self, job_id: str) -> np.ndarray:
+        with self.lock:
+            member = self.owner_of(job_id)
+            if member is None:
+                raise KeyError(job_id)
+            return member.scheduler.result(job_id)
+
+    def cancel(self, job_id: str) -> bool:
+        with self.lock:
+            member = self.owner_of(job_id)
+            if member is None:
+                raise KeyError(job_id)
+            return member.scheduler.cancel(job_id)
+
+    def member_of(self, job_id: str) -> int | None:
+        with self.lock:
+            assignment = self._assignments.get(job_id)
+            return None if assignment is None else assignment["member"]
+
+    def progress(self, job_id: str,
+                 since: int = -1) -> tuple[list[dict], bool]:
+        """Flight records for one job with seq > ``since`` (the shared
+        recorder spans every member, so a migrated job's progress is
+        one stream) plus its terminal flag — the gateway's streaming
+        endpoint polls this."""
+        with self.lock:
+            member = self.owner_of(job_id)
+            if member is None:
+                raise KeyError(job_id)
+            records = [
+                r for r in self.recorder.records()
+                if r.get("job") == job_id and r.get("seq", -1) > since
+            ]
+            return records, member.scheduler.job(job_id).terminal
+
+    def _update_gauges(self) -> None:
+        self._members_gauge.set(
+            sum(1 for m in self.members if m.alive)
+        )
+        for m in self.members:
+            self._fleet_queue_depth.set(
+                m.scheduler.queue_depth if m.alive else 0,
+                member=f"m{m.index}",
+            )
+
+    def _jobs_json(self) -> dict:
+        """Aggregated job table for the exporter's ``/jobs``: every
+        member's rows plus the owning member index."""
+        with self.lock:
+            rows = []
+            for m in self.members:
+                if not m.alive:
+                    continue
+                for row in m.scheduler._jobs_json()["jobs"]:
+                    rows.append(dict(row, member=m.index))
+            rows.sort(key=lambda r: r["id"])
+            return {
+                "schema": FLIGHT_SCHEMA,
+                "queue_depth": sum(
+                    m.scheduler.queue_depth
+                    for m in self.members if m.alive
+                ),
+                "resident": sum(
+                    m.scheduler.resident_count
+                    for m in self.members if m.alive
+                ),
+                "jobs": rows,
+            }
+
+    def fleet_json(self) -> dict:
+        """The ``/fleet`` endpoint: routing + liveness view."""
+        with self.lock:
+            return {
+                "schema": FLIGHT_SCHEMA,
+                "members": [
+                    {
+                        "member": m.index,
+                        "alive": m.alive,
+                        "queue_depth": (
+                            m.scheduler.queue_depth if m.alive else 0
+                        ),
+                        "resident": (
+                            m.scheduler.resident_count
+                            if m.alive else 0
+                        ),
+                        "placed": m.placed,
+                        "jobs": (
+                            len(m.scheduler.jobs()) if m.alive else 0
+                        ),
+                        "warm_classes": sorted(m.warm),
+                        "journal": self.journal.member_dir(m.index),
+                    }
+                    for m in self.members
+                ],
+                "assignments": len(self._assignments),
+                "accepted_keys": len(self._accepted),
+                "migrations": int(self._migrations_total.value()),
+            }
+
+    def stats(self) -> dict:
+        """Fleet summary for serve.py's JSON (per-member placement
+        counts included — the chaos campaign asserts over them)."""
+        with self.lock:
+            all_jobs = [
+                j for m in self.members if m.alive
+                for j in m.scheduler.jobs()
+            ]
+            outcomes: dict[str, int] = {}
+            for j in all_jobs:
+                if j.outcome is not None:
+                    outcomes[j.outcome] = outcomes.get(j.outcome, 0) + 1
+            return {
+                "members": len(self.members),
+                "alive": sum(1 for m in self.members if m.alive),
+                "jobs": len(all_jobs),
+                "outcomes": outcomes,
+                "queue_depth": sum(
+                    m.scheduler.queue_depth
+                    for m in self.members if m.alive
+                ),
+                "placements": {
+                    f"member-{m.index}": m.placed for m in self.members
+                },
+                "migrations": int(self._migrations_total.value()),
+                "retries": sum(j.retries for j in all_jobs),
+                "recovered": sum(
+                    m.scheduler._n_recovered
+                    for m in self.members if m.alive
+                ),
+                "journal": self.journal.dir,
+                "aot": (
+                    self.bank.stats() if self.bank is not None else None
+                ),
+            }
+
+    # ------------------------------------------------------------------ #
+    # Teardown
+    # ------------------------------------------------------------------ #
+    def close(self) -> None:
+        """Graceful shutdown: every alive member parks its residents'
+        checkpoints and flushes its journal, then the routing journal
+        commits last."""
+        with self.lock:
+            for m in self.members:
+                if m.alive:
+                    m.scheduler.close()
+            self._flush_fleet()
+            if self._exporter is not None:
+                self._exporter.stop()
+                self._exporter = None
+
+    def abandon(self) -> None:
+        """Crash-model teardown: release device state everywhere, no
+        journal writes — recovery must work from what the write-ahead
+        journals already committed."""
+        with self.lock:
+            for m in self.members:
+                if m.alive:
+                    m.scheduler.abandon()
+            if self._exporter is not None:
+                self._exporter.stop()
+                self._exporter = None

@@ -1,0 +1,319 @@
+"""Network ingress for the serving fleet: idempotent HTTP job intake.
+
+The missing half of ROADMAP item 3's "a request is an in-process
+Python call": ``TallyGateway`` puts a plain-stdlib HTTP server (the
+``obs/exporter.py`` ThreadingHTTPServer pattern — no dependencies,
+daemon threads, dies with the process) in front of a ``FleetRouter``:
+
+  * ``POST /submit`` — body is the ``serving/journal.py`` request wire
+    format (``request_to_json``: origins/n_moves/weights/groups/
+    source/job_id — float64 payloads survive bitwise through json's
+    repr round-trip) plus an optional ``idempotency_key``.  The key is
+    journaled in FLEET.json BEFORE the job is accepted onto any member
+    (``FleetRouter.submit``, protolint-verified), so a client that
+    times out and retries the POST gets the SAME job id back and never
+    starts a second execution.  Answers ``{"job": id}``.
+  * ``GET /status/<job>`` — state/outcome/moves/member/trace identity.
+  * ``GET /result/<job>`` — the finished flux, bitwise: dtype + shape
+    + base64 of the raw little-endian buffer (json floats would be
+    fine too, but base64 is unambiguous and 4x smaller).  409 while
+    the job has no result yet.
+  * ``GET /progress/<job>?since=N&timeout=S`` — streams the job's
+    flight records as JSONL, one line per record, polling the fleet's
+    shared recorder until the job is terminal (or ``timeout`` seconds
+    pass).  Served with HTTP/1.0 connection-close framing — no
+    Content-Length, the closed socket ends the stream — so ``curl``
+    tails live progress with zero client smarts.
+  * ``POST /cancel`` — body ``{"job": id}``; answers
+    ``{"job": id, "cancelled": bool}`` (false: already terminal).
+  * ``GET /healthz`` — liveness for load balancers.
+
+Every path that embeds a job id validates it with the journal's
+``check_job_id`` BEFORE any filesystem name could be formed from it —
+a path-unsafe id is a 400, never a file probe.  Malformed JSON and
+validation failures are 400s with the reason in the body; unknown jobs
+are 404s; unknown paths answer 404 naming the valid endpoints (the
+exporter's teach-don't-stonewall rule).
+"""
+from __future__ import annotations
+
+import base64
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..utils.log import log_info
+from .journal import check_job_id, request_from_json
+
+#: Routes the 404 body teaches (the gateway's whole surface).
+ROUTES = (
+    "POST /submit", "POST /cancel", "GET /status/<job>",
+    "GET /result/<job>", "GET /progress/<job>", "GET /healthz",
+)
+
+
+class TallyGateway:
+    """One HTTP ingress bound to one ``FleetRouter`` (module docstring
+    API).  Handler threads and the router's scheduling loop serialize
+    on the router's lock — the gateway holds no job state of its own,
+    so everything a handler answers comes from (journaled) router
+    state."""
+
+    def __init__(self, router, port: int = 0, host: str = "127.0.0.1"):
+        self.router = router
+        gateway = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_POST(self):  # noqa: N802 — http.server API
+                path = self.path.split("?", 1)[0]
+                if path == "/submit":
+                    self._answer(gateway._submit(self._body()))
+                elif path == "/cancel":
+                    self._answer(gateway._cancel(self._body()))
+                else:
+                    self._unknown(path)
+
+            def do_GET(self):  # noqa: N802 — http.server API
+                path, _, query = self.path.partition("?")
+                if path == "/healthz":
+                    self._answer((200, {"ok": True}))
+                elif path.startswith("/status/"):
+                    self._answer(
+                        gateway._status(path[len("/status/"):])
+                    )
+                elif path.startswith("/result/"):
+                    self._answer(
+                        gateway._result(path[len("/result/"):])
+                    )
+                elif path.startswith("/progress/"):
+                    self._stream(path[len("/progress/"):], query)
+                else:
+                    self._unknown(path)
+
+            # -- plumbing ---------------------------------------- #
+            def _body(self) -> bytes:
+                length = int(self.headers.get("Content-Length") or 0)
+                return self.rfile.read(length)
+
+            def _answer(self, status_payload) -> None:
+                status, payload = status_payload
+                body = (
+                    json.dumps(payload, sort_keys=True) + "\n"
+                ).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _unknown(self, path: str) -> None:
+                self._answer((404, {
+                    "error": f"unknown path {path!r}",
+                    "routes": list(ROUTES),
+                }))
+
+            def _stream(self, job_id: str, query: str) -> None:
+                """JSONL progress stream (module docstring framing:
+                HTTP/1.0 connection-close, so no Content-Length and
+                the socket end IS the end of stream)."""
+                params = dict(
+                    kv.split("=", 1)
+                    for kv in query.split("&") if "=" in kv
+                )
+                try:
+                    check_job_id(job_id)
+                except ValueError as e:
+                    self._answer((400, {"error": str(e)}))
+                    return
+                try:
+                    since = int(params.get("since", -1))
+                    timeout = float(params.get("timeout", 30.0))
+                except ValueError as e:
+                    self._answer((400, {"error": f"bad query: {e}"}))
+                    return
+                try:
+                    records, terminal = gateway.router.progress(
+                        job_id, since
+                    )
+                except KeyError:
+                    self._answer(
+                        (404, {"error": f"unknown job {job_id!r}"})
+                    )
+                    return
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "application/jsonl"
+                )
+                self.end_headers()
+                deadline = time.monotonic() + timeout
+                while True:
+                    for rec in records:
+                        self.wfile.write(
+                            (json.dumps(rec, sort_keys=True,
+                                        default=str) + "\n").encode()
+                        )
+                        since = max(since, rec.get("seq", since))
+                    self.wfile.flush()
+                    if terminal or time.monotonic() > deadline:
+                        return
+                    time.sleep(0.05)
+                    try:
+                        records, terminal = gateway.router.progress(
+                            job_id, since
+                        )
+                    except KeyError:  # pragma: no cover - races a drop
+                        return
+
+            def log_message(self, *args):  # requests are not log events
+                pass
+
+        self._server = ThreadingHTTPServer((host, int(port)), _Handler)
+        self._server.daemon_threads = True
+        # stop() races between FleetRouter teardown paths and test
+        # finalizers; the flag flip must be atomic so exactly one
+        # caller runs the shutdown sequence (astlint PUMI007).
+        self._stop_lock = threading.Lock()
+        self._stopped = False  # guarded by: self._stop_lock
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name="pumi-tally-gateway",
+            daemon=True,
+        )
+        self._thread.start()
+        log_info(f"tally gateway serving at {self.url}")
+
+    # ------------------------------------------------------------------ #
+    # Route handlers (return (status, json-able payload))
+    # ------------------------------------------------------------------ #
+    def _submit(self, body: bytes):
+        try:
+            payload = json.loads(body.decode() or "null")
+        except ValueError as e:
+            return 400, {"error": f"body is not JSON: {e}"}
+        if not isinstance(payload, dict):
+            return 400, {"error": "body must be a JSON object"}
+        key = payload.pop("idempotency_key", None)
+        if key is not None and not isinstance(key, str):
+            return 400, {"error": "idempotency_key must be a string"}
+        # Path-unsafe ids are refused BEFORE request_from_json could
+        # hand them anywhere a filesystem name is formed.
+        job_id = payload.get("job_id")
+        if job_id is not None:
+            try:
+                check_job_id(str(job_id))
+            except ValueError as e:
+                return 400, {"error": str(e)}
+        try:
+            request = request_from_json(payload)
+        except (KeyError, TypeError, ValueError) as e:
+            return 400, {
+                "error": f"bad request: {type(e).__name__}: {e}"
+            }
+        try:
+            accepted = self.router.submit(
+                request, idempotency_key=key
+            )
+        except ValueError as e:
+            return 400, {"error": str(e)}
+        return 200, {"job": accepted}
+
+    def _cancel(self, body: bytes):
+        try:
+            payload = json.loads(body.decode() or "null")
+        except ValueError as e:
+            return 400, {"error": f"body is not JSON: {e}"}
+        if not isinstance(payload, dict) or "job" not in payload:
+            return 400, {"error": 'body must be {"job": <id>}'}
+        job_id = str(payload["job"])
+        try:
+            check_job_id(job_id)
+        except ValueError as e:
+            return 400, {"error": str(e)}
+        try:
+            cancelled = self.router.cancel(job_id)
+        except KeyError:
+            return 404, {"error": f"unknown job {job_id!r}"}
+        return 200, {"job": job_id, "cancelled": cancelled}
+
+    def _status(self, job_id: str):
+        try:
+            check_job_id(job_id)
+        except ValueError as e:
+            return 400, {"error": str(e)}
+        try:
+            job = self.router.job(job_id)
+        except KeyError:
+            return 404, {"error": f"unknown job {job_id!r}"}
+        return 200, {
+            "job": job.id,
+            "state": job.state,
+            "outcome": job.outcome,
+            "error": job.error,
+            "moves_done": job.moves_done,
+            "n_moves": int(job.request.n_moves),
+            "member": self.router.member_of(job_id),
+            "preemptions": job.preemptions,
+            "retries": job.retries,
+            "trace_id": job.trace_id,
+            "device_seconds": job.device_seconds,
+        }
+
+    def _result(self, job_id: str):
+        try:
+            check_job_id(job_id)
+        except ValueError as e:
+            return 400, {"error": str(e)}
+        try:
+            flux = self.router.result(job_id)
+        except KeyError:
+            return 404, {"error": f"unknown job {job_id!r}"}
+        except RuntimeError as e:
+            return 409, {"error": str(e)}
+        import numpy as np
+
+        arr = np.ascontiguousarray(flux)
+        le = arr.astype(arr.dtype.newbyteorder("<"), copy=False)
+        return 200, {
+            "job": job_id,
+            "dtype": str(arr.dtype),
+            "shape": list(arr.shape),
+            "data_b64": base64.b64encode(le.tobytes()).decode(),
+        }
+
+    # ------------------------------------------------------------------ #
+    @property
+    def port(self) -> int:
+        """The bound port (resolves port 0 to the ephemeral choice)."""
+        return self._server.server_address[1]
+
+    @property
+    def url(self) -> str:
+        host, port = self._server.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def stop(self) -> None:
+        """Shut the ingress down and release the socket (idempotent —
+        teardown paths and finalizers both call it)."""
+        with self._stop_lock:
+            if self._stopped:
+                return
+            self._stopped = True
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout=5)
+
+
+def decode_result(payload: dict):
+    """Reverse of ``GET /result``'s encoding — the client-side helper
+    tests and the chaos campaign use for bitwise comparison."""
+    import numpy as np
+
+    raw = base64.b64decode(payload["data_b64"])
+    arr = np.frombuffer(
+        raw, dtype=np.dtype(payload["dtype"]).newbyteorder("<")
+    )
+    return (
+        arr.astype(np.dtype(payload["dtype"]), copy=False)
+        .reshape(payload["shape"])
+    )

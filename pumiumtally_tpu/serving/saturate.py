@@ -166,3 +166,132 @@ def run_saturation(
     finally:
         if not crashed:
             sched.close()
+
+
+def run_fleet_saturation(
+    mesh,
+    config=None,
+    *,
+    fleet_dir: str,
+    n_members: int = 2,
+    port: int = 0,
+    bank=None,
+    n_jobs: int = 8,
+    class_sizes: tuple = (96, 192),
+    n_moves: int = 8,
+    seed: int = 0,
+    resume: bool = False,
+    faults=None,
+    absorb_member_kills: bool = False,
+    via_http: bool = True,
+    **member_kwargs,
+) -> dict:
+    """The fleet-path twin of ``run_saturation``: same synthetic
+    workload, but submitted through the NETWORK ingress (one POST per
+    job, each with an idempotency key) into a ``FleetRouter`` spread
+    over ``n_members`` schedulers, then drained.
+
+    Every submission carries ``idempotency_key="key-<job_id>"``, so
+    ``resume=True`` (the restart path of a killed router) simply
+    re-POSTs the whole workload — the journaled key map in FLEET.json
+    dedups every job the previous process already accepted, and the
+    re-POST storm is itself the idempotency proof the chaos campaign
+    leans on.  ``via_http=False`` calls ``router.submit`` directly
+    (the bench's probe, where HTTP overhead would pollute
+    ``jobs_per_sec``)."""
+    import json as _json
+    import os
+    import urllib.request
+
+    from .fleet import FLEET_FILE, FleetRouter
+    from .gateway import TallyGateway
+    from .journal import request_to_json
+
+    kwargs = dict(
+        bank=bank,
+        faults=faults,
+        absorb_member_kills=absorb_member_kills,
+        **member_kwargs,
+    )
+    if resume and os.path.exists(os.path.join(fleet_dir, FLEET_FILE)):
+        router = FleetRouter.recover(fleet_dir, mesh, config, **kwargs)
+    else:
+        router = FleetRouter(
+            mesh, config, fleet_dir=fleet_dir, n_members=n_members,
+            **kwargs,
+        )
+    gateway = TallyGateway(router, port=port) if via_http else None
+    crashed = False
+    try:
+        requests = synthetic_requests(
+            mesh, n_jobs, class_sizes=class_sizes, n_moves=n_moves,
+            seed=seed,
+        )
+        ids = []
+        for r in requests:
+            key = f"key-{r.job_id}"
+            if gateway is not None:
+                body = _json.dumps(
+                    dict(request_to_json(r), idempotency_key=key)
+                ).encode()
+                with urllib.request.urlopen(
+                    urllib.request.Request(
+                        f"{gateway.url}/submit", data=body,
+                        method="POST",
+                        headers={"Content-Type": "application/json"},
+                    ),
+                    timeout=30,
+                ) as resp:
+                    ids.append(_json.loads(resp.read())["job"])
+            else:
+                ids.append(router.submit(r, idempotency_key=key))
+        t0 = time.perf_counter()
+        try:
+            router.run()
+        except InjectedKill:
+            # A modeled ROUTER crash (no member absorbed it): recovery
+            # must work from FLEET.json + the member journals alone —
+            # abandon() releases device state without journal writes,
+            # like run_saturation's crash path.
+            crashed = True
+            router.abandon()
+            raise
+        elapsed = time.perf_counter() - t0
+        stats = router.stats()
+        per_job = [
+            {
+                "job": j.id,
+                "shape_key": j.shape_key,
+                "outcome": j.outcome,
+                "member": router.member_of(j.id),
+                "moves": j.moves_done,
+                "preemptions": j.preemptions,
+                "retries": j.retries,
+                "device_seconds": round(j.device_seconds, 4),
+                "trace_id": j.trace_id,
+                "error": j.error,
+            }
+            for j in (router.job(i) for i in ids)
+        ]
+        return {
+            "n_jobs": n_jobs,
+            "n_members": stats["members"],
+            "class_sizes": list(class_sizes),
+            "n_moves": n_moves,
+            "elapsed_s": round(elapsed, 4),
+            "jobs_per_sec": round(n_jobs / elapsed, 3),
+            "via_http": gateway is not None,
+            "fleet": stats,
+            "per_job": per_job,
+            # Raw flux per job id (bitwise-parity consumers; JSON
+            # writers drop the arrays first).
+            "results": {
+                i: router.result(i) for i in ids
+                if router.job(i).result is not None
+            },
+        }
+    finally:
+        if gateway is not None:
+            gateway.stop()
+        if not crashed:
+            router.close()

@@ -282,6 +282,8 @@ class TallyScheduler:
         faults: FaultInjector | None = None,
         handle_signals: bool = True,
         registry: MetricsRegistry | None = None,
+        tracer: SpanTracer | None = None,
+        recorder: FlightRecorder | None = None,
         sleep=time.sleep,
     ):
         self.mesh = mesh
@@ -341,12 +343,17 @@ class TallyScheduler:
         self.registry = (
             registry if registry is not None else MetricsRegistry()
         )
-        self.recorder = FlightRecorder(schema=FLIGHT_SCHEMA)
+        self.recorder = (
+            recorder if recorder is not None
+            else FlightRecorder(schema=FLIGHT_SCHEMA)
+        )
         # One tracer for the whole serving path (scheduler + bank +
         # coordinator share it via the ambient binding); journaled
         # schedulers stream spans to <journal_dir>/TRACE.jsonl so both
         # process lifetimes of a crashed server append to one stream.
-        self.tracer = SpanTracer(
+        # A fleet (serving/fleet.py) passes one shared tracer/recorder
+        # so every member streams into the SAME fleet-level spine.
+        self.tracer = tracer if tracer is not None else SpanTracer(
             sink=(
                 self.journal.trace_path()
                 if self.journal is not None else None
@@ -372,7 +379,8 @@ class TallyScheduler:
             "evicted early at the requested precision; poisoned: "
             "isolated after a persistent per-job failure or an "
             "exhausted retry budget; rejected: admission "
-            "backpressure at max_queued)",
+            "backpressure at max_queued; cancelled: terminated by "
+            "an explicit cancel request)",
         )
         self._queue_depth = r.gauge(
             "pumi_queue_depth",
@@ -406,7 +414,8 @@ class TallyScheduler:
             "pumi_jobs_recovered_total",
             "jobs re-queued from the JOBS.json journal at recovery "
             "(labeled by source: checkpoint = resumed mid-run, "
-            "scratch = request replayed from move 0)",
+            "scratch = request replayed from move 0, migrated = "
+            "adopted from another fleet member's journal)",
         )
         self._device_seconds = r.counter(
             "pumi_job_device_seconds",
@@ -560,6 +569,10 @@ class TallyScheduler:
     def queue_depth(self) -> int:
         return sum(len(q) for q in self._queues.values())
 
+    @property
+    def resident_count(self) -> int:
+        return len(self._resident)
+
     def _pop_next(self) -> Job | None:
         """Round-robin across shape-class queues."""
         if not self._class_order:
@@ -681,6 +694,28 @@ class TallyScheduler:
         return sched
 
     def _recover_job(self, entry: dict) -> None:
+        self._import_entry(entry, src_dir=None, link="recovered")
+
+    def _copy_sidefile(self, src: str, dst: str) -> bool:
+        """Copy one journal side file (checkpoint/flux) from another
+        member's journal directory into this one — atomically, so a
+        crash mid-migration never leaves a torn file under the real
+        name.  Returns False when the source is missing."""
+        if not os.path.exists(src):
+            return False
+        with open(src, "rb") as fh:
+            data = fh.read()
+        from ..utils.checkpoint import atomic_write_bytes
+
+        atomic_write_bytes(dst, data)
+        return True
+
+    def _import_entry(self, entry: dict, *, src_dir: str | None,
+                      link: str) -> Job:
+        """Rebuild one journaled job in this scheduler.  ``link`` names
+        the cross-lifetime trace event: ``recovered`` (same journal,
+        new process) or ``migrated`` (another member's journal — side
+        files are copied in from ``src_dir`` first)."""
         request = request_from_json(entry["request"])
         origins = np.asarray(request.origins, np.float64).reshape(-1, 3)
         n = origins.shape[0]
@@ -690,6 +725,11 @@ class TallyScheduler:
             self.mesh.ntet, padded_n, cfg.n_groups, cfg.dtype,
             getattr(self.mesh, "geo20", None) is not None,
         ).key()
+        if entry["id"] in self._jobs:
+            raise ValueError(
+                f"duplicate job id {entry['id']!r} (already owned by "
+                "this scheduler)"
+            )
         job = Job(
             entry["id"], request, n, padded_n, shape_key,
             index=int(entry["index"]),
@@ -712,12 +752,21 @@ class TallyScheduler:
             job.moves_done = int(entry.get("moves_done", 0))
             job.finished_s = job.submitted_s
             if entry.get("flux"):
+                if src_dir is not None:
+                    self._copy_sidefile(
+                        os.path.join(src_dir, entry["flux"]),
+                        self.journal.flux_path(job.id),
+                    )
                 job.result = self.journal.load_flux(job.id)
                 job.flux_name = entry["flux"]
-            return
+            return job
         source = "scratch"
         if entry.get("checkpoint"):
             ck = self.journal.checkpoint_path(job.id)
+            if src_dir is not None:
+                self._copy_sidefile(
+                    os.path.join(src_dir, entry["checkpoint"]), ck
+                )
             try:
                 verify_checkpoint(ck)
                 job.checkpoint = ck
@@ -734,20 +783,116 @@ class TallyScheduler:
                 )
         self._enqueue(job)
         self._n_recovered += 1
-        self._recovered_total.inc(source=source)
-        # The explicit cross-lifetime link: this span's pid differs
-        # from every span the crashed process emitted, and both parent
-        # onto the same deterministic root id.
+        self._recovered_total.inc(
+            source="migrated" if link == "migrated" else source
+        )
+        # The explicit cross-lifetime link: this span's pid (or, for a
+        # migration, member) differs from the spans the previous owner
+        # emitted, and both parent onto the same deterministic root id.
         self.tracer.event(
-            "recovered", trace_id=job.trace_id,
+            link, trace_id=job.trace_id,
             parent=SpanTracer.root_id(job.trace_id), job_id=job.id,
             source=source, moves_done=job.moves_done,
         )
         self.recorder.record(
             "journal_recovered", job=job.id, job_id=job.id,
-            shape_key=job.shape_key,
+            shape_key=job.shape_key, link=link,
             source=source, moves_done=job.moves_done,
         )
+        return job
+
+    # ------------------------------------------------------------------ #
+    # Cross-member migration primitives (serving/fleet.py)
+    # ------------------------------------------------------------------ #
+    def preempt_job(self, job_id: str) -> None:
+        """Checkpoint-preempt one RESIDENT job at its megastep boundary
+        (no-op for queued/preempted/terminal jobs) — the export half of
+        a cross-chip migration."""
+        job = self._jobs[job_id]
+        if job.state == RESIDENT:
+            self._preempt(job)
+
+    def export_entry(self, job_id: str) -> dict:
+        """This job's journal entry — exactly what recovery would read;
+        ``adopt_job`` on another member rebuilds the job from it."""
+        return self._journal_entry(self._jobs[job_id])
+
+    def adopt_job(self, entry: dict, *, src_dir: str | None = None) -> Job:
+        """Adopt one job journaled by ANOTHER fleet member (cross-chip
+        migration / dead-member re-placement): side files are copied
+        from ``src_dir`` into this journal, a pending job re-queues
+        from its checkpoint (bitwise — the move counter keys the RNG),
+        a done job lands terminal with its persisted flux, and the
+        trace continues across the hop with a ``migrated`` link.  The
+        adopted job is journaled here BEFORE the caller drops it from
+        the source member (write-ahead: two journals briefly know the
+        job; the fleet's assignment record names the owner)."""
+        if self.journal is None:
+            raise ValueError(
+                "adopt_job needs a journaled scheduler (fleet members "
+                "always journal)"
+            )
+        entry = dict(entry, index=self._n_submitted)
+        job = self._import_entry(entry, src_dir=src_dir, link="migrated")
+        self._n_submitted += 1
+        self._flush_journal()
+        return job
+
+    def drop_job(self, job_id: str) -> None:
+        """Forget one job after another member adopted it: remove it
+        from the queue and the journal document, then its side files
+        (record first, delete after — the same write-ahead edge as
+        every terminal transition).  Resident jobs must be
+        checkpoint-preempted (``preempt_job``) first."""
+        job = self._jobs[job_id]
+        if job.state == RESIDENT:
+            raise ValueError(
+                f"job {job_id} is resident — preempt_job() before "
+                "drop_job()"
+            )
+        q = self._queues.get(job.shape_key)
+        if q is not None and job in q:
+            q.remove(job)
+        del self._jobs[job_id]
+        self._queue_depth.set(self.queue_depth)
+        self._flush_journal()
+        if self.journal is not None:
+            self.journal.remove_sidefiles(job_id, flux=True)
+
+    def cancel(self, job_id: str) -> bool:
+        """Terminate one non-terminal job (outcome="cancelled"): free
+        its slot or queue position and journal the terminal record
+        before its checkpoint is removed.  Returns False when the job
+        is already terminal (cancel is idempotent, never un-finishes
+        work)."""
+        job = self._jobs[job_id]
+        if job.terminal:
+            return False
+        if job.tally is not None:
+            try:
+                job.tally.close()
+            except Exception:  # pragma: no cover - teardown best-effort
+                pass
+            job.tally = None
+        if job in self._resident:
+            self._resident.remove(job)
+        q = self._queues.get(job.shape_key)
+        if q is not None and job in q:
+            q.remove(job)
+        job.state = DONE
+        job.outcome = "cancelled"
+        job.finished_s = time.perf_counter()
+        self._jobs_total.inc(outcome="cancelled")
+        self._job_seconds.observe(job.finished_s - job.submitted_s)
+        self._queue_depth.set(self.queue_depth)
+        self._trace_terminal(job, "cancelled")
+        self.recorder.record(
+            "job_cancelled", job=job_id, job_id=job_id,
+            shape_key=job.shape_key, moves=job.moves_done,
+        )
+        self._flush_journal()
+        self._remove_checkpoint(job)
+        return True
 
     # ------------------------------------------------------------------ #
     # Preemption-signal flush (journaled schedulers only)

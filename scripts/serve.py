@@ -18,6 +18,15 @@ persistent AOT program bank and serve a synthetic many-job workload:
                                                    # every job from
                                                    # JOBS.json and
                                                    # drain bitwise
+  python scripts/serve.py --demo 8 --fleet 3 --port 0 --journal F/
+                                                   # multi-chip fleet:
+                                                   # N member
+                                                   # schedulers behind
+                                                   # the HTTP gateway,
+                                                   # FLEET.json routing
+                                                   # journal in F/
+                                                   # (--resume recovers
+                                                   # the whole fleet)
 
 The demo drives the SAME ``run_saturation`` workload driver bench.py's
 ``BENCH_SERVE`` probe uses, so the printed ``jobs_per_sec`` is
@@ -55,8 +64,9 @@ sys.path.insert(
 
 #: Outcomes that leave the exit code at 0.
 GOOD = ("completed", "converged")
-#: Outcomes that mean "job failed / shed, server healthy" — exit 3.
-ISOLATED = ("poisoned", "rejected")
+#: Outcomes that mean "job failed / shed / was told to stop, server
+#: healthy" — exit 3.
+ISOLATED = ("poisoned", "rejected", "cancelled")
 
 
 def main() -> int:
@@ -103,6 +113,14 @@ def main() -> int:
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--prom-port", type=int, default=None,
                     help="serve live Prometheus /metrics on this port")
+    ap.add_argument("--fleet", type=int, default=None, metavar="N",
+                    help="serve through a FleetRouter with N member "
+                         "schedulers behind the HTTP gateway (the "
+                         "multi-chip path; --journal names the fleet "
+                         "directory)")
+    ap.add_argument("--port", type=int, default=0, metavar="P",
+                    help="gateway ingress port with --fleet "
+                         "(default 0: ephemeral)")
     ap.add_argument("--out", default=None, help="also write JSON here")
     args = ap.parse_args()
 
@@ -110,9 +128,14 @@ def main() -> int:
         os.environ["PUMI_TPU_PROM_PORT"] = str(args.prom_port)
     if args.resume and not args.journal:
         ap.error("--resume needs --journal DIR")
+    if args.fleet is not None and args.fleet < 1:
+        ap.error("--fleet needs at least one member")
 
     from pumiumtally_tpu import TallyConfig, build_box
-    from pumiumtally_tpu.serving import run_saturation
+    from pumiumtally_tpu.serving import (
+        run_fleet_saturation,
+        run_saturation,
+    )
 
     mesh = build_box(
         1.0, 1.0, 1.0, args.cells, args.cells, args.cells,
@@ -134,27 +157,49 @@ def main() -> int:
     else:
         tmp_bank = bank = tempfile.mkdtemp(prefix="pumi_bank_")
     ck_dir = None
-    if args.preempt_after is not None and args.journal is None:
+    if (args.preempt_after is not None and args.journal is None
+            and args.fleet is None):
         tmp_ck = ck_dir = tempfile.mkdtemp(prefix="pumi_serve_ck_")
+    tmp_fleet = None
+    if args.fleet is not None and args.journal is None:
+        tmp_fleet = tempfile.mkdtemp(prefix="pumi_fleet_")
     try:
-        out = run_saturation(
-            mesh, cfg, bank=bank, n_jobs=args.demo,
-            class_sizes=tuple(
-                int(x) for x in args.classes.split(",")
-            ),
-            n_moves=args.moves, seed=args.seed,
-            max_resident=args.max_resident,
-            quantum_moves=args.quantum,
-            preempt_after=args.preempt_after,
-            checkpoint_dir=ck_dir,
-            max_queued=args.max_queued,
-            job_retries=args.retries,
-            quantum_deadline_s=args.deadline,
-            journal_dir=args.journal,
-            resume=args.resume,
-        )
+        if args.fleet is not None:
+            out = run_fleet_saturation(
+                mesh, cfg, bank=bank, n_jobs=args.demo,
+                fleet_dir=args.journal or tmp_fleet,
+                n_members=args.fleet, port=args.port,
+                class_sizes=tuple(
+                    int(x) for x in args.classes.split(",")
+                ),
+                n_moves=args.moves, seed=args.seed,
+                resume=args.resume,
+                max_resident=args.max_resident,
+                quantum_moves=args.quantum,
+                preempt_after=args.preempt_after,
+                max_queued=args.max_queued,
+                job_retries=args.retries,
+                quantum_deadline_s=args.deadline,
+            )
+        else:
+            out = run_saturation(
+                mesh, cfg, bank=bank, n_jobs=args.demo,
+                class_sizes=tuple(
+                    int(x) for x in args.classes.split(",")
+                ),
+                n_moves=args.moves, seed=args.seed,
+                max_resident=args.max_resident,
+                quantum_moves=args.quantum,
+                preempt_after=args.preempt_after,
+                checkpoint_dir=ck_dir,
+                max_queued=args.max_queued,
+                job_retries=args.retries,
+                quantum_deadline_s=args.deadline,
+                journal_dir=args.journal,
+                resume=args.resume,
+            )
     finally:
-        for d in (tmp_bank, tmp_ck):
+        for d in (tmp_bank, tmp_ck, tmp_fleet):
             if d is not None:
                 shutil.rmtree(d, ignore_errors=True)
     out.pop("results")  # raw flux arrays — not JSON material
@@ -177,19 +222,23 @@ def main() -> int:
         rc = 3  # jobs failed/shed in isolation; the server is healthy
     else:
         rc = 1
-    sched = out["scheduler"]
+    sched = out["fleet"] if args.fleet is not None else out["scheduler"]
     # The per-outcome summary line: always the LAST stdout line,
     # always one valid JSON object (chaos drivers parse it).
-    print(json.dumps({
-        "summary": {
-            "outcomes": outcomes,
-            "jobs": len(out["per_job"]),
-            "recovered": sched.get("recovered", 0),
-            "retries": sched.get("retries", 0),
-            "aot": sched.get("aot"),
-            "exit": rc,
-        }
-    }, sort_keys=True))
+    summary = {
+        "outcomes": outcomes,
+        "jobs": len(out["per_job"]),
+        "recovered": sched.get("recovered", 0),
+        "retries": sched.get("retries", 0),
+        "aot": sched.get("aot"),
+        "exit": rc,
+    }
+    if args.fleet is not None:
+        summary["members"] = sched["members"]
+        summary["alive"] = sched["alive"]
+        summary["placements"] = sched["placements"]
+        summary["migrations"] = sched["migrations"]
+    print(json.dumps({"summary": summary}, sort_keys=True))
     return rc
 
 

@@ -32,8 +32,11 @@ source may be any of:
 ``--check`` (with ``--job``) exits non-zero unless the job's trace is
 single and causally ordered — one trace_id, a submit, a terminal
 ``job`` root span, every parent resolvable — and, when spans come
-from more than one process lifetime, an explicit ``recovered`` link.
-The chaos campaign drives this as its postmortem acceptance gate.
+from more than one process lifetime, an explicit ``recovered`` (crash
+recovery) or ``migrated`` (cross-member fleet hop) link.  The chaos
+campaigns drive this as their postmortem acceptance gate; a FLEET
+directory works as a source too (the router sinks every member's
+spans into one ``<fleet_dir>/TRACE.jsonl``).
 
 Usage:
     python scripts/teleview.py run.metrics.jsonl
@@ -269,7 +272,9 @@ def job_trace(records: list[dict], job_id: str) -> list[dict]:
 def check_job_trace(trace: list[dict], job_id: str) -> list[str]:
     """Causal-integrity problems with one job's trace (empty = good):
     a single trace id; a submit record; a terminal ``job`` root span;
-    every parent resolvable; an explicit ``recovered`` link whenever
+    every parent resolvable; an explicit cross-lifetime link
+    (``recovered`` — crash recovery — or ``migrated`` — the job hopped
+    fleet members, and a member restart is a new lifetime) whenever
     spans come from more than one process lifetime."""
     problems = []
     if not trace:
@@ -294,10 +299,10 @@ def check_job_trace(trace: list[dict], job_id: str) -> list[str]:
     if dangling:
         problems.append(f"unresolvable parent span(s): {sorted(dangling)}")
     pids = {r.get("pid") for r in trace} - {None}
-    if len(pids) > 1 and "recovered" not in names:
+    if len(pids) > 1 and not {"recovered", "migrated"} & set(names):
         problems.append(
             f"spans from {len(pids)} process lifetimes but no "
-            "'recovered' link"
+            "'recovered'/'migrated' link"
         )
     return problems
 

@@ -1,0 +1,497 @@
+"""Serving-fleet contracts (the ISSUE 17 robustness tentpole:
+network ingress + multi-chip fleet with crash-safe routing and
+cross-chip migration).
+
+Contracts pinned here:
+
+  * IDEMPOTENT SUBMISSION — a resubmitted ``idempotency_key`` maps to
+    the ORIGINAL job id without touching any scheduler, and the proof
+    is journaled: FLEET.json's ``accepted`` map is flushed before the
+    job reaches a member (idempotency-record-before-accept), so the
+    dedup survives a router crash + recovery.
+  * PLACEMENT — jobs spread across members least-loaded-first, with
+    shape-class warmth as the tiebreak; per-member placement counts
+    balance for a uniform workload.
+  * MEMBER DEATH — ``kill_member`` re-places the dead member's
+    JOURNALED jobs onto survivors: zero lost, zero duplicated, and
+    survivors' fluxes stay bitwise vs the fault-free fleet.
+  * CROSS-CHIP MIGRATION — ``migrate`` checkpoint-preempts on the
+    source, adopts on the target, and the finished flux is bitwise vs
+    the uninterrupted fleet; the hop is observable (``migrated`` trace
+    link + ``pumi_jobs_recovered_total{source="migrated"}``).
+  * GATEWAY VALIDATION — malformed JSON and path-unsafe job ids are
+    400s before any filesystem name could be formed; unknown jobs are
+    404s; unknown paths teach the route list; cancel is idempotent
+    (false on terminal jobs) and a cancelled job's result is a 409.
+  * TORN ROUTING JOURNAL — an unreadable or wrong-schema FLEET.json
+    is rejected loudly (the atomic writer cannot tear it, so garbage
+    means foreign writes); recovery never silently re-runs over it.
+
+Compile budget: the fast core (-m 'not slow') keeps the routing /
+journal-grammar / gateway-validation tests — submission only enqueues,
+so none of them compile.  Everything that drains real quanta (bitwise
+migration / member-kill / recovery) is marked slow and runs in the CI
+fleet step beside scripts/chaos_fleet.py.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pumiumtally_tpu import TallyConfig, build_box
+from pumiumtally_tpu.serving import (
+    FleetJournal,
+    FleetRouter,
+    TallyGateway,
+    decode_result,
+    synthetic_requests,
+)
+from pumiumtally_tpu.serving.fleet import FLEET_FILE, FLEET_SCHEMA
+from pumiumtally_tpu.serving.journal import request_to_json
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_env(monkeypatch):
+    """Fleet contracts drive faults/ports explicitly — scrub any CI
+    sweep's env overrides (PUMI_TPU_FAULTS feeds the scheduler's
+    default injector; PROM_PORT would bind real sockets per router)."""
+    for var in (
+        "PUMI_TPU_MEGASTEP", "PUMI_TPU_KERNEL", "PUMI_TPU_IO_PIPELINE",
+        "PUMI_TPU_TUNING", "PUMI_TPU_AOT_FAULT", "PUMI_TPU_PROM_PORT",
+        "PUMI_TPU_FAULTS",
+    ):
+        monkeypatch.delenv(var, raising=False)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return build_box(1.0, 1.0, 1.0, 2, 2, 2)
+
+
+def _cfg(**kw):
+    return TallyConfig(tolerance=1e-6, **kw)
+
+
+def _router(tmp_path, mesh, n_members=2, **kw):
+    kw.setdefault("quantum_moves", 2)
+    kw.setdefault("max_resident", 2)
+    return FleetRouter(
+        mesh, _cfg(), fleet_dir=str(tmp_path / "fleet"),
+        n_members=n_members, bank=None, **kw,
+    )
+
+
+def _reference_results(tmp_path, mesh, requests, **kw):
+    """Fault-free fleet run of the same requests — the bitwise
+    reference the chaos'd fleets must match."""
+    ref = FleetRouter(
+        mesh, _cfg(), fleet_dir=str(tmp_path / "ref"), n_members=2,
+        bank=None, quantum_moves=2, max_resident=2, **kw,
+    )
+    try:
+        for r in requests:
+            ref.submit(r, idempotency_key=f"key-{r.job_id}")
+        ref.run()
+        return {r.job_id: np.asarray(ref.result(r.job_id)).copy()
+                for r in requests}
+    finally:
+        ref.close()
+
+
+# --------------------------------------------------------------------- #
+# Idempotent submission + the journaled proof
+# --------------------------------------------------------------------- #
+def test_idempotent_resubmit_same_id_and_journaled(tmp_path, mesh):
+    router = _router(tmp_path, mesh)
+    try:
+        req = synthetic_requests(mesh, 1, class_sizes=(24,))[0]
+        first = router.submit(req, idempotency_key="key-a")
+        # The SAME key resubmitted (even with a different payload —
+        # acceptance is decided by the journaled map alone) returns
+        # the original id and starts nothing new.
+        other = dataclasses.replace(req, job_id=None)
+        again = router.submit(other, idempotency_key="key-a")
+        assert again == first
+        assert len(router.jobs()) == 1
+        assert router.stats()["placements"] == {
+            "member-0": 1, "member-1": 0,
+        }
+        # The journaled proof: the accepted map is ON DISK (flushed
+        # before placement — idempotency-record-before-accept), so
+        # the dedup decision survives a router crash.
+        doc = FleetJournal(router.journal.dir).load()
+        assert doc["accepted"] == {"key-a": first}
+        assert first in doc["assignments"]
+        assert doc["n_submitted"] == 1
+    finally:
+        router.close()
+
+
+def test_submission_validation(tmp_path, mesh):
+    router = _router(tmp_path, mesh)
+    try:
+        req = synthetic_requests(mesh, 1, class_sizes=(24,))[0]
+        with pytest.raises(ValueError, match="journal-safe"):
+            router.submit(req, idempotency_key="../escape")
+        with pytest.raises(ValueError, match="journal-safe"):
+            router.submit(req, idempotency_key="")
+        router.submit(req)
+        with pytest.raises(ValueError, match="duplicate job id"):
+            router.submit(req)  # same explicit job_id
+        # A rejected request must NOT journal its key: the next use
+        # of the key is a fresh acceptance, not a dedup hit.
+        doc = FleetJournal(router.journal.dir).load()
+        assert doc["accepted"] == {}
+    finally:
+        router.close()
+
+
+# --------------------------------------------------------------------- #
+# Placement
+# --------------------------------------------------------------------- #
+def test_placement_balances_across_members(tmp_path, mesh):
+    router = _router(tmp_path, mesh, n_members=4)
+    try:
+        for r in synthetic_requests(mesh, 8, class_sizes=(24,)):
+            router.submit(r)
+        placed = [m.placed for m in router.members]
+        assert placed == [2, 2, 2, 2]
+        owners = {router.member_of(f"sat-{i:04d}") for i in range(8)}
+        assert owners == {0, 1, 2, 3}
+    finally:
+        router.close()
+
+
+def test_placement_prefers_warm_member_on_load_tie(tmp_path, mesh):
+    router = _router(tmp_path, mesh, n_members=2)
+    try:
+        reqs = synthetic_requests(mesh, 3, class_sizes=(24, 130, 24))
+        assert router.member_of(router.submit(reqs[0])) == 0
+        assert router.member_of(router.submit(reqs[1])) == 1
+        # Load tie (1 job each) — member 0 is warm for the small
+        # class, so warmth breaks the tie in its favor.
+        assert router.member_of(router.submit(reqs[2])) == 0
+    finally:
+        router.close()
+
+
+# --------------------------------------------------------------------- #
+# Torn / foreign routing journal
+# --------------------------------------------------------------------- #
+def test_torn_fleet_journal_rejected(tmp_path, mesh):
+    fdir = tmp_path / "torn"
+    fdir.mkdir()
+    (fdir / FLEET_FILE).write_text('{"schema": 1, "members": 2, "acc')
+    with pytest.raises(ValueError, match="not valid JSON"):
+        FleetJournal(str(fdir)).load()
+    with pytest.raises(ValueError, match="not valid JSON"):
+        FleetRouter.recover(str(fdir), mesh, _cfg())
+
+
+def test_wrong_schema_fleet_journal_rejected(tmp_path, mesh):
+    fdir = tmp_path / "schema"
+    fdir.mkdir()
+    (fdir / FLEET_FILE).write_text(
+        json.dumps({"schema": FLEET_SCHEMA + 1, "members": 2})
+    )
+    with pytest.raises(ValueError, match="schema"):
+        FleetJournal(str(fdir)).load()
+
+
+def test_recover_without_journal_rejected(tmp_path, mesh):
+    with pytest.raises(ValueError, match="nothing to recover"):
+        FleetRouter.recover(str(tmp_path / "empty"), mesh, _cfg())
+
+
+# --------------------------------------------------------------------- #
+# Gateway validation + cancel semantics (no quanta run: every job
+# stays queued, so none of this compiles)
+# --------------------------------------------------------------------- #
+def _get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=30) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _post(url, body: bytes):
+    req = urllib.request.Request(
+        url, data=body, headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_gateway_validation_and_cancel(tmp_path, mesh):
+    router = _router(tmp_path, mesh)
+    gateway = TallyGateway(router, port=0)
+    try:
+        url = gateway.url
+        assert _get(f"{url}/healthz") == (200, {"ok": True})
+
+        # Malformed / non-object bodies.
+        status, body = _post(f"{url}/submit", b"{not json")
+        assert status == 400 and "not JSON" in body["error"]
+        status, body = _post(f"{url}/submit", b"[1, 2]")
+        assert status == 400 and "JSON object" in body["error"]
+
+        # Path-unsafe ids are refused before any filesystem name
+        # could be formed from them (journal-grammar check_job_id).
+        wire = request_to_json(
+            synthetic_requests(mesh, 1, class_sizes=(24,))[0]
+        )
+        evil = dict(wire, job_id="..")
+        status, body = _post(
+            f"{url}/submit", json.dumps(evil).encode()
+        )
+        assert status == 400
+        status, body = _post(
+            f"{url}/submit",
+            json.dumps(dict(wire, idempotency_key=7)).encode(),
+        )
+        assert status == 400 and "idempotency_key" in body["error"]
+        status, body = _post(
+            f"{url}/submit",
+            json.dumps({"n_moves": 4, "source": {}}).encode(),
+        )
+        assert status == 400 and "bad request" in body["error"]
+        # Over-long id in a GET path: rejected as a 400, not probed.
+        status, body = _get(f"{url}/status/{'a' * 200}")
+        assert status == 400
+        status, _ = _get(f"{url}/result/{'a' * 200}")
+        assert status == 400
+
+        # Unknown jobs and unknown paths.
+        status, body = _get(f"{url}/status/never-submitted")
+        assert status == 404
+        status, body = _get(f"{url}/nope")
+        assert status == 404 and "POST /submit" in body["routes"]
+
+        # A real submission: idempotent retry over the wire, then
+        # status / premature result / cancel semantics.
+        accepted = json.dumps(
+            dict(wire, idempotency_key="key-g")
+        ).encode()
+        status, body = _post(f"{url}/submit", accepted)
+        assert status == 200
+        job = body["job"]
+        status, body = _post(f"{url}/submit", accepted)
+        assert (status, body["job"]) == (200, job)
+        assert len(router.jobs()) == 1
+
+        status, body = _get(f"{url}/status/{job}")
+        assert status == 200
+        assert body["state"] == "queued" and body["member"] == 0
+        status, body = _get(f"{url}/result/{job}")
+        assert status == 409  # no result yet — not an unknown job
+
+        status, body = _post(f"{url}/cancel", b'{"job": "ghost"}')
+        assert status == 404
+        status, body = _post(f"{url}/cancel", b"{}")
+        assert status == 400
+        status, body = _post(
+            f"{url}/cancel", json.dumps({"job": job}).encode()
+        )
+        assert (status, body["cancelled"]) == (200, True)
+        # Idempotent: a second cancel reports false, never un-finishes.
+        status, body = _post(
+            f"{url}/cancel", json.dumps({"job": job}).encode()
+        )
+        assert (status, body["cancelled"]) == (200, False)
+        status, body = _get(f"{url}/status/{job}")
+        assert body["outcome"] == "cancelled"
+        status, body = _get(f"{url}/result/{job}")
+        assert status == 409
+    finally:
+        gateway.stop()
+        router.close()
+
+
+def test_exporter_mounts_fleet_endpoint(tmp_path, mesh, monkeypatch):
+    monkeypatch.setenv("PUMI_TPU_PROM_PORT", "0")
+    router = _router(tmp_path, mesh)
+    try:
+        assert router._exporter is not None
+        base = f"http://127.0.0.1:{router._exporter.port}"
+        with urllib.request.urlopen(f"{base}/buildz", timeout=30) as r:
+            info = json.loads(r.read())
+        assert "/fleet" in info["endpoints"]
+        with urllib.request.urlopen(f"{base}/fleet", timeout=30) as r:
+            fleet = json.loads(r.read())
+        assert [m["member"] for m in fleet["members"]] == [0, 1]
+        assert all(m["alive"] for m in fleet["members"])
+        # Unknown scrape paths teach the mounted surface.
+        try:
+            urllib.request.urlopen(f"{base}/missing", timeout=30)
+            raise AssertionError("expected 404")
+        except urllib.error.HTTPError as e:
+            assert e.code == 404 and "/fleet" in e.read().decode()
+    finally:
+        router.close()
+
+
+# --------------------------------------------------------------------- #
+# The slow half: real quanta — migration, member death, recovery
+# --------------------------------------------------------------------- #
+@pytest.mark.slow
+def test_migration_bitwise_vs_uninterrupted(tmp_path, mesh):
+    requests = synthetic_requests(
+        mesh, 2, class_sizes=(24,), n_moves=8,
+    )
+    ref = _reference_results(tmp_path, mesh, requests)
+    router = _router(tmp_path, mesh)
+    try:
+        for r in requests:
+            router.submit(r, idempotency_key=f"key-{r.job_id}")
+        router.step()
+        moving = next(j for j in router.jobs() if not j.terminal)
+        src = router.member_of(moving.id)
+        dst = router.migrate(moving.id)
+        assert dst != src
+        assert router.member_of(moving.id) == dst
+        router.run()
+        for r in requests:
+            assert np.array_equal(
+                np.asarray(router.result(r.job_id)), ref[r.job_id]
+            ), f"{r.job_id} not bitwise across migration"
+        stats = router.stats()
+        assert stats["migrations"] == 1
+        assert stats["outcomes"] == {"completed": 2}
+        # The hop is observable: the migrated-source recovery counter
+        # and the cross-member trace link both fire exactly once.
+        assert router.registry.counter(
+            "pumi_jobs_recovered_total"
+        ).value(source="migrated") == 1
+        trace = [
+            json.loads(line)
+            for line in open(router.journal.trace_path())
+            if line.strip()
+        ]
+        links = [t for t in trace if t.get("name") == "migrated"]
+        assert [t["job_id"] for t in links] == [moving.id]
+    finally:
+        router.close()
+
+
+@pytest.mark.slow
+def test_member_kill_zero_lost_zero_duplicated(tmp_path, mesh):
+    requests = synthetic_requests(
+        mesh, 6, class_sizes=(24,), n_moves=6,
+    )
+    ref = _reference_results(tmp_path, mesh, requests)
+    router = _router(tmp_path, mesh, n_members=3)
+    try:
+        for r in requests:
+            router.submit(r, idempotency_key=f"key-{r.job_id}")
+        router.step()
+        victim_jobs = [
+            r.job_id for r in requests if router.member_of(r.job_id) == 0
+        ]
+        assert victim_jobs  # placement spread means member 0 owns some
+        router.kill_member(0)
+        assert not router.members[0].alive
+        assert router.registry.gauge("pumi_fleet_members").value() == 2
+        assert router.registry.gauge(
+            "pumi_fleet_queue_depth"
+        ).value(member="m0") == 0
+        for jid in victim_jobs:
+            assert router.member_of(jid) != 0
+        router.run()
+        # Zero lost, zero duplicated: every accepted job is owned by
+        # exactly one alive member (jobs() walks all alive members, so
+        # a stale duplicate would surface as a repeated id).
+        ids = sorted(j.id for j in router.jobs())
+        assert ids == sorted(r.job_id for r in requests)
+        for r in requests:
+            assert np.array_equal(
+                np.asarray(router.result(r.job_id)), ref[r.job_id]
+            ), f"{r.job_id} not bitwise across member death"
+        stats = router.stats()
+        assert stats["alive"] == 2
+        assert stats["outcomes"] == {"completed": 6}
+        assert stats["migrations"] >= len(victim_jobs)
+    finally:
+        router.close()
+
+
+@pytest.mark.slow
+def test_recovery_preserves_idempotency_keys(tmp_path, mesh):
+    requests = synthetic_requests(
+        mesh, 4, class_sizes=(24,), n_moves=6,
+    )
+    ref = _reference_results(tmp_path, mesh, requests)
+    fdir = str(tmp_path / "fleet")
+    router = FleetRouter(
+        mesh, _cfg(), fleet_dir=fdir, n_members=2, bank=None,
+        quantum_moves=2, max_resident=2,
+    )
+    accepted = {}
+    for r in requests:
+        accepted[r.job_id] = router.submit(
+            r, idempotency_key=f"key-{r.job_id}"
+        )
+    router.step()
+    router.abandon()  # crash model: no graceful flush
+    router = FleetRouter.recover(
+        fdir, mesh, _cfg(), bank=None,
+        quantum_moves=2, max_resident=2,
+    )
+    try:
+        # The client's retry storm after the crash: every key maps to
+        # its pre-crash id (the journaled map is the arbiter) and no
+        # second execution starts.
+        for r in requests:
+            assert router.submit(
+                r, idempotency_key=f"key-{r.job_id}"
+            ) == accepted[r.job_id]
+        assert len(router.jobs()) == len(requests)
+        router.run()
+        for r in requests:
+            assert np.array_equal(
+                np.asarray(router.result(r.job_id)), ref[r.job_id]
+            ), f"{r.job_id} not bitwise across router recovery"
+        stats = router.stats()
+        assert stats["recovered"] >= 1
+        assert stats["outcomes"] == {"completed": len(requests)}
+    finally:
+        router.close()
+
+
+@pytest.mark.slow
+def test_result_roundtrip_bitwise_over_http(tmp_path, mesh):
+    requests = synthetic_requests(
+        mesh, 2, class_sizes=(24,), n_moves=4,
+    )
+    router = _router(tmp_path, mesh)
+    gateway = TallyGateway(router, port=0)
+    try:
+        for r in requests:
+            wire = dict(
+                request_to_json(r), idempotency_key=f"key-{r.job_id}"
+            )
+            status, body = _post(
+                f"{gateway.url}/submit", json.dumps(wire).encode()
+            )
+            assert (status, body["job"]) == (200, r.job_id)
+        router.run()
+        for r in requests:
+            status, body = _get(f"{gateway.url}/result/{r.job_id}")
+            assert status == 200
+            assert np.array_equal(
+                decode_result(body), np.asarray(router.result(r.job_id))
+            ), "HTTP result payload not bitwise vs in-process flux"
+    finally:
+        gateway.stop()
+        router.close()

@@ -514,6 +514,7 @@ CKPT = "pumiumtally_tpu/utils/checkpoint.py"
 _CRASH_SAFETY_MODULES = (
     "pumiumtally_tpu/serving/scheduler.py",
     "pumiumtally_tpu/serving/journal.py",
+    "pumiumtally_tpu/serving/fleet.py",
     "pumiumtally_tpu/resilience/runner.py",
     "pumiumtally_tpu/resilience/store.py",
     "pumiumtally_tpu/utils/checkpoint.py",
