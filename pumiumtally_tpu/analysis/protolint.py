@@ -108,6 +108,11 @@ _SIMPLE_EFFECTS = {
     "_flush_fleet": "fleet.record",
     "_place": "job.place",
     "_dispatch_job": "job.dispatch",
+    # Supervisor eviction (serving/supervisor.py): the FLEET.json
+    # eviction record and the two drain flavors it must precede.
+    "record_eviction": "eviction.record",
+    "drain_member": "member.drain",
+    "drain_member_from_journal": "member.drain",
 }
 
 #: fully-dotted deletion heads (``remove`` alone would match
@@ -381,6 +386,30 @@ PROTOCOLS: tuple[Protocol, ...] = (
             "on restart the router would place it AGAIN elsewhere "
             "(double-run), and migration's adopt-before-drop overlap "
             "would have no arbiter naming which copy survives."
+        ),
+    ),
+    Protocol(
+        name="eviction-record-before-drain",
+        path=f"{PACKAGE}/serving/supervisor.py",
+        function="FleetSupervisor._evict",
+        constraints=(
+            {"kind": "require", "effect": "eviction.record"},
+            {"kind": "require", "effect": "member.drain"},
+            {"kind": "before", "before": "eviction.record",
+             "after": "member.drain", "required": True},
+        ),
+        rationale=(
+            "The FLEET.json eviction record is flushed BEFORE the "
+            "member's jobs are drained onto survivors.  A supervisor "
+            "crash mid-drain then leaves a journaled eviction whose "
+            "drain recovery replays from the member's on-disk "
+            "journal (assignments arbitrate the already-moved "
+            "copies).  Reversed, a crash after the drain but before "
+            "the record leaves re-placed jobs under a member the "
+            "routing journal still calls healthy — recovery would "
+            "rebuild its device state and re-adopt jobs that now "
+            "live (and run) elsewhere: the double-run the eviction "
+            "machinery exists to rule out."
         ),
     ),
     Protocol(

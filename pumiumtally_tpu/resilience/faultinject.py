@@ -81,6 +81,24 @@ Grammar: comma-separated ``name[:value]`` clauses —
                           injector (the restarted process is a new
                           one); recovery is the JOBS.json journal's
                           ``TallyScheduler.recover`` path;
+  ``wedge_member:M``      fleet member M stops answering health probes
+                          but HOLDS its jobs (no raise, no progress) —
+                          the silent-wedge failure mode only the
+                          supervisor's missed-heartbeat detection can
+                          see (serving/supervisor.py). Persists until
+                          the injector is swapped out;
+  ``slow_member:M:F``     fleet member M's scheduling quanta run F×
+                          their natural wall time (host-side injected
+                          latency; device results are untouched, so
+                          the job stays bitwise) — a brownout the
+                          supervisor's latency SLO must flag without
+                          false-positively evicting;
+  ``disk_full_at:N``      the N-th durable write this injector gates
+                          (journal flush, flux persist, quantum
+                          checkpoint) — and every one after it, the
+                          disk stays full — raises an ENOSPC OSError;
+                          the journal must degrade instead of crash
+                          (serving/journal.py);
   ``seed:S``              rng seed for nan_src lane choice (default 0).
 
 The PR 2 modes (nan_src/die/transient/corrupt_ckpt) are driven by the
@@ -95,6 +113,7 @@ call its hooks unconditionally.
 from __future__ import annotations
 
 import dataclasses
+import errno
 import os
 
 import numpy as np
@@ -160,6 +179,10 @@ class FaultPlan:
     poison_job: int | None = None
     transient_quantum: int | None = None
     kill_server_at_quantum: int | None = None
+    wedge_member: int | None = None
+    slow_member: int | None = None
+    slow_factor: float = 1.0
+    disk_full_at: int | None = None
     seed: int = 0
 
     def any(self) -> bool:
@@ -177,6 +200,9 @@ class FaultPlan:
             or self.poison_job is not None
             or self.transient_quantum is not None
             or self.kill_server_at_quantum is not None
+            or self.wedge_member is not None
+            or self.slow_member is not None
+            or self.disk_full_at is not None
         )
 
 
@@ -236,6 +262,23 @@ def parse_faults(spec: str) -> FaultPlan:
                     "kill_server_at_quantum counts quanta from 1: "
                     f"{value!r}"
                 )
+        elif name == "wedge_member":
+            fields["wedge_member"] = int(value)
+        elif name == "slow_member":
+            member, _, factor = value.partition(":")
+            fields["slow_member"] = int(member)
+            fields["slow_factor"] = float(factor) if factor else 4.0
+            if fields["slow_factor"] < 1.0:
+                raise ValueError(
+                    f"slow_member factor must be >= 1: {value!r}"
+                )
+        elif name == "disk_full_at":
+            fields["disk_full_at"] = int(value)
+            if fields["disk_full_at"] < 1:
+                raise ValueError(
+                    f"disk_full_at counts durable writes from 1: "
+                    f"{value!r}"
+                )
         elif name == "seed":
             fields["seed"] = int(value)
         else:
@@ -245,7 +288,8 @@ def parse_faults(spec: str) -> FaultPlan:
                 f"corrupt_ckpt, bitflip_flux, sdc_walk, hang_at_move, "
                 f"hang_seconds, chip_down_at_move, chip, "
                 f"preempt_at_move, torn_shard, poison_job, "
-                f"transient_quantum, kill_server_at_quantum, seed)"
+                f"transient_quantum, kill_server_at_quantum, "
+                f"wedge_member, slow_member, disk_full_at, seed)"
             )
     return FaultPlan(**fields)
 
@@ -280,6 +324,7 @@ class FaultInjector:
         self._torn_fired = False
         self._quantum_transient_fired = False
         self._server_killed = False
+        self._durable_writes = 0
 
     # ------------------------------------------------------------------ #
     def maybe_die(self, move: int) -> None:
@@ -381,6 +426,47 @@ class FaultInjector:
             raise InjectedKill(
                 f"injected server kill at quantum {quantum} "
                 f"(PUMI_TPU_FAULTS kill_server_at_quantum)"
+            )
+
+    # -- fleet-supervisor hooks (per-MEMBER fault targeting) ----------- #
+    def member_wedged(self, member_index: int | None) -> bool:
+        """``wedge_member:M``: True while member M is wedged — it
+        answers no health probe and makes no progress, but holds its
+        jobs and device state. Not once-only: a wedge persists until
+        the member's injector is replaced (chaos harnesses model
+        un-wedging by swapping in a clean injector)."""
+        return (
+            self.plan.wedge_member is not None
+            and member_index == self.plan.wedge_member
+        )
+
+    def slow_quantum_extra(
+        self, member_index: int | None, base_s: float
+    ) -> float:
+        """``slow_member:M:F``: extra host-side seconds to sleep after
+        member M's quantum so the quantum's wall time is ~F× its
+        natural duration. Device results are untouched — the brownout
+        is pure latency, and the job stays bitwise."""
+        if (
+            self.plan.slow_member is None
+            or member_index != self.plan.slow_member
+        ):
+            return 0.0
+        return max(0.0, (self.plan.slow_factor - 1.0) * float(base_s))
+
+    def maybe_disk_full(self) -> None:
+        """``disk_full_at:N``: the N-th durable write this injector
+        gates — and every write after it; an injected full disk stays
+        full — raises an ENOSPC ``OSError``. The journal layer must
+        convert it into degraded mode, never a crash."""
+        if self.plan.disk_full_at is None:
+            return
+        self._durable_writes += 1
+        if self._durable_writes >= self.plan.disk_full_at:
+            raise OSError(
+                errno.ENOSPC,
+                f"injected disk full at durable write "
+                f"{self._durable_writes} (PUMI_TPU_FAULTS disk_full_at)",
             )
 
     def bitflip_at(self, move: int) -> bool:
@@ -516,6 +602,10 @@ class ChaosPlan:
     poison_job: int | None = None
     transient_quantum: int | None = None
     kill_server_at_quantum: int | None = None
+    wedge_member: int | None = None
+    slow_member: int | None = None
+    slow_factor: float = 1.0
+    disk_full_at: int | None = None
     seed: int = 0
 
     def describe(self) -> str:
@@ -536,6 +626,14 @@ class ChaosPlan:
             bits.append(f"transient_quantum@job{self.transient_quantum}")
         if self.kill_server_at_quantum is not None:
             bits.append(f"kill_server@q{self.kill_server_at_quantum}")
+        if self.wedge_member is not None:
+            bits.append(f"wedge_member@{self.wedge_member}")
+        if self.slow_member is not None:
+            bits.append(
+                f"slow_member@{self.slow_member}x{self.slow_factor:g}"
+            )
+        if self.disk_full_at is not None:
+            bits.append(f"disk_full@write{self.disk_full_at}")
         return " ".join(bits)
 
 
@@ -554,6 +652,9 @@ def chaos_plan(spec: str, n_moves: int) -> ChaosPlan:
       ``poison_job:K``  job index K is poison (serving campaigns);
       ``transient_quantum:K``  one transient on job K's next quantum;
       ``kill_server:Q`` the server dies before its Q-th quantum;
+      ``wedge_member:M``  fleet member M silently wedges;
+      ``slow_member:M:F`` fleet member M runs F× slower (default 4×);
+      ``disk_full:N``   member-local disk fills at durable write N;
       ``seed:S``        the schedule seed (default 0).
 
     Same spec + seed + n_moves → the same schedule, so a chaos soak
@@ -561,6 +662,8 @@ def chaos_plan(spec: str, n_moves: int) -> ChaosPlan:
     counts = {"transients": 0, "chip_down": 0, "preempt": 0}
     chip, torn, seed = -1, None, 0
     poison_job = transient_quantum = kill_server = None
+    wedge_member = slow_member = disk_full = None
+    slow_factor = 1.0
     for clause in filter(None, (c.strip() for c in spec.split(","))):
         name, _, value = clause.partition(":")
         if name in counts:
@@ -575,13 +678,22 @@ def chaos_plan(spec: str, n_moves: int) -> ChaosPlan:
             transient_quantum = int(value)
         elif name == "kill_server":
             kill_server = int(value)
+        elif name == "wedge_member":
+            wedge_member = int(value)
+        elif name == "slow_member":
+            member, _, factor = value.partition(":")
+            slow_member = int(member)
+            slow_factor = float(factor) if factor else 4.0
+        elif name == "disk_full":
+            disk_full = int(value)
         elif name == "seed":
             seed = int(value)
         else:
             raise ValueError(
                 f"unknown chaos clause {name!r} (known: transients, "
                 "chip_down, chip, preempt, torn, poison_job, "
-                "transient_quantum, kill_server, seed)"
+                "transient_quantum, kill_server, wedge_member, "
+                "slow_member, disk_full, seed)"
             )
     rng = np.random.default_rng([987654321, seed])
     # Faults land in [2, n_moves-1]: move 1 establishes a good state
@@ -611,6 +723,10 @@ def chaos_plan(spec: str, n_moves: int) -> ChaosPlan:
         poison_job=poison_job,
         transient_quantum=transient_quantum,
         kill_server_at_quantum=kill_server,
+        wedge_member=wedge_member,
+        slow_member=slow_member,
+        slow_factor=slow_factor,
+        disk_full_at=disk_full,
         seed=seed,
     )
 
@@ -630,6 +746,10 @@ class ChaosInjector(FaultInjector):
             poison_job=plan.poison_job,
             transient_quantum=plan.transient_quantum,
             kill_server_at_quantum=plan.kill_server_at_quantum,
+            wedge_member=plan.wedge_member,
+            slow_member=plan.slow_member,
+            slow_factor=plan.slow_factor,
+            disk_full_at=plan.disk_full_at,
         ))
         self.chaos = plan
         self._fired_transients: set[int] = set()

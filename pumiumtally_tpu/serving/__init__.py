@@ -12,7 +12,10 @@ write-ahead journal (``SchedulerJournal``, ``TallyScheduler.recover``)
 so a killed server resumes every job bitwise; ``FleetRouter`` owns one
 scheduler per device behind a write-ahead ``FLEET.json`` routing
 journal (idempotent acceptance, crash-safe placement, cross-chip
-migration, member-death absorption); ``TallyGateway`` is the network
+migration, member-death absorption); ``FleetSupervisor`` closes the
+detect-decide-drain loop over it (health-probe-driven eviction,
+brownout quarantine, disk-pressure drain — serving/supervisor.py);
+``TallyGateway`` is the network
 ingress in front of it; ``run_saturation`` / ``run_fleet_saturation``
 are the shared many-job workload drivers behind scripts/serve.py and
 bench.py's ``BENCH_SERVE`` / ``BENCH_FLEET`` probes.
@@ -27,11 +30,13 @@ from .saturate import (
     synthetic_requests,
 )
 from .scheduler import JobRequest, TallyScheduler
+from .supervisor import FleetSupervisor
 
 __all__ = [
     "FleetJournal",
     "FleetMember",
     "FleetRouter",
+    "FleetSupervisor",
     "JobRequest",
     "ProgramBank",
     "SchedulerJournal",

@@ -23,7 +23,8 @@ FLEET.json document (schema 1)::
    "accepted":    {idempotency_key: job_id},
    "requests":    {job_id: request_json},   # journaled, not yet
                                             # dispatched to a member
-   "assignments": {job_id: {"member": K, "migrations": J}}}
+   "assignments": {job_id: {"member": K, "migrations": J}},
+   "evicted":     {member_index: {"cause": ...}}}  # supervisor evictions
 
 Write-ahead orderings (machine-checked by analysis/protolint.py, not
 chaos-only):
@@ -42,6 +43,14 @@ chaos-only):
     still journaled).  Reversed, a crash after dispatch but before
     the record would leave a job some member owns that the router
     cannot attribute — double-run fodder on restart.
+  * **eviction-record-before-drain** (``FleetSupervisor._evict``,
+    serving/supervisor.py): the ``evicted[member] = cause`` record is
+    flushed to FLEET.json BEFORE the member's jobs are drained onto
+    survivors.  A supervisor crash mid-drain leaves a journaled
+    eviction whose member may still hold jobs — recovery replays the
+    drain from the evicted member's on-disk journal
+    (``_replace_from_disk``), with the assignment record arbitrating
+    the copies exactly as for an interrupted migration.
 
 The assignment record is also the DUPLICATE arbiter: migration adopts
 a job on member B before dropping it from member A (so a crash between
@@ -148,12 +157,28 @@ class FleetJournal:
 class FleetMember:
     """One device slot: a journaled TallyScheduler plus the router's
     placement view of it (liveness, lifetime placements, which shape
-    classes it has already served — the warmth signal)."""
+    classes it has already served — the warmth signal, plus the
+    supervisor's health view).
 
-    def __init__(self, index: int, scheduler: TallyScheduler):
+    ``scheduler`` may be None for a member the routing journal records
+    as EVICTED: recovery keeps the slot (member indices are stable —
+    FLEET.json assignments reference them) but never rebuilds device
+    state for it.  Every ``.scheduler`` access in the router is
+    guarded by ``.alive``, which is False for such a slot.
+    """
+
+    def __init__(self, index: int, scheduler: TallyScheduler | None):
         self.index = index
         self.scheduler = scheduler
-        self.alive = True
+        self.alive = scheduler is not None
+        #: Supervisor classification: healthy / brownout / wedged /
+        #: disk-pressured while alive; "evicted" once drained
+        #: (serving/supervisor.py owns the transitions).
+        self.health = "healthy" if scheduler is not None else "evicted"
+        #: Quarantined members stop receiving NEW placements (the
+        #: supervisor's grace period before eviction) but keep running
+        #: the jobs they hold.
+        self.quarantined = False
         self.placed = 0            # jobs dispatched here (lifetime)
         self.warm: set[str] = set()  # shape classes served here
 
@@ -186,6 +211,7 @@ class FleetRouter:
         faults: FaultInjector | None = None,
         absorb_member_kills: bool = False,
         _recover: bool = False,
+        _evicted: tuple = (),
         **member_kwargs,
     ):
         if int(n_members) < 1:
@@ -227,6 +253,7 @@ class FleetRouter:
         self._requests: dict[str, dict] = {}    # journaled, undispatched
         self._pending: dict[str, JobRequest] = {}
         self._assignments: dict[str, dict] = {}
+        self._evicted: dict[int, dict] = {}     # member index -> {cause}
         self._n_submitted = 0
         # Members never bind the scrape port (the ROUTER's exporter
         # owns it, with the fleet endpoints mounted) and never install
@@ -234,6 +261,11 @@ class FleetRouter:
         # every transition; recovery needs no graceful flush).
         self.members: list[FleetMember] = []
         for i in range(int(n_members)):
+            if i in _evicted:
+                # A journaled-evicted slot: keep the index stable for
+                # FLEET.json references, never rebuild device state.
+                self.members.append(FleetMember(i, None))
+                continue
             mdir = self.journal.member_dir(i)
             mkw = dict(
                 member_kwargs,
@@ -244,6 +276,7 @@ class FleetRouter:
                 blackbox_dir=self.journal.dir,
                 faults=faults,
                 handle_signals=False,
+                member_index=i,
             )
             with _quiet_exporter():
                 if _recover and os.path.exists(
@@ -286,7 +319,20 @@ class FleetRouter:
             "assignments": {
                 k: dict(v) for k, v in self._assignments.items()
             },
+            "evicted": {
+                str(k): dict(v) for k, v in self._evicted.items()
+            },
         })
+
+    def record_eviction(self, index: int, cause: str) -> None:
+        """Journal the decision to evict member ``index`` BEFORE any
+        drain work starts (eviction-record-before-drain, module
+        docstring; the supervisor's ``_evict`` is protolint-checked to
+        call this first).  A crash after this record replays the drain
+        at recovery from the member's on-disk journal."""
+        with self.lock:
+            self._evicted[int(index)] = {"cause": str(cause)}
+            self._flush_fleet()
 
     # ------------------------------------------------------------------ #
     # Submission (network-facing: serving/gateway.py calls this)
@@ -360,7 +406,10 @@ class FleetRouter:
             return job_id
 
     def _shape_key(self, n: int) -> str:
-        cfg = self.members[0].scheduler.config
+        cfg = next(
+            m.scheduler.config for m in self.members
+            if m.scheduler is not None
+        )
         return classify(
             self.mesh.ntet, bucket(n), cfg.n_groups, cfg.dtype,
             getattr(self.mesh, "geo20", None) is not None,
@@ -375,13 +424,16 @@ class FleetRouter:
         that has already served this shape class holds the deserialized
         programs resident (the shared on-disk bank makes the first
         touch cheap everywhere, but warm re-use is free), so warmth
-        wins until queue depth tips the balance."""
+        wins until queue depth tips the balance.  Quarantined members
+        (supervisor grace period) rank strictly LAST: they keep their
+        jobs but get new work only when no healthy member exists."""
         best = None
         best_score = None
         for m in self.members:
             if not m.alive or m.index in exclude:
                 continue
             score = (
+                1 if m.quarantined else 0,
                 m.load,
                 0 if shape_key in m.warm else 1,
                 m.placed,
@@ -393,13 +445,15 @@ class FleetRouter:
 
     def _place(self, job_id: str, shape_key: str, *, entry: dict | None = None,
                src_dir: str | None = None, member: int | None = None,
-               exclude: tuple = ()) -> int:
+               exclude: tuple = (), link: str = "migrated") -> int:
         """Assign ``job_id`` to a member and dispatch it there — in
         that order: the FLEET.json assignment record is flushed BEFORE
         the member's scheduler sees the job
         (assignment-record-before-dispatch, protolint-verified).  A
         fresh submission dispatches its pending request; a migration
-        (``entry``/``src_dir``) adopts the journaled entry."""
+        (``entry``/``src_dir``) adopts the journaled entry, continuing
+        the job's trace with the given ``link`` event (``migrated`` or
+        the supervisor's ``evicted``)."""
         if member is not None:
             target = self.members[member]
             if not target.alive:
@@ -418,14 +472,17 @@ class FleetRouter:
             ),
         }
         self._flush_fleet()
-        self._dispatch_job(target, job_id, entry=entry, src_dir=src_dir)
+        self._dispatch_job(
+            target, job_id, entry=entry, src_dir=src_dir, link=link
+        )
         return target.index
 
     def _dispatch_job(self, member: FleetMember, job_id: str, *,
                       entry: dict | None = None,
-                      src_dir: str | None = None) -> None:
+                      src_dir: str | None = None,
+                      link: str = "migrated") -> None:
         if entry is not None:
-            member.scheduler.adopt_job(entry, src_dir=src_dir)
+            member.scheduler.adopt_job(entry, src_dir=src_dir, link=link)
             self._migrations_total.inc()
         else:
             member.scheduler.submit(self._pending.pop(job_id))
@@ -506,23 +563,7 @@ class FleetRouter:
         # authority for what it owned — its in-memory table died with
         # it.  Terminal jobs re-place too (their persisted fluxes ride
         # along), so every accepted job stays owned by an alive member.
-        mdir = self.journal.member_dir(member.index)
-        doc = SchedulerJournal(mdir).load() or {"jobs": {}}
-        moved = 0
-        for entry in sorted(
-            doc.get("jobs", {}).values(), key=lambda e: e["index"]
-        ):
-            jid = entry["id"]
-            assignment = self._assignments.get(jid)
-            if assignment is not None and (
-                assignment["member"] != member.index
-            ):
-                continue  # stale copy; the assignment names the owner
-            self._place(
-                jid, entry["shape_key"], entry=entry, src_dir=mdir,
-                exclude=(member.index,),
-            )
-            moved += 1
+        moved = self._replace_from_disk(member.index)
         self.recorder.record(
             "member_death", member=member.index, reason=reason,
             replaced=moved,
@@ -531,6 +572,140 @@ class FleetRouter:
             f"fleet member {member.index}: {moved} journaled jobs "
             "re-placed onto survivors"
         )
+
+    def _replace_from_disk(self, index: int, *,
+                           link: str = "migrated") -> int:
+        """Re-place member ``index``'s JOURNALED jobs onto survivors:
+        the on-disk write-ahead journal is the authority for what the
+        member owned (its in-memory table is dead or untrustworthy).
+        Copies whose assignment already names another member are
+        skipped — they are the stale half of an interrupted migration,
+        drain, or eviction."""
+        mdir = self.journal.member_dir(index)
+        doc = SchedulerJournal(mdir).load() or {"jobs": {}}
+        moved = 0
+        for entry in sorted(
+            doc.get("jobs", {}).values(), key=lambda e: e["index"]
+        ):
+            jid = entry["id"]
+            assignment = self._assignments.get(jid)
+            if assignment is not None and (
+                assignment["member"] != index
+            ):
+                continue  # stale copy; the assignment names the owner
+            self._place(
+                jid, entry["shape_key"], entry=entry, src_dir=mdir,
+                exclude=(index,), link=link,
+            )
+            moved += 1
+        return moved
+
+    # ------------------------------------------------------------------ #
+    # Supervisor eviction (serving/supervisor.py drives these)
+    # ------------------------------------------------------------------ #
+    def drain_member(self, index: int, *, cause: str) -> int:
+        """Cooperatively evict an ALIVE member: park + export every
+        job it owns onto healthy peers (``evicted`` trace link), then
+        retire the member.  This is the brownout / disk-pressure path
+        — the member's scheduler still answers, so its in-memory table
+        (not just the on-disk journal) hands the jobs over, including
+        a degraded-disk member's unpersisted results.  Callers flush
+        ``record_eviction`` FIRST (eviction-record-before-drain)."""
+        with self.lock:
+            member = self.members[index]
+            if not member.alive:
+                return 0
+            if not any(
+                m.alive and m.index != member.index
+                for m in self.members
+            ):
+                raise RuntimeError(
+                    f"cannot drain member {index} ({cause}): no other "
+                    "alive member to take its jobs"
+                )
+            src = member.scheduler
+            moved = 0
+            for job in sorted(src.jobs(), key=lambda j: j.index):
+                # park_job (not preempt_job): identical on a healthy
+                # disk, but a disk-pressured member frees the slot
+                # without a durable checkpoint and resumes from the
+                # last committed one (or move 0) — bitwise either way.
+                src.park_job(job.id)
+                assignment = self._assignments.get(job.id)
+                if assignment is not None and (
+                    assignment["member"] != member.index
+                ):
+                    src.drop_job(job.id)
+                    continue  # stale copy; the assignment names the owner
+                entry = src.export_entry(job.id)
+                self._place(
+                    job.id, job.shape_key, entry=entry,
+                    src_dir=src.journal.dir,
+                    exclude=(member.index,), link="evicted",
+                )
+                target = self.members[
+                    self._assignments[job.id]["member"]
+                ]
+                adopted = target.scheduler.job(job.id)
+                if (job.terminal and job.result is not None
+                        and adopted.result is None):
+                    # Degraded-disk flux loss: the source finished the
+                    # job but could not persist its flux — re-persist
+                    # from the in-memory result on the adopting member.
+                    adopted.result = job.result.copy()
+                    adopted.flux_name = target.scheduler.journal.write_flux(
+                        job.id, adopted.result
+                    )
+                    target.scheduler._flush_journal()
+                src.drop_job(job.id)
+                moved += 1
+            src.abandon()
+            member.alive = False
+            member.health = "evicted"
+            member.quarantined = False
+            self._update_gauges()
+            self.recorder.record(
+                "member_evicted", member=member.index, cause=cause,
+                replaced=moved, cooperative=True,
+            )
+            log_warn(
+                f"fleet member {member.index} evicted ({cause}): "
+                f"{moved} jobs drained onto healthy peers"
+            )
+            return moved
+
+    def drain_member_from_journal(self, index: int, *,
+                                  cause: str) -> int:
+        """Evict a WEDGED member: its scheduler no longer answers
+        probes, so its in-memory table is untrustworthy — abandon the
+        device state and re-place from the on-disk write-ahead journal
+        exactly like a member death, but under the supervisor's
+        ``evicted`` trace link.  Callers flush ``record_eviction``
+        FIRST (eviction-record-before-drain)."""
+        with self.lock:
+            member = self.members[index]
+            if not member.alive:
+                return 0
+            member.scheduler.abandon()
+            member.alive = False
+            member.health = "evicted"
+            member.quarantined = False
+            self._update_gauges()
+            if not any(m.alive for m in self.members):
+                raise RuntimeError(
+                    f"cannot evict wedged member {index} ({cause}): "
+                    "no members survive"
+                )
+            moved = self._replace_from_disk(member.index, link="evicted")
+            self.recorder.record(
+                "member_evicted", member=member.index, cause=cause,
+                replaced=moved, cooperative=False,
+            )
+            log_warn(
+                f"fleet member {member.index} evicted ({cause}): "
+                f"{moved} journaled jobs re-placed onto survivors"
+            )
+            return moved
 
     # ------------------------------------------------------------------ #
     # The scheduling loop
@@ -546,6 +721,14 @@ class FleetRouter:
             pending = False
             for member in list(self.members):
                 if not member.alive:
+                    continue
+                if member.scheduler.wedged:
+                    # A wedged member holds its jobs but makes no
+                    # progress — it still reports pending so the loop
+                    # does not declare the fleet drained; only the
+                    # supervisor's missed-heartbeat eviction
+                    # (serving/supervisor.py) can free the jobs.
+                    pending = True
                     continue
                 try:
                     pending = member.scheduler.step() or pending
@@ -565,6 +748,29 @@ class FleetRouter:
             f"fleet did not drain within {max_rounds} rounds"
         )
 
+    def backpressured(self) -> bool:
+        """True when the fleet cannot usefully accept a NEW job right
+        now: no alive member, or every alive non-quarantined member
+        (falling back to any-alive when the whole fleet is
+        quarantined) is at its admission bound.  The gateway turns
+        this into a 503 + ``Retry-After`` BEFORE journaling an
+        acceptance record — a rejected submission must not burn an
+        idempotency key on a job no member would admit."""
+        with self.lock:
+            candidates = [
+                m for m in self.members
+                if m.alive and not m.quarantined
+            ]
+            if not candidates:
+                candidates = [m for m in self.members if m.alive]
+            if not candidates:
+                return True
+            return all(
+                m.scheduler.max_queued is not None
+                and m.scheduler.queue_depth >= m.scheduler.max_queued
+                for m in candidates
+            )
+
     # ------------------------------------------------------------------ #
     # Recovery (the router-kill half of the chaos campaign)
     # ------------------------------------------------------------------ #
@@ -583,9 +789,14 @@ class FleetRouter:
                 f"no fleet journal at {journal.path} — nothing to "
                 "recover"
             )
+        evicted = {
+            int(k): dict(v)
+            for k, v in doc.get("evicted", {}).items()
+        }
         router = cls(
             mesh, config, fleet_dir=fleet_dir,
-            n_members=int(doc["members"]), _recover=True, **kwargs,
+            n_members=int(doc["members"]), _recover=True,
+            _evicted=tuple(sorted(evicted)), **kwargs,
         )
         try:
             with router.lock:
@@ -599,6 +810,7 @@ class FleetRouter:
                         "migrations": int(v.get("migrations", 0))}
                     for k, v in doc.get("assignments", {}).items()
                 }
+                router._evicted = evicted
                 router._n_submitted = int(doc.get("n_submitted", 0))
                 router._reconcile()
         except BaseException:
@@ -634,6 +846,15 @@ class FleetRouter:
                         f"member {assignment['member']})"
                     )
                     m.scheduler.drop_job(j.id)
+        # (i½) A journaled eviction whose drain the crash interrupted:
+        # replay it from the evicted member's on-disk journal.  Jobs
+        # the drain already moved have assignments naming their new
+        # owner and are skipped; jobs it never reached still carry the
+        # evicted member's assignment and re-place now
+        # (eviction-record-before-drain's recovery half).
+        for idx in sorted(self._evicted):
+            if idx < len(self.members) and not self.members[idx].alive:
+                self._replace_from_disk(idx, link="evicted")
         # (ii) Journaled-accepted jobs nobody knows: the crash landed
         # between the acceptance/assignment record and the dispatch —
         # the journaled request payload replays it.
@@ -774,6 +995,8 @@ class FleetRouter:
                     {
                         "member": m.index,
                         "alive": m.alive,
+                        "health": m.health,
+                        "quarantined": m.quarantined,
                         "queue_depth": (
                             m.scheduler.queue_depth if m.alive else 0
                         ),

@@ -34,11 +34,29 @@ a path-unsafe id is a 400, never a file probe.  Malformed JSON and
 validation failures are 400s with the reason in the body; unknown jobs
 are 404s; unknown paths answer 404 naming the valid endpoints (the
 exporter's teach-don't-stonewall rule).
+
+Request-level robustness (the self-healing-fleet PR's ingress half):
+
+  * Every connection gets a per-request READ/WRITE socket deadline
+    (``request_timeout_s`` → the handler's ``timeout``, applied by
+    socketserver's ``setup()`` via ``settimeout``): a client that
+    stalls mid-body or stops draining a response times the SOCKET out
+    instead of wedging a daemon handler thread forever.  The timed-out
+    connection is closed, never answered partially.
+  * Backpressure is a 503 WITH retry guidance: when every healthy
+    member is at its admission bound (``FleetRouter.backpressured``),
+    ``POST /submit`` answers 503 + ``Retry-After`` and a body carrying
+    ``retry_after_s``/``retry_jitter_s`` — clients sleep
+    ``retry_after_s + uniform(0, retry_jitter_s)`` and retry with the
+    SAME idempotency key, so a rejected burst decorrelates instead of
+    hot-looping in lockstep.  The check runs BEFORE ``router.submit``
+    journals anything: a rejected request burns no idempotency key.
 """
 from __future__ import annotations
 
 import base64
 import json
+import math
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -60,36 +78,63 @@ class TallyGateway:
     so everything a handler answers comes from (journaled) router
     state."""
 
-    def __init__(self, router, port: int = 0, host: str = "127.0.0.1"):
+    def __init__(self, router, port: int = 0, host: str = "127.0.0.1",
+                 *, request_timeout_s: float = 30.0,
+                 retry_after_s: float = 1.0):
+        if float(request_timeout_s) <= 0:
+            raise ValueError(
+                f"request_timeout_s must be > 0: {request_timeout_s}"
+            )
+        if float(retry_after_s) <= 0:
+            raise ValueError(
+                f"retry_after_s must be > 0: {retry_after_s}"
+            )
         self.router = router
+        self.request_timeout_s = float(request_timeout_s)
+        self.retry_after_s = float(retry_after_s)
         gateway = self
 
         class _Handler(BaseHTTPRequestHandler):
+            # socketserver's setup() applies this as the connection's
+            # settimeout — one deadline covering every blocking read
+            # AND write on the socket (module docstring).
+            timeout = self.request_timeout_s
+
             def do_POST(self):  # noqa: N802 — http.server API
-                path = self.path.split("?", 1)[0]
-                if path == "/submit":
-                    self._answer(gateway._submit(self._body()))
-                elif path == "/cancel":
-                    self._answer(gateway._cancel(self._body()))
-                else:
-                    self._unknown(path)
+                try:
+                    path = self.path.split("?", 1)[0]
+                    if path == "/submit":
+                        self._answer(gateway._submit(self._body()))
+                    elif path == "/cancel":
+                        self._answer(gateway._cancel(self._body()))
+                    else:
+                        self._unknown(path)
+                except OSError:
+                    # Stalled or vanished client (socket timeout,
+                    # reset): drop the connection; there is nobody
+                    # left to answer, and the handler thread must not
+                    # wedge (TimeoutError is an OSError here).
+                    self.close_connection = True
 
             def do_GET(self):  # noqa: N802 — http.server API
-                path, _, query = self.path.partition("?")
-                if path == "/healthz":
-                    self._answer((200, {"ok": True}))
-                elif path.startswith("/status/"):
-                    self._answer(
-                        gateway._status(path[len("/status/"):])
-                    )
-                elif path.startswith("/result/"):
-                    self._answer(
-                        gateway._result(path[len("/result/"):])
-                    )
-                elif path.startswith("/progress/"):
-                    self._stream(path[len("/progress/"):], query)
-                else:
-                    self._unknown(path)
+                try:
+                    path, _, query = self.path.partition("?")
+                    if path == "/healthz":
+                        self._answer((200, {"ok": True}))
+                    elif path.startswith("/status/"):
+                        self._answer(
+                            gateway._status(path[len("/status/"):])
+                        )
+                    elif path.startswith("/result/"):
+                        self._answer(
+                            gateway._result(path[len("/result/"):])
+                        )
+                    elif path.startswith("/progress/"):
+                        self._stream(path[len("/progress/"):], query)
+                    else:
+                        self._unknown(path)
+                except OSError:
+                    self.close_connection = True
 
             # -- plumbing ---------------------------------------- #
             def _body(self) -> bytes:
@@ -97,13 +142,16 @@ class TallyGateway:
                 return self.rfile.read(length)
 
             def _answer(self, status_payload) -> None:
-                status, payload = status_payload
+                status, payload, *rest = status_payload
+                headers = rest[0] if rest else {}
                 body = (
                     json.dumps(payload, sort_keys=True) + "\n"
                 ).encode()
                 self.send_response(status)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for name, value in headers.items():
+                    self.send_header(name, str(value))
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -210,13 +258,41 @@ class TallyGateway:
             return 400, {
                 "error": f"bad request: {type(e).__name__}: {e}"
             }
+        # Backpressure answers BEFORE router.submit journals anything:
+        # a 503'd request must not burn an idempotency key on a job no
+        # member would admit (module docstring).
+        if self.router.backpressured():
+            return self._too_busy(
+                "fleet backpressured: every healthy member is at "
+                "its admission bound"
+            )
         try:
             accepted = self.router.submit(
                 request, idempotency_key=key
             )
         except ValueError as e:
             return 400, {"error": str(e)}
+        except RuntimeError as e:
+            # No alive member to place on (mid-eviction trough): the
+            # request is retryable, not wrong.
+            return self._too_busy(str(e))
         return 200, {"job": accepted}
+
+    def _too_busy(self, reason: str):
+        """503 + Retry-After + jittered-backoff guidance (module
+        docstring): the client sleeps ``retry_after_s + uniform(0,
+        retry_jitter_s)`` then retries with the SAME idempotency
+        key."""
+        return 503, {
+            "error": reason,
+            "retry_after_s": self.retry_after_s,
+            "retry_jitter_s": self.retry_after_s / 2.0,
+            "guidance": (
+                "sleep retry_after_s + uniform(0, retry_jitter_s), "
+                "then retry the same request with the same "
+                "idempotency_key"
+            ),
+        }, {"Retry-After": int(math.ceil(self.retry_after_s))}
 
     def _cancel(self, body: bytes):
         try:

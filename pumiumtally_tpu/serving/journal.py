@@ -49,6 +49,18 @@ carries its own move counter, recovery re-reads it at restore time,
 and replaying quanta a stale journal forgot is bitwise (the RNG stream
 is keyed by the counter, not by wall history).
 
+Degraded mode: a durable write failing with an ENOSPC-class errno
+(disk full / quota exceeded) marks the journal ``degraded`` instead of
+propagating out of the flush path — the scheduler's in-memory job
+table is intact, and crashing over it would turn a full disk into lost
+work. While degraded, flushes and flux persists are skipped (the
+on-disk document freezes at the last committed state), the owning
+scheduler parks its residents at the next quantum boundary, and the
+fleet supervisor drains the member by exporting its jobs to healthy
+peers (serving/supervisor.py). The flag is sticky for the journal's
+lifetime: a disk does not un-fill under a process that keeps writing,
+and recovery after an operator clears space is a fresh process.
+
 Request payloads round-trip EXACTLY: Python's json emits floats via
 ``repr`` (shortest round trip), so float64 origins/weights come back
 bit-identical, and ``SourceParams.tables()`` coerces the
@@ -62,6 +74,7 @@ checkpoint store is the known next step if job counts grow).
 from __future__ import annotations
 
 import dataclasses
+import errno
 import io
 import json
 import os
@@ -70,6 +83,11 @@ import re
 import numpy as np
 
 from ..utils.checkpoint import atomic_write_bytes, atomic_write_json
+from ..utils.log import log_warn
+
+#: The errnos that mean "the disk is full", not "the write is wrong":
+#: these degrade the journal instead of crashing the scheduler.
+DISK_FULL_ERRNOS = (errno.ENOSPC, errno.EDQUOT)
 
 JOURNAL_SCHEMA = 2
 #: Schemas this reader accepts (older documents lack trace fields,
@@ -161,6 +179,42 @@ class SchedulerJournal:
         self.dir = str(dirname)
         os.makedirs(self.dir, exist_ok=True)
         self.path = os.path.join(self.dir, JOURNAL_FILE)
+        #: Sticky disk-pressure flag (module docstring "Degraded
+        #: mode"): set by the first ENOSPC-class durable-write failure;
+        #: while set, flush/write_flux are skipped instead of raising.
+        self.degraded = False
+        #: Optional fault injector — or a zero-arg provider returning
+        #: one — whose ``maybe_disk_full`` gates every durable write.
+        #: The owning scheduler wires a provider so an injector
+        #: swapped in mid-run (the chaos harness pattern) still gates.
+        self.faults = None
+        #: Optional ``(op, exc) -> None`` callback fired once, on the
+        #: transition into degraded mode (the scheduler hangs metrics
+        #: and flight-recorder notes off it).
+        self.on_degraded = None
+
+    def note_disk_failure(self, op: str, exc: OSError) -> None:
+        """Record an ENOSPC-class failure of durable write ``op`` and
+        enter degraded mode (idempotent; first transition logs and
+        fires ``on_degraded``)."""
+        if self.degraded:
+            return
+        self.degraded = True
+        log_warn(
+            "journal degraded: durable write failed with disk "
+            "pressure — freezing the on-disk document and parking "
+            "residents (serving/journal.py 'Degraded mode')",
+            dir=self.dir, op=op, error=str(exc),
+        )
+        if self.on_degraded is not None:
+            self.on_degraded(op, exc)
+
+    def _gate_durable(self) -> None:
+        """Fault-injection gate for one durable write
+        (``disk_full_at:N``); raises the injected ENOSPC."""
+        faults = self.faults() if callable(self.faults) else self.faults
+        if faults is not None:
+            faults.maybe_disk_full()
 
     # -- side files ---------------------------------------------------- #
     def checkpoint_path(self, job_id: str) -> str:
@@ -180,12 +234,23 @@ class SchedulerJournal:
         shutdown reason) lands inside the journal dir."""
         return os.path.join(self.dir, f"{tag}.blackbox.json")
 
-    def write_flux(self, job_id: str, arr: np.ndarray) -> str:
+    def write_flux(self, job_id: str, arr: np.ndarray) -> str | None:
         """Persist one finished job's raw flux atomically; returns the
-        journal-relative name the document records."""
+        journal-relative name the document records, or None when the
+        disk is full (degraded mode — the result stays in memory and a
+        draining supervisor re-persists it on the adopting member)."""
+        if self.degraded:
+            return None
         buf = io.BytesIO()
         np.save(buf, np.asarray(arr))
-        atomic_write_bytes(self.flux_path(job_id), buf.getvalue())
+        try:
+            self._gate_durable()
+            atomic_write_bytes(self.flux_path(job_id), buf.getvalue())
+        except OSError as exc:
+            if exc.errno not in DISK_FULL_ERRNOS:
+                raise
+            self.note_disk_failure("flux persist", exc)
+            return None
         return os.path.basename(self.flux_path(job_id))
 
     def load_flux(self, job_id: str) -> np.ndarray | None:
@@ -206,12 +271,20 @@ class SchedulerJournal:
 
     # -- the document -------------------------------------------------- #
     def flush(self, entries: list[dict], *, quantum_moves: int) -> None:
+        if self.degraded:
+            return
         doc = {
             "schema": JOURNAL_SCHEMA,
             "quantum_moves": int(quantum_moves),
             "jobs": {e["id"]: e for e in entries},
         }
-        atomic_write_json(self.path, doc)
+        try:
+            self._gate_durable()
+            atomic_write_json(self.path, doc)
+        except OSError as exc:
+            if exc.errno not in DISK_FULL_ERRNOS:
+                raise
+            self.note_disk_failure("journal flush", exc)
 
     def load(self) -> dict | None:
         """The committed document, or None when no journal exists yet.

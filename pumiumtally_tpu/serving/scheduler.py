@@ -135,6 +135,7 @@ from ..utils.signals import (
 )
 from .bank import ProgramBank
 from .journal import (
+    DISK_FULL_ERRNOS,
     SchedulerJournal,
     check_job_id,
     request_from_json,
@@ -258,8 +259,13 @@ class TallyScheduler:
         the journal directory; None without a journal disables dumps.
       faults: the scheduler-level FaultInjector driving the per-job
         fault hooks (poison_job / transient_quantum /
-        kill_server_at_quantum); default: one built from
-        PUMI_TPU_FAULTS.
+        kill_server_at_quantum) and the per-member hooks
+        (wedge_member / slow_member / disk_full_at); default: one
+        built from PUMI_TPU_FAULTS.
+      member_index: this scheduler's fleet-member index (set by
+        FleetRouter) — the identity the per-member fault hooks and
+        the fleet supervisor's health probes key on; None for a
+        standalone scheduler.
     """
 
     def __init__(
@@ -280,6 +286,7 @@ class TallyScheduler:
         journal_dir: str | None = None,
         blackbox_dir: str | None = None,
         faults: FaultInjector | None = None,
+        member_index: int | None = None,
         handle_signals: bool = True,
         registry: MetricsRegistry | None = None,
         tracer: SpanTracer | None = None,
@@ -319,9 +326,18 @@ class TallyScheduler:
         self.backoff_max = float(backoff_max)
         self._sleep = sleep
         self.faults = faults if faults is not None else FaultInjector()
+        self.member_index = (
+            None if member_index is None else int(member_index)
+        )
         self.journal = (
             SchedulerJournal(journal_dir)
             if journal_dir is not None else None
+        )
+        # Per-quantum wall seconds (successful quanta only), the
+        # sliding window the fleet supervisor's brownout SLO compares
+        # against the fleet median (serving/supervisor.py).
+        self.recent_quantum_seconds: collections.deque = (
+            collections.deque(maxlen=64)
         )
         self.preempt_after = preempt_after
         self.checkpoint_dir = checkpoint_dir
@@ -415,7 +431,8 @@ class TallyScheduler:
             "jobs re-queued from the JOBS.json journal at recovery "
             "(labeled by source: checkpoint = resumed mid-run, "
             "scratch = request replayed from move 0, migrated = "
-            "adopted from another fleet member's journal)",
+            "adopted from another fleet member's journal, evicted = "
+            "adopted from a member the supervisor drained)",
         )
         self._device_seconds = r.counter(
             "pumi_job_device_seconds",
@@ -433,6 +450,22 @@ class TallyScheduler:
             "SLO: wall seconds from submission to the first quantum "
             "dispatch (queue wait + admission + staging)",
         )
+        self._journal_degraded_gauge = r.gauge(
+            "pumi_journal_degraded",
+            "1 while this scheduler's journal is in disk-pressure "
+            "degraded mode (ENOSPC-class durable-write failure — "
+            "flushes frozen, residents parked; serving/journal.py "
+            "'Degraded mode'), labeled by fleet member",
+        )
+        self._journal_degraded_gauge.set(
+            0.0, member=self._member_label()
+        )
+        if self.journal is not None:
+            # Resolve the injector at gate time (a chaos harness swaps
+            # ``self.faults`` mid-run) and surface the degraded
+            # transition through this scheduler's metrics/recorder.
+            self.journal.faults = lambda: self.faults
+            self.journal.on_degraded = self._on_journal_degraded
         # The PR 11 failure taxonomy, shared with ResilientRunner: one
         # coordinator on the SCHEDULER registry, rebound to the failing
         # job's facade at classification time (the probe needs the
@@ -464,6 +497,40 @@ class TallyScheduler:
                 "/trace": self.tracer.chrome,
             },
         )
+
+    def _member_label(self) -> str:
+        return (
+            "solo" if self.member_index is None
+            else f"m{self.member_index}"
+        )
+
+    def _on_journal_degraded(self, op: str, exc: OSError) -> None:
+        """Journal's degraded-mode transition callback: hang the gauge
+        and a flight record off the first ENOSPC-class failure."""
+        self._journal_degraded_gauge.set(
+            1.0, member=self._member_label()
+        )
+        self.recorder.record(
+            "journal_degraded", member=self._member_label(),
+            op=op, error=str(exc)[:200],
+        )
+
+    # -- fleet-supervisor probes (serving/supervisor.py) --------------- #
+    @property
+    def wedged(self) -> bool:
+        """True while the ``wedge_member`` fault holds this member: it
+        answers no probe and makes no progress, but keeps its jobs and
+        device state (the silent-wedge failure mode)."""
+        return self.faults.member_wedged(self.member_index)
+
+    def heartbeat(self) -> bool:
+        """One liveness probe: False when this member is wedged, else
+        the per-chip health probe verdict (every device of the served
+        mesh answers a device_put round-trip —
+        resilience/coordinator.py)."""
+        if self.wedged:
+            return False
+        return all(self._coordinator.probe_chips().values())
 
     # ------------------------------------------------------------------ #
     # Submission
@@ -638,11 +705,23 @@ class TallyScheduler:
     def _journal_checkpoint(self, job: Job) -> None:
         """Quantum-boundary checkpoint into the journal dir (written
         BEFORE the journal flush that references it — the write-ahead
-        discipline serving/journal.py documents)."""
+        discipline serving/journal.py documents).  An ENOSPC-class
+        failure degrades the journal instead of crashing the serving
+        loop: the job keeps its previous checkpoint (if any), whose
+        own move counter makes a later resume bitwise."""
         if self.journal is None or job.tally is None:
             return
+        if self.journal.degraded:
+            return
         path = self.journal.checkpoint_path(job.id)
-        job.tally.save_checkpoint(path)
+        try:
+            self.journal._gate_durable()
+            job.tally.save_checkpoint(path)
+        except OSError as exc:
+            if exc.errno not in DISK_FULL_ERRNOS:
+                raise
+            self.journal.note_disk_failure("quantum checkpoint", exc)
+            return
         job.checkpoint = path
 
     @classmethod
@@ -714,8 +793,10 @@ class TallyScheduler:
                       link: str) -> Job:
         """Rebuild one journaled job in this scheduler.  ``link`` names
         the cross-lifetime trace event: ``recovered`` (same journal,
-        new process) or ``migrated`` (another member's journal — side
-        files are copied in from ``src_dir`` first)."""
+        new process), ``migrated`` (another member's journal — side
+        files are copied in from ``src_dir`` first), or ``evicted``
+        (same copy-in, but the hop was forced by the supervisor
+        draining an unhealthy member)."""
         request = request_from_json(entry["request"])
         origins = np.asarray(request.origins, np.float64).reshape(-1, 3)
         n = origins.shape[0]
@@ -784,7 +865,7 @@ class TallyScheduler:
         self._enqueue(job)
         self._n_recovered += 1
         self._recovered_total.inc(
-            source="migrated" if link == "migrated" else source
+            source=link if link in ("migrated", "evicted") else source
         )
         # The explicit cross-lifetime link: this span's pid (or, for a
         # migration, member) differs from the spans the previous owner
@@ -812,28 +893,91 @@ class TallyScheduler:
         if job.state == RESIDENT:
             self._preempt(job)
 
+    def park_job(self, job_id: str) -> None:
+        """Degraded-safe preempt of one RESIDENT job (no-op
+        otherwise): checkpoint-preempt when the disk allows; under
+        disk pressure, release the device slot WITHOUT a durable
+        checkpoint.  The job then resumes from its previous
+        quantum-boundary checkpoint if one exists on disk (its own
+        move counter makes that bitwise), else replays from move 0
+        (also bitwise — the whole stream re-runs).  The supervisor's
+        disk-pressure drain and the scheduler's own degraded parking
+        both route through here."""
+        job = self._jobs[job_id]
+        if job.state != RESIDENT:
+            return
+        if self.journal is None or not self.journal.degraded:
+            try:
+                self._preempt(job)
+                return
+            except OSError as exc:
+                if exc.errno not in DISK_FULL_ERRNOS:
+                    raise
+                if self.journal is not None:
+                    self.journal.note_disk_failure(
+                        "preempt checkpoint", exc
+                    )
+        # Disk-pressure fallback: free the slot, keep (at most) the
+        # last durable checkpoint as the resume point.
+        if job.tally is not None:
+            try:
+                job.tally.close()
+            except Exception:  # pragma: no cover - teardown best-effort
+                pass
+            job.tally = None
+        if job in self._resident:
+            self._resident.remove(job)
+        job.preemptions += 1
+        if job.checkpoint is None or not os.path.exists(job.checkpoint):
+            job.checkpoint = None
+            job.moves_done = 0
+            job.needs_stage = True
+        self._preempt_total.inc()
+        self.recorder.record(
+            "job_parked", job=job.id, job_id=job.id,
+            shape_key=job.shape_key, moves=job.moves_done,
+            degraded=True,
+        )
+        self._enqueue(job)
+        self._flush_journal()
+
+    def _park_degraded(self) -> None:
+        """Degraded-mode quantum boundary (satellite contract): park
+        every resident so device memory is released and all state is
+        journaled-or-replayable, then hold admission until a
+        supervisor drains this member or an operator intervenes."""
+        for job in list(self._resident):
+            self.park_job(job.id)
+
     def export_entry(self, job_id: str) -> dict:
         """This job's journal entry — exactly what recovery would read;
         ``adopt_job`` on another member rebuilds the job from it."""
         return self._journal_entry(self._jobs[job_id])
 
-    def adopt_job(self, entry: dict, *, src_dir: str | None = None) -> Job:
+    def adopt_job(self, entry: dict, *, src_dir: str | None = None,
+                  link: str = "migrated") -> Job:
         """Adopt one job journaled by ANOTHER fleet member (cross-chip
-        migration / dead-member re-placement): side files are copied
-        from ``src_dir`` into this journal, a pending job re-queues
-        from its checkpoint (bitwise — the move counter keys the RNG),
-        a done job lands terminal with its persisted flux, and the
-        trace continues across the hop with a ``migrated`` link.  The
-        adopted job is journaled here BEFORE the caller drops it from
-        the source member (write-ahead: two journals briefly know the
-        job; the fleet's assignment record names the owner)."""
+        migration / dead-member re-placement / supervisor eviction):
+        side files are copied from ``src_dir`` into this journal, a
+        pending job re-queues from its checkpoint (bitwise — the move
+        counter keys the RNG), a done job lands terminal with its
+        persisted flux, and the trace continues across the hop with a
+        ``migrated`` (or ``evicted``) link.  The adopted job is
+        journaled here BEFORE the caller drops it from the source
+        member (write-ahead: two journals briefly know the job; the
+        fleet's assignment record names the owner)."""
         if self.journal is None:
             raise ValueError(
                 "adopt_job needs a journaled scheduler (fleet members "
                 "always journal)"
             )
+        if link not in ("migrated", "evicted"):
+            raise ValueError(
+                f"adopt_job link must be 'migrated' or 'evicted': "
+                f"{link!r}"
+            )
         entry = dict(entry, index=self._n_submitted)
-        job = self._import_entry(entry, src_dir=src_dir, link="migrated")
+        job = self._import_entry(entry, src_dir=src_dir, link=link)
         self._n_submitted += 1
         self._flush_journal()
         return job
@@ -1225,6 +1369,17 @@ class TallyScheduler:
                             self.backoff_base * 2 ** (attempt - 1),
                             self.backoff_max,
                         ))
+                # Injected brownout (slow_member:M:F): stretch this
+                # quantum's WALL time to ~F× its dispatch time.  Pure
+                # host-side latency — device results are untouched, so
+                # the job stays bitwise; only the supervisor's latency
+                # SLO sees it.
+                if poison is None:
+                    extra = self.faults.slow_quantum_extra(
+                        self.member_index, disp_s
+                    )
+                    if extra > 0.0:
+                        self._sleep(extra)
         finally:
             # Device-time attribution survives every exit path
             # (success, poison return, injected kill unwinding).
@@ -1255,6 +1410,9 @@ class TallyScheduler:
             job.totals[key] += v
         job.totals["alive"] = totals["alive"]
         self._quanta_total.inc()
+        # Successful quanta feed the supervisor's brownout window
+        # (wall time, injected latency included).
+        self.recent_quantum_seconds.append(time.perf_counter() - t0)
         self.recorder.record(
             "quantum", job=job.id, job_id=job.id,
             shape_key=job.shape_key,
@@ -1431,7 +1589,16 @@ class TallyScheduler:
         resident job (round-robin fairness), then apply the preemption
         policy.  Returns True while any job is non-terminal.  A
         preemption signal landing mid-round defers to the next quantum
-        boundary, where the journal flush writes consistent state."""
+        boundary, where the journal flush writes consistent state.
+
+        A DEGRADED journal (disk pressure) parks every resident and
+        holds the round: the member neither admits nor dispatches
+        until a fleet supervisor drains it (or an operator clears the
+        disk and restarts).  Returns False then — a degraded member
+        cannot make progress on its own."""
+        if self.journal is not None and self.journal.degraded:
+            self._park_degraded()
+            return False
         self._in_step = True
         try:
             while len(self._resident) < self.max_resident:

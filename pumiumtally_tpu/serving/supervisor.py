@@ -1,0 +1,295 @@
+"""Self-healing fleet supervisor: health-probe-driven eviction.
+
+ROADMAP item 3 left "health-probe-driven eviction (vs explicit /
+injected kills)" open: the fleet healed only when a dispatch RAISED.
+A member that silently wedges, slows to a crawl, or fills its disk was
+never detected — its jobs stalled forever.  ``FleetSupervisor`` closes
+the detect-decide-drain loop with NO explicit kill signal anywhere:
+
+  detect   every ``tick()`` probes each alive member's heartbeat
+           (``TallyScheduler.heartbeat`` — the per-chip device_put
+           round-trip probe from resilience/coordinator.py) and reads
+           its per-quantum latency window
+           (``scheduler.recent_quantum_seconds``, fed by the PR 16
+           device-time attribution path) and its journal's
+           disk-pressure flag (serving/journal.py "Degraded mode").
+  decide   members classify into a small state machine::
+
+             healthy ──(probe miss x heartbeat_misses)──▶ wedged
+             healthy ──(median quantum > slow_factor x
+                        fleet median over `window` quanta)──▶ brownout
+             healthy ──(journal.degraded)──▶ disk-pressured
+
+           Any unhealthy state QUARANTINES the member first (it keeps
+           its jobs and keeps running, but receives no new
+           placements — ``FleetRouter._choose`` ranks quarantined
+           members strictly last).  Only ``grace_ticks`` CONSECUTIVE
+           unhealthy ticks escalate to eviction, and
+           ``restore_ticks`` consecutive healthy ticks lift the
+           quarantine — the hysteresis that keeps a slow-but-
+           recovering member from being false-positively drained.
+  drain    eviction journals the decision FIRST
+           (``FleetRouter.record_eviction`` → FLEET.json ``evicted``
+           map), THEN drains: a wedged member's in-memory table is
+           untrustworthy, so its on-disk write-ahead journal re-places
+           (``drain_member_from_journal``); a brownout or
+           disk-pressured member still answers, so it hands its jobs
+           over cooperatively (``drain_member`` — park, export, adopt
+           on a healthy peer, drop).  The record-before-drain edge is
+           machine-checked by analysis/protolint.py
+           (eviction-record-before-drain in PROTOCOLS.json): a
+           supervisor crash mid-drain leaves a journaled eviction that
+           recovery replays, so no job is ever orphaned or duplicated.
+
+Evicted jobs stay BITWISE equal to the fault-free run: re-placement
+rides the same checkpoint-adoption path as cross-chip migration (the
+megastep RNG is keyed by the persistent move counter), and a
+disk-pressured member's unpersisted state replays from its last
+durable checkpoint or from move 0 — both bitwise, since the RNG stream
+depends on the counter, not on wall history.  The trace continues
+across the hop with an ``evicted`` link event (scripts/teleview.py
+accepts it like ``recovered``/``migrated``).
+
+Metrics (on the router's registry, scraped by the router's exporter):
+
+  pumi_member_health{member,state}    1 for the member's current state
+                                      (healthy/brownout/wedged/
+                                      disk-pressured/evicted), 0 for
+                                      the others
+  pumi_evictions_total{cause}         evictions by detected cause
+  pumi_supervisor_probe_seconds       wall seconds per tick() sweep
+
+Threading: the supervisor is driven SYNCHRONOUSLY (``tick()`` between
+scheduling rounds, or ``run()`` which interleaves them) and serializes
+on the router's lock — no background thread touches member schedulers,
+matching the router's thread model.
+"""
+from __future__ import annotations
+
+import statistics
+import time
+
+from ..utils.log import log_info, log_warn
+
+#: Every state ``pumi_member_health`` reports (module docstring state
+#: machine; "evicted" is terminal).
+HEALTH_STATES = (
+    "healthy", "brownout", "wedged", "disk-pressured", "evicted",
+)
+
+
+class FleetSupervisor:
+    """Periodic health sweep over one ``FleetRouter`` (module
+    docstring).  Construct it over a live router and either call
+    ``tick()`` from your own loop or ``run()`` to drive the fleet to
+    drain with supervision interleaved.
+
+    Knobs (all per-tick, so the wall-clock grace scales with however
+    often the caller ticks):
+
+      slow_factor       brownout threshold: member median quantum
+                        latency > ``slow_factor`` x fleet median
+      window            quanta in the sliding latency window (a member
+                        needs a full window before it can be judged
+                        slow; the fleet needs >= 2 judged members for
+                        a median)
+      heartbeat_misses  consecutive failed probes before "wedged"
+      grace_ticks       consecutive unhealthy ticks tolerated in
+                        quarantine before eviction
+      restore_ticks     consecutive healthy ticks before a quarantined
+                        member is restored
+    """
+
+    def __init__(self, router, *, slow_factor: float = 3.0,
+                 window: int = 4, heartbeat_misses: int = 2,
+                 grace_ticks: int = 2, restore_ticks: int = 2):
+        if float(slow_factor) <= 1.0:
+            raise ValueError(
+                f"slow_factor must be > 1.0: {slow_factor}"
+            )
+        for name, v in (("window", window),
+                        ("heartbeat_misses", heartbeat_misses),
+                        ("grace_ticks", grace_ticks),
+                        ("restore_ticks", restore_ticks)):
+            if int(v) < 1:
+                raise ValueError(f"{name} must be >= 1: {v}")
+        self.router = router
+        self.slow_factor = float(slow_factor)
+        self.window = int(window)
+        self.heartbeat_misses = int(heartbeat_misses)
+        self.grace_ticks = int(grace_ticks)
+        self.restore_ticks = int(restore_ticks)
+        #: Per-member streak counters: consecutive probe misses,
+        #: consecutive healthy ticks, consecutive unhealthy ticks.
+        self._track: dict[int, dict] = {}
+        r = router.registry
+        self._health_gauge = r.gauge(
+            "pumi_member_health",
+            "1 for the member's current supervisor-classified health "
+            "state (healthy/brownout/wedged/disk-pressured/evicted), "
+            "0 for the others — labeled by member and state",
+        )
+        self._evictions_total = r.counter(
+            "pumi_evictions_total",
+            "members evicted by the fleet supervisor, labeled by the "
+            "detected cause (wedged/brownout/disk-pressured)",
+        )
+        self._probe_seconds = r.histogram(
+            "pumi_supervisor_probe_seconds",
+            "wall seconds per supervisor tick (heartbeat probes + "
+            "latency classification over every alive member)",
+        )
+        for m in router.members:
+            self._set_health(m)
+
+    # ------------------------------------------------------------------ #
+    # Detection
+    # ------------------------------------------------------------------ #
+    def tick(self) -> None:
+        """One detect-decide sweep over every alive member (module
+        docstring state machine).  May evict — which re-places jobs
+        onto healthy peers and can raise ``RuntimeError`` when none
+        survive to take them."""
+        t0 = time.perf_counter()
+        with self.router.lock:
+            members = [m for m in self.router.members if m.alive]
+            # Latency view: a member is judged only on a FULL window,
+            # and only against a fleet median built from >= 2 judged
+            # members — one member alone has nothing to be slower than.
+            medians = {}
+            for m in members:
+                recent = list(m.scheduler.recent_quantum_seconds)
+                if len(recent) >= self.window:
+                    medians[m.index] = statistics.median(
+                        recent[-self.window:]
+                    )
+            fleet_median = (
+                statistics.median(medians.values())
+                if len(medians) >= 2 else None
+            )
+            for m in members:
+                track = self._track.setdefault(
+                    m.index, {"misses": 0, "ok": 0, "unhealthy": 0}
+                )
+                beat = m.scheduler.heartbeat()
+                track["misses"] = 0 if beat else track["misses"] + 1
+                if track["misses"] >= self.heartbeat_misses:
+                    state = "wedged"
+                elif (m.scheduler.journal is not None
+                      and m.scheduler.journal.degraded):
+                    state = "disk-pressured"
+                elif (fleet_median is not None
+                      and fleet_median > 0.0
+                      and m.index in medians
+                      and medians[m.index]
+                      > self.slow_factor * fleet_median):
+                    state = "brownout"
+                else:
+                    state = "healthy"
+                self._apply(m, state, credit=beat)
+        self._probe_seconds.observe(time.perf_counter() - t0)
+
+    # ------------------------------------------------------------------ #
+    # Decision (hysteresis) + drain
+    # ------------------------------------------------------------------ #
+    def _apply(self, member, state: str, *, credit: bool) -> None:
+        """Fold one tick's classification into the member's streaks:
+        quarantine on the first unhealthy tick, evict after
+        ``grace_ticks`` consecutive ones, restore after
+        ``restore_ticks`` consecutive healthy ticks.  A healthy
+        classification with a MISSED probe (``credit=False`` — below
+        the wedged deadline but suspect) neither breaks nor builds the
+        healthy streak."""
+        track = self._track[member.index]
+        if state == "healthy":
+            track["unhealthy"] = 0
+            if credit:
+                track["ok"] += 1
+            if member.quarantined and track["ok"] >= self.restore_ticks:
+                member.quarantined = False
+                member.health = "healthy"
+                self.router.recorder.record(
+                    "member_restored", member=member.index,
+                )
+                log_info(
+                    f"fleet member {member.index} restored to healthy "
+                    f"after {track['ok']} clean ticks — quarantine "
+                    "lifted, jobs untouched"
+                )
+            elif not member.quarantined:
+                member.health = "healthy"
+            self._set_health(member)
+            return
+        track["ok"] = 0
+        track["unhealthy"] += 1
+        member.health = state
+        if not member.quarantined:
+            member.quarantined = True
+            self.router.recorder.record(
+                "member_quarantined", member=member.index, state=state,
+            )
+            log_warn(
+                f"fleet member {member.index} quarantined ({state}): "
+                "no new placements; eviction after "
+                f"{self.grace_ticks} more unhealthy ticks"
+            )
+        self._set_health(member)
+        if track["unhealthy"] > self.grace_ticks:
+            self._evict(member, state)
+
+    def _evict(self, member, cause: str) -> int:
+        """Evict one member: journal the decision, THEN drain its
+        jobs onto healthy peers.  The order is the crash-safety
+        contract (eviction-record-before-drain, PROTOCOLS.json,
+        protolint-checked): a journaled eviction whose drain never ran
+        is replayed at recovery from the member's on-disk journal;
+        reversed, a crash after the drain but before the record would
+        leave re-placed jobs under a member the routing journal still
+        calls healthy."""
+        self.router.record_eviction(member.index, cause)
+        if cause == "wedged":
+            # The member answers nothing — its in-memory table is
+            # untrustworthy; the on-disk write-ahead journal re-places.
+            moved = self.router.drain_member_from_journal(
+                member.index, cause=cause
+            )
+        else:
+            # Brownout / disk pressure: the scheduler still answers,
+            # so it hands its jobs over cooperatively (including a
+            # degraded-disk member's unpersisted results).
+            moved = self.router.drain_member(member.index, cause=cause)
+        self._evictions_total.inc(cause=cause)
+        self._set_health(member)
+        self._track.pop(member.index, None)
+        return moved
+
+    def _set_health(self, member) -> None:
+        for state in HEALTH_STATES:
+            self._health_gauge.set(
+                1.0 if member.health == state else 0.0,
+                member=f"m{member.index}", state=state,
+            )
+
+    # ------------------------------------------------------------------ #
+    # The supervised scheduling loop
+    # ------------------------------------------------------------------ #
+    def step(self) -> bool:
+        """One scheduling round + one supervision sweep.  Returns True
+        while any accepted job is non-terminal — including jobs held
+        by a wedged member the router's own loop cannot advance, so a
+        supervised fleet never declares itself drained while work is
+        stuck behind a pending eviction."""
+        pending = self.router.step()
+        self.tick()
+        return pending or any(
+            not j.terminal for j in self.router.jobs()
+        )
+
+    def run(self, max_rounds: int = 100000) -> None:
+        for _ in range(max_rounds):
+            if not self.step():
+                return
+        raise RuntimeError(
+            f"supervised fleet did not drain within {max_rounds} "
+            "rounds"
+        )

@@ -17,7 +17,8 @@ serving/gateway.py) and asserts the fleet contracts:
   * **trace continuity** — every job, migrated and poisoned included,
     passes ``teleview.py --check`` against the fleet directory alone:
     one causally-ordered trace, with an explicit ``migrated`` /
-    ``recovered`` link wherever spans cross process lifetimes.
+    ``recovered`` / ``evicted`` link wherever spans cross process
+    lifetimes.
 
 Scenarios (run all by default; ``--only NAME`` to pick one,
 ``--list`` to enumerate):
@@ -34,9 +35,27 @@ Scenarios (run all by default; ``--only NAME`` to pick one,
   retry_storm   a storm of concurrent duplicate POST /submit retries
                 (same idempotency keys, many threads): the journaled
                 key map collapses every retry onto one job id and one
-                execution per key.
+                execution per key;
+  wedged_member member 0 silently wedges (answers no health probe,
+                holds its jobs, NO kill signal anywhere): the
+                FleetSupervisor detects via missed heartbeats alone,
+                journals the eviction, re-places every job from the
+                wedged member's on-disk journal with ``evicted`` trace
+                links, and the fleet drains bitwise;
+  brownout      member 0 runs 25x slow (injected per-quantum latency):
+                the supervisor quarantines it (no new placements) but
+                does NOT evict within the grace period, then restores
+                it to healthy once the latency clears — its jobs never
+                leave it and finish bitwise (false-positive
+                resistance);
+  disk_pressure member 0's disk fills (injected ENOSPC on every
+                durable write): its journal degrades instead of
+                crashing, residents park at the quantum boundary, and
+                the supervisor drains the member cooperatively — zero
+                lost, zero duplicated, every flux bitwise.
 
-Usage: python scripts/chaos_fleet.py [--jobs N] [--only NAME] [--list]
+Usage: python scripts/chaos_fleet.py [--jobs N] [--only NAMES] [--list]
+(``--only`` takes one name or a comma-separated list.)
 Exit code 0 = every scenario met its declared contract.
 """
 import json
@@ -63,7 +82,11 @@ if not maybe_force_cpu():
 
 from pumiumtally_tpu import TallyConfig, build_box
 from pumiumtally_tpu.resilience import ChaosInjector, ChaosPlan
-from pumiumtally_tpu.serving import FleetRouter, TallyGateway
+from pumiumtally_tpu.serving import (
+    FleetRouter,
+    FleetSupervisor,
+    TallyGateway,
+)
 from pumiumtally_tpu.serving.journal import request_to_json
 from pumiumtally_tpu.serving.saturate import synthetic_requests
 
@@ -82,10 +105,12 @@ def build():
 
 
 def make_router(mesh, cfg, fleet_dir, bank, **kw):
+    kw.setdefault("max_resident", 2)
+    kw.setdefault("quantum_moves", QUANTUM)
+    kw.setdefault("job_retries", 2)
     return FleetRouter(
         mesh, cfg, fleet_dir=fleet_dir, n_members=N_MEMBERS,
-        bank=bank, max_resident=2, quantum_moves=QUANTUM,
-        job_retries=2, **kw,
+        bank=bank, **kw,
     )
 
 
@@ -403,7 +428,245 @@ def check_retry_storm(name, mesh, cfg, ref, requests, tmpdir) -> bool:
     return ok
 
 
-SCENARIOS = ("member_kill", "router_kill", "retry_storm")
+def _lost_and_duplicated(router, ids):
+    """The zero-lost / zero-duplicated contract over alive members."""
+    jobs = {j.id: j for j in router.jobs()}
+    lost = set(ids) - set(jobs)
+    duplicated = [
+        i for i in ids
+        if sum(
+            1 for m in router.members if m.alive
+            and any(j.id == i for j in m.scheduler.jobs())
+        ) > 1
+    ]
+    return jobs, lost, duplicated
+
+
+def _bitwise(router, ref, ids):
+    """(all-bitwise?, n_compared) — every job completed with a flux
+    byte-identical to the fault-free reference."""
+    n = 0
+    for i in ids:
+        job = router.job(i)
+        if job.outcome != "completed":
+            return False, n
+        if np.asarray(router.result(i)).tobytes() != ref[i].tobytes():
+            return False, n
+        n += 1
+    return True, n
+
+
+def evicted_link_jobs(fleet_dir: str) -> set:
+    """Job ids with an ``evicted`` trace link in the fleet's span
+    stream (the supervisor's cross-member hop marker)."""
+    return {
+        r.get("job_id")
+        for r in load_trace_records(fleet_dir)
+        if r.get("name") == "evicted"
+    }
+
+
+def check_wedged_member(name, mesh, cfg, ref, requests, tmpdir) -> bool:
+    """Member 0 wedges silently — it answers no heartbeat but holds
+    its jobs, and NOTHING sends a kill.  The supervisor must detect
+    via missed probes alone, journal the eviction
+    (eviction-record-before-drain), re-place every journaled job with
+    ``evicted`` trace links, and drain the fleet bitwise."""
+    fleet_dir = os.path.join(tmpdir, name)
+    router = make_router(
+        mesh, cfg, fleet_dir, os.path.join(tmpdir, "bank"),
+    )
+    try:
+        ids = submit_all(router, requests)
+        victim = 0
+        victim_jobs = {i for i in ids if router.member_of(i) == victim}
+        router.members[victim].scheduler.faults = ChaosInjector(
+            ChaosPlan(wedge_member=victim)
+        )
+        supervisor = FleetSupervisor(
+            router, heartbeat_misses=2, grace_ticks=1,
+        )
+        supervisor.run()
+        jobs, lost, duplicated = _lost_and_duplicated(router, ids)
+        evicted = (
+            not router.members[victim].alive
+            and router.members[victim].health == "evicted"
+        )
+        with open(os.path.join(fleet_dir, "FLEET.json")) as fh:
+            journaled = json.load(fh).get("evicted")
+        journal_proof = journaled == {str(victim): {"cause": "wedged"}}
+        counted = supervisor._evictions_total.value(cause="wedged") == 1
+        links_ok = victim_jobs <= evicted_link_jobs(fleet_dir)
+        bitwise, n_compared = _bitwise(router, ref, ids)
+    finally:
+        router.close()
+    trace_problems = fleet_trace_problems(fleet_dir, ids)
+    ok = (
+        len(victim_jobs) > 0 and evicted and not lost
+        and not duplicated and journal_proof and counted and links_ok
+        and bitwise and not trace_problems
+    )
+    for p in trace_problems:
+        print(f"[chaos-fleet] {name}: trace check: {p}", flush=True)
+    print(
+        f"[chaos-fleet] {name}: wedge member{victim}, no kill signal | "
+        f"evicted={evicted} lost={sorted(lost)} "
+        f"duplicated={duplicated} journal_proof={journal_proof} "
+        f"evicted_links({len(victim_jobs)} jobs)={links_ok} "
+        f"bitwise({n_compared} jobs)={bitwise} "
+        f"traces({len(ids)} jobs)={not trace_problems} "
+        f"{'OK' if ok else 'FAIL'}",
+        flush=True,
+    )
+    return ok
+
+
+def check_brownout(name, mesh, cfg, tmpdir) -> bool:
+    """Member 0 runs 25x slow under ``slow_member`` injection: the
+    supervisor quarantines it within the grace period but must NOT
+    evict, and once the injected latency clears it restores the
+    member to healthy with its jobs untouched — every flux bitwise vs
+    a fault-free run of the SAME workload (false-positive
+    resistance).  Runs at ``quantum_moves=1`` (reference included, so
+    the chunking matches bitwise) — jobs then span enough quanta for
+    the latency window to fill, clear, and restore BEFORE the fleet
+    drains; at the shared QUANTUM the tiny workload finishes in 1-2
+    quanta per job and nothing is ever judged."""
+    requests = synthetic_requests(
+        mesh, 6, class_sizes=CLASSES, n_moves=N_MOVES, seed=SEED + 1,
+    )
+    ref_router = make_router(
+        mesh, cfg, os.path.join(tmpdir, f"{name}-ref"),
+        os.path.join(tmpdir, "bank"), quantum_moves=1,
+    )
+    try:
+        ids = submit_all(ref_router, requests)
+        ref_router.run()
+        ref = {i: np.asarray(ref_router.result(i)) for i in ids}
+    finally:
+        ref_router.close()
+    fleet_dir = os.path.join(tmpdir, name)
+    router = make_router(
+        mesh, cfg, fleet_dir, os.path.join(tmpdir, "bank"),
+        quantum_moves=1,
+    )
+    try:
+        ids = submit_all(router, requests)
+        victim = 0
+        router.members[victim].scheduler.faults = ChaosInjector(
+            ChaosPlan(slow_member=victim, slow_factor=25.0)
+        )
+        supervisor = FleetSupervisor(
+            router, slow_factor=4.0, window=2, heartbeat_misses=2,
+            grace_ticks=50, restore_ticks=1,
+        )
+        quarantined_seen = False
+        for _ in range(100000):
+            pending = router.step()
+            supervisor.tick()
+            if router.members[victim].quarantined and not quarantined_seen:
+                quarantined_seen = True
+                # The brownout clears: whatever throttled the member
+                # (thermal, a noisy neighbor) goes away mid-grace.
+                router.members[victim].scheduler.faults = ChaosInjector(
+                    ChaosPlan()
+                )
+            if not pending and all(j.terminal for j in router.jobs()):
+                break
+        never_evicted = all(m.alive for m in router.members)
+        restored = (
+            not router.members[victim].quarantined
+            and router.members[victim].health == "healthy"
+        )
+        migrations = router.stats()["migrations"]
+        jobs, lost, duplicated = _lost_and_duplicated(router, ids)
+        bitwise, n_compared = _bitwise(router, ref, ids)
+    finally:
+        router.close()
+    trace_problems = fleet_trace_problems(fleet_dir, ids)
+    ok = (
+        quarantined_seen and never_evicted and restored
+        and migrations == 0 and not lost and not duplicated
+        and bitwise and not trace_problems
+    )
+    for p in trace_problems:
+        print(f"[chaos-fleet] {name}: trace check: {p}", flush=True)
+    print(
+        f"[chaos-fleet] {name}: member{victim} 25x slow, clears in "
+        f"quarantine | quarantined={quarantined_seen} "
+        f"never_evicted={never_evicted} restored={restored} "
+        f"migrations={migrations} lost={sorted(lost)} "
+        f"duplicated={duplicated} "
+        f"bitwise({n_compared} jobs)={bitwise} "
+        f"traces({len(ids)} jobs)={not trace_problems} "
+        f"{'OK' if ok else 'FAIL'}",
+        flush=True,
+    )
+    return ok
+
+
+def check_disk_pressure(name, mesh, cfg, ref, requests, tmpdir) -> bool:
+    """Member 0's disk fills on its FIRST durable write after
+    submission: the journal degrades instead of crashing, residents
+    park at the quantum boundary, and the supervisor drains the member
+    cooperatively — zero lost, zero duplicated, every flux bitwise
+    (jobs without a durable checkpoint replay from move 0, which is
+    bitwise by the RNG's move-counter keying)."""
+    fleet_dir = os.path.join(tmpdir, name)
+    router = make_router(
+        mesh, cfg, fleet_dir, os.path.join(tmpdir, "bank"),
+    )
+    try:
+        ids = submit_all(router, requests)
+        victim = 0
+        router.members[victim].scheduler.faults = ChaosInjector(
+            ChaosPlan(disk_full_at=1)
+        )
+        supervisor = FleetSupervisor(
+            router, heartbeat_misses=2, grace_ticks=1,
+        )
+        supervisor.run()
+        degraded = (
+            router.registry.gauge("pumi_journal_degraded")
+            .value(member=f"m{victim}") == 1.0
+        )
+        drained = (
+            not router.members[victim].alive
+            and router.members[victim].health == "evicted"
+        )
+        with open(os.path.join(fleet_dir, "FLEET.json")) as fh:
+            journaled = json.load(fh).get("evicted")
+        journal_proof = journaled == {
+            str(victim): {"cause": "disk-pressured"}
+        }
+        jobs, lost, duplicated = _lost_and_duplicated(router, ids)
+        bitwise, n_compared = _bitwise(router, ref, ids)
+    finally:
+        router.close()
+    trace_problems = fleet_trace_problems(fleet_dir, ids)
+    ok = (
+        degraded and drained and journal_proof and not lost
+        and not duplicated and bitwise and not trace_problems
+    )
+    for p in trace_problems:
+        print(f"[chaos-fleet] {name}: trace check: {p}", flush=True)
+    print(
+        f"[chaos-fleet] {name}: disk_full@write1 on member{victim} | "
+        f"degraded={degraded} drained={drained} "
+        f"journal_proof={journal_proof} lost={sorted(lost)} "
+        f"duplicated={duplicated} "
+        f"bitwise({n_compared} jobs)={bitwise} "
+        f"traces({len(ids)} jobs)={not trace_problems} "
+        f"{'OK' if ok else 'FAIL'}",
+        flush=True,
+    )
+    return ok
+
+
+SCENARIOS = (
+    "member_kill", "router_kill", "retry_storm",
+    "wedged_member", "brownout", "disk_pressure",
+)
 
 
 def main() -> int:
@@ -422,7 +685,7 @@ def main() -> int:
     names = list(SCENARIOS)
     if "--only" in args:
         i = args.index("--only")
-        names = [args[i + 1]]
+        names = [s for s in args[i + 1].split(",") if s]
         del args[i:i + 2]
     # The in-process scenarios drive faults explicitly — scrub any
     # env-level fault spec so member injectors default to none.
@@ -444,6 +707,16 @@ def main() -> int:
                 ok = check_router_kill(name, ref, tmpdir, n_jobs)
             elif name == "retry_storm":
                 ok = check_retry_storm(
+                    name, mesh, cfg, ref, requests, tmpdir
+                )
+            elif name == "wedged_member":
+                ok = check_wedged_member(
+                    name, mesh, cfg, ref, requests, tmpdir
+                )
+            elif name == "brownout":
+                ok = check_brownout(name, mesh, cfg, tmpdir)
+            elif name == "disk_pressure":
+                ok = check_disk_pressure(
                     name, mesh, cfg, ref, requests, tmpdir
                 )
             else:

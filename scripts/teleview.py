@@ -33,7 +33,8 @@ source may be any of:
 single and causally ordered — one trace_id, a submit, a terminal
 ``job`` root span, every parent resolvable — and, when spans come
 from more than one process lifetime, an explicit ``recovered`` (crash
-recovery) or ``migrated`` (cross-member fleet hop) link.  The chaos
+recovery), ``migrated`` (cross-member fleet hop), or ``evicted``
+(supervisor-driven re-placement) link.  The chaos
 campaigns drive this as their postmortem acceptance gate; a FLEET
 directory works as a source too (the router sinks every member's
 spans into one ``<fleet_dir>/TRACE.jsonl``).
@@ -273,9 +274,10 @@ def check_job_trace(trace: list[dict], job_id: str) -> list[str]:
     """Causal-integrity problems with one job's trace (empty = good):
     a single trace id; a submit record; a terminal ``job`` root span;
     every parent resolvable; an explicit cross-lifetime link
-    (``recovered`` — crash recovery — or ``migrated`` — the job hopped
-    fleet members, and a member restart is a new lifetime) whenever
-    spans come from more than one process lifetime."""
+    (``recovered`` — crash recovery; ``migrated`` — the job hopped
+    fleet members, and a member restart is a new lifetime; or
+    ``evicted`` — the supervisor drained it off an unhealthy member)
+    whenever spans come from more than one process lifetime."""
     problems = []
     if not trace:
         return [f"no span records for job {job_id}"]
@@ -299,10 +301,11 @@ def check_job_trace(trace: list[dict], job_id: str) -> list[str]:
     if dangling:
         problems.append(f"unresolvable parent span(s): {sorted(dangling)}")
     pids = {r.get("pid") for r in trace} - {None}
-    if len(pids) > 1 and not {"recovered", "migrated"} & set(names):
+    links = {"recovered", "migrated", "evicted"}
+    if len(pids) > 1 and not links & set(names):
         problems.append(
             f"spans from {len(pids)} process lifetimes but no "
-            "'recovered'/'migrated' link"
+            "'recovered'/'migrated'/'evicted' link"
         )
     return problems
 

@@ -646,6 +646,61 @@ def test_metrics_lint_no_orphan_serving_registry(tmp_path):
     )
 
 
+def test_metrics_lint_no_orphan_fleet_registry(tmp_path):
+    """The fleet-layer twin of the orphan-registry lint: every pumi_*
+    family the router and the self-healing supervisor declare must be
+    reachable from the ONE registry the router's scrape endpoint
+    serves (members and supervisor share it by construction)."""
+    import ast
+    import os
+
+    from pumiumtally_tpu.serving import FleetRouter, FleetSupervisor
+
+    pkg = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "pumiumtally_tpu",
+    )
+    modules = [
+        os.path.join(pkg, "serving", "fleet.py"),
+        os.path.join(pkg, "serving", "supervisor.py"),
+    ]
+    declared: dict[str, str] = {}
+    for path in modules:
+        with open(path) as fh:
+            tree = ast.parse(fh.read(), filename=path)
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("counter", "gauge", "histogram")
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+                and node.args[0].value.startswith("pumi_")
+            ):
+                declared[node.args[0].value] = os.path.basename(path)
+    # Router (3 families) + supervisor (3 families) at minimum.
+    assert len(declared) >= 6, sorted(declared)
+    mesh = build_box(1.0, 1.0, 1.0, 2, 2, 2)
+    router = FleetRouter(
+        mesh, TallyConfig(tolerance=1e-6),
+        fleet_dir=str(tmp_path / "fleet"), n_members=1, bank=None,
+    )
+    try:
+        FleetSupervisor(router)
+        reachable = set(router.registry.snapshot())
+    finally:
+        router.close()
+    orphans = {
+        name: src for name, src in declared.items()
+        if name not in reachable
+    }
+    assert not orphans, (
+        f"pumi_* metrics registered on a registry the router's "
+        f"scrape endpoint cannot reach: {orphans}"
+    )
+
+
 def test_registry_render_safe_under_concurrent_registration():
     """The scrape thread renders while the move loop lazily registers
     (e.g. the fault counters on first injection): iteration must run

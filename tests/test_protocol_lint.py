@@ -515,6 +515,7 @@ _CRASH_SAFETY_MODULES = (
     "pumiumtally_tpu/serving/scheduler.py",
     "pumiumtally_tpu/serving/journal.py",
     "pumiumtally_tpu/serving/fleet.py",
+    "pumiumtally_tpu/serving/supervisor.py",
     "pumiumtally_tpu/resilience/runner.py",
     "pumiumtally_tpu/resilience/store.py",
     "pumiumtally_tpu/utils/checkpoint.py",
@@ -593,12 +594,12 @@ def test_raw_journal_flush_is_a_named_protocol_finding(real_sources):
     journal-document-atomic must fire."""
     jr = "pumiumtally_tpu/serving/journal.py"
     src = real_sources[jr]
-    atomic = "        atomic_write_json(self.path, doc)"
+    atomic = "            atomic_write_json(self.path, doc)"
     assert atomic in src
     bad = src.replace(
         atomic,
-        "        with open(self.path, \"w\") as fh:\n"
-        "            json.dump(doc, fh)",
+        "            with open(self.path, \"w\") as fh:\n"
+        "                json.dump(doc, fh)",
     )
     fs = P.check_sources({**real_sources, jr: bad})
     syms = {f.symbol for f in fs}
@@ -606,6 +607,26 @@ def test_raw_journal_flush_is_a_named_protocol_finding(real_sources):
         f.render() for f in fs
     ]
     assert "require.journal-document-atomic" in syms
+
+
+def test_reordered_eviction_record_is_a_named_protocol_finding(
+    real_sources,
+):
+    """Move the supervisor's FLEET.json eviction record AFTER the
+    drain — the crash window ISSUE 19's ordering exists to close
+    (record-less drain: re-placed jobs under a member the routing
+    journal still calls healthy) must be a named finding on every
+    CFG path through ``_evict``."""
+    sup = "pumiumtally_tpu/serving/supervisor.py"
+    src = real_sources[sup]
+    record = "        self.router.record_eviction(member.index, cause)\n"
+    counter = "        self._evictions_total.inc(cause=cause)\n"
+    assert record in src and counter in src
+    bad = src.replace(record, "").replace(counter, record + counter)
+    fs = P.check_sources({**real_sources, sup: bad})
+    assert "order.eviction-record-before-drain" in {
+        f.symbol for f in fs
+    }, [f.render() for f in fs]
 
 
 def test_path_explosion_is_flagged_not_silently_truncated(real_sources):
