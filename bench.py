@@ -871,6 +871,26 @@ def run_fleet_bench(n_members: int, seed: int) -> dict:
             via_http=False, max_resident=resident,
             quantum_moves=quantum,
         )
+        # A/B the observability plane (ISSUE 20): the identical
+        # workload once more with PUMI_TPU_FLEET_OBS=off — the delta
+        # prices aggregation + SLO evaluation + FLEETSTATS snapshots
+        # at quantum cadence.  The headline jobs_per_sec stays the
+        # plane-ON number (the shipped default).
+        prior = os.environ.get("PUMI_TPU_FLEET_OBS")
+        os.environ["PUMI_TPU_FLEET_OBS"] = "off"
+        try:
+            bare = run_fleet_saturation(
+                mesh, cfg, fleet_dir=os.path.join(tmp, "fleet-bare"),
+                n_members=n_members, bank=bank_dir, n_jobs=n_jobs,
+                class_sizes=classes, n_moves=moves, seed=seed,
+                via_http=False, max_resident=resident,
+                quantum_moves=quantum,
+            )
+        finally:
+            if prior is None:
+                os.environ.pop("PUMI_TPU_FLEET_OBS", None)
+            else:
+                os.environ["PUMI_TPU_FLEET_OBS"] = prior
         st = out["fleet"]
         return {
             "fleet": {
@@ -887,6 +907,14 @@ def run_fleet_bench(n_members: int, seed: int) -> dict:
                 "outcomes": st["outcomes"],
                 "aot_hits": (st["aot"] or {}).get("hits", 0),
                 "aot_misses": (st["aot"] or {}).get("misses", 0),
+                "obs_plane": {
+                    "jobs_per_sec_on": out["jobs_per_sec"],
+                    "jobs_per_sec_off": bare["jobs_per_sec"],
+                    "overhead_pct": round(
+                        (bare["jobs_per_sec"] - out["jobs_per_sec"])
+                        / bare["jobs_per_sec"] * 100.0, 2,
+                    ) if bare["jobs_per_sec"] else None,
+                },
             }
         }
     finally:

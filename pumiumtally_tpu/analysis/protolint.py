@@ -113,6 +113,10 @@ _SIMPLE_EFFECTS = {
     "record_eviction": "eviction.record",
     "drain_member": "member.drain",
     "drain_member_from_journal": "member.drain",
+    # SLO advisory (obs/slo.py → serving/supervisor.py): the
+    # FLEET.json breach record and the quarantine it must precede.
+    "record_breach": "breach.record",
+    "_quarantine": "member.quarantine",
 }
 
 #: fully-dotted deletion heads (``remove`` alone would match
@@ -410,6 +414,30 @@ PROTOCOLS: tuple[Protocol, ...] = (
             "rebuild its device state and re-adopt jobs that now "
             "live (and run) elsewhere: the double-run the eviction "
             "machinery exists to rule out."
+        ),
+    ),
+    Protocol(
+        name="breach-record-before-quarantine",
+        path=f"{PACKAGE}/serving/supervisor.py",
+        function="FleetSupervisor._advise_slo",
+        constraints=(
+            {"kind": "require", "effect": "breach.record"},
+            {"kind": "require", "effect": "member.quarantine"},
+            {"kind": "before", "before": "breach.record",
+             "after": "member.quarantine", "required": True},
+        ),
+        rationale=(
+            "An SLO-driven quarantine is advisory, not observed: no "
+            "probe failed, the member was convicted by burn-rate "
+            "attribution (obs/slo.py).  The FLEET.json breach record "
+            "is flushed BEFORE the quarantine takes effect, so a "
+            "supervisor crash mid-advice leaves a journal that says "
+            "WHY the member stopped taking placements — the operator "
+            "(and fleetview --check) can audit the conviction.  "
+            "Reversed, a crash after the quarantine but before the "
+            "record leaves a member mysteriously sidelined with no "
+            "journaled cause: an unexplained capacity loss the "
+            "observability plane exists to rule out."
         ),
     ),
     Protocol(

@@ -8,7 +8,12 @@ Three layers (see each module's docstring):
     Prometheus text exposition, and JSONL emission;
   * ``recorder`` / ``telemetry`` — the per-move flight recorder and the
     facade helper that feeds it (``PumiTally.telemetry()``,
-    ``PartitionedTally.telemetry()``).
+    ``PartitionedTally.telemetry()``);
+  * ``aggregate`` / ``slo`` / ``profile`` — the fleet observability
+    plane: per-member registry aggregation (``/fleetz`` +
+    FLEETSTATS.json), declarative SLOs with multi-window burn-rate
+    alerting, and per-quantum device profiling with capture-on-anomaly
+    (``PUMI_TPU_PROFILE=anomaly``).
 
 Env knobs: ``PUMI_TPU_METRICS=jsonl:/path`` streams every flight record
 to that file; ``PUMI_TPU_LOG_JSON=1`` renders the debug-level copies the
@@ -24,8 +29,16 @@ from .convergence import (
     conv_to_dict,
     reduce_chip_conv,
 )
+from .aggregate import (
+    FLEETSTATS_FILE,
+    FLEETSTATS_SCHEMA,
+    FleetAggregator,
+    render_snapshot_prometheus,
+)
 from .exporter import MetricsExporter, maybe_start_exporter
+from .profile import FleetProfiler, profile_mode
 from .recorder import FLIGHT_SCHEMA, FlightRecorder
+from .slo import SLO, SLOEvaluator, default_slos
 from .trace import (
     NO_PARENT,
     TRACE_SCHEMA,
@@ -65,6 +78,15 @@ __all__ = [
     "TallyTelemetry",
     "MetricsExporter",
     "maybe_start_exporter",
+    "FleetAggregator",
+    "FLEETSTATS_FILE",
+    "FLEETSTATS_SCHEMA",
+    "render_snapshot_prometheus",
+    "SLO",
+    "SLOEvaluator",
+    "default_slos",
+    "FleetProfiler",
+    "profile_mode",
     "WALK_STATS_FIELDS",
     "WALK_STATS_LEN",
     "IDX",

@@ -17,8 +17,13 @@ with the process).  Endpoints:
     tracer's ring as chrome://tracing JSON, loadable in Perfetto and
     consumed by ``scripts/teleview.py --job`` against a live server);
     a fleet router additionally mounts ``/fleet`` (per-member routing
-    + liveness JSON).  ``/buildz`` and 404 bodies enumerate whatever
-    is mounted.
+    + liveness JSON) and ``/fleetz`` (the FleetAggregator's merged
+    Prometheus rollup of every member registry — obs/aggregate.py).
+    ``/buildz`` and 404 bodies enumerate whatever is mounted.
+    Endpoint callables may declare one positional parameter to
+    receive the parsed query string (``/jobs?limit=50`` caps the job
+    table, default 500 newest-first), and may return a pre-rendered
+    ``str`` to serve Prometheus text instead of JSON.
 
 Unknown paths answer 404 with a body NAMING the valid endpoints —
 a misremembered path should teach, not stonewall.
@@ -31,16 +36,39 @@ and the run continues — observability must never take a run down.
 """
 from __future__ import annotations
 
+import inspect
 import json
 import os
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs
 
 from ..utils.log import log_info, log_warn
 
 PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 ENV_PORT = "PUMI_TPU_PROM_PORT"
+
+
+def _accepts_query(fn) -> bool:
+    """True when an endpoint callable OPTS IN to the parsed query
+    dict by declaring a positional parameter literally named
+    ``query`` (decided by signature, not by trial call — a TypeError
+    from inside the endpoint must surface as a 500, not be mistaken
+    for an arity probe).  The name requirement is the contract: an
+    endpoint with an unrelated optional positional (``chrome``'s
+    ``records=None``) must NOT be handed the query dict."""
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):  # pragma: no cover - builtins
+        return False
+    for p in sig.parameters.values():
+        if p.name == "query" and p.kind in (
+            inspect.Parameter.POSITIONAL_ONLY,
+            inspect.Parameter.POSITIONAL_OR_KEYWORD,
+        ):
+            return True
+    return False
 
 
 def build_info() -> dict:
@@ -79,13 +107,18 @@ class MetricsExporter:
     def __init__(self, registry, port: int, host: str = "127.0.0.1",
                  endpoints: dict | None = None):
         self.registry = registry
-        # path -> zero-arg callable returning a JSON-able object.
+        # path -> callable returning either a JSON-able object (served
+        # as application/json) or a pre-rendered str (served as
+        # Prometheus text — the fleet router's /fleetz rollup).  A
+        # callable with a positional parameter receives the parsed
+        # query string as {key: last value} (e.g. /jobs?limit=50);
+        # zero-arg callables keep working unchanged.
         self.endpoints = dict(endpoints or {})
         exporter = self
 
         class _Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 — http.server API
-                path = self.path.split("?", 1)[0]
+                path, _, rawq = self.path.partition("?")
                 try:
                     if path in ("/", "/metrics"):
                         body = (
@@ -111,12 +144,19 @@ class MetricsExporter:
                         ).encode()
                         ctype = "application/json"
                     elif path in exporter.endpoints:
-                        body = (
-                            json.dumps(
-                                exporter.endpoints[path](), default=str
-                            ) + "\n"
-                        ).encode()
-                        ctype = "application/json"
+                        query = {
+                            k: v[-1]
+                            for k, v in parse_qs(rawq).items()
+                        }
+                        result = exporter._call(path, query)
+                        if isinstance(result, str):
+                            body = result.encode()
+                            ctype = PROM_CONTENT_TYPE
+                        else:
+                            body = (
+                                json.dumps(result, default=str) + "\n"
+                            ).encode()
+                            ctype = "application/json"
                     else:
                         known = ", ".join(
                             ["/metrics", "/healthz", "/buildz"]
@@ -170,6 +210,15 @@ class MetricsExporter:
             daemon=True,
         )
         self._thread.start()
+
+    def _call(self, path: str, query: dict):
+        """Invoke one mounted endpoint, passing the parsed query dict
+        to callables declaring a positional parameter (``/jobs`` takes
+        ``?limit=``) and nothing to the zero-arg ones."""
+        fn = self.endpoints[path]
+        if _accepts_query(fn):
+            return fn(query)
+        return fn()
 
     @property
     def port(self) -> int:

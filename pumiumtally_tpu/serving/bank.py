@@ -642,6 +642,42 @@ class ProgramBank:
             os.replace(tmp, os.path.join(entry_dir, name))
 
     # ------------------------------------------------------------------ #
+    def memory_analysis(self) -> dict:
+        """HBM footprint over every program RESOLVED for dispatch so
+        far (the fleet profiler's high-water source).  Per executable
+        the footprint is argument + output + temp bytes from XLA's
+        ``memory_analysis()``; executables that expose none —
+        deserialized entries report empty analyses (the PR 9 finding),
+        and CPU backends may expose nothing at all — count as
+        ``unanalyzed`` rather than as zero-byte programs."""
+        high = 0
+        analyzed = unanalyzed = 0
+        with self._lock:
+            programs = list(self._programs.values())
+        for prog in programs:
+            if prog.compiled is None:
+                continue
+            try:
+                ma = prog.compiled.memory_analysis()
+                footprint = int(
+                    getattr(ma, "argument_size_in_bytes", 0)
+                    + getattr(ma, "output_size_in_bytes", 0)
+                    + getattr(ma, "temp_size_in_bytes", 0)
+                )
+            except Exception:
+                unanalyzed += 1
+                continue
+            if footprint <= 0:
+                unanalyzed += 1
+                continue
+            analyzed += 1
+            high = max(high, footprint)
+        return {
+            "high_water_bytes": high,
+            "analyzed": analyzed,
+            "unanalyzed": unanalyzed,
+        }
+
     def entries_on_disk(self) -> list[str]:
         """Committed entry keys in this environment's section."""
         if not os.path.isdir(self.section_dir):

@@ -24,7 +24,10 @@ FLEET.json document (schema 1)::
    "requests":    {job_id: request_json},   # journaled, not yet
                                             # dispatched to a member
    "assignments": {job_id: {"member": K, "migrations": J}},
-   "evicted":     {member_index: {"cause": ...}}}  # supervisor evictions
+   "evicted":     {member_index: {"cause": ...}},  # supervisor evictions
+   "breaches":    {member_index: [{"slo": ..., "burn": ...}]}}
+                                  # SLO breach advisories journaled
+                                  # before the quarantine they explain
 
 Write-ahead orderings (machine-checked by analysis/protolint.py, not
 chaos-only):
@@ -87,6 +90,13 @@ from ..obs import (
     SpanTracer,
     maybe_start_exporter,
 )
+from ..obs.aggregate import (
+    FLEETSTATS_FILE,
+    FLEETSTATS_SCHEMA,
+    FleetAggregator,
+)
+from ..obs.profile import FleetProfiler
+from ..obs.slo import SLOEvaluator, default_slos
 from ..resilience.faultinject import FaultInjector, InjectedKill
 from ..tuning.shapes import bucket, classify
 from ..utils.checkpoint import atomic_write_json
@@ -104,6 +114,15 @@ from .scheduler import JobRequest, TallyScheduler, _quiet_exporter
 
 FLEET_SCHEMA = 1
 FLEET_FILE = "FLEET.json"
+
+# The fleet observability plane (aggregator + SLO evaluation +
+# profiler sampling + FLEETSTATS.json snapshots) is ON by default;
+# PUMI_TPU_FLEET_OBS=off disables it wholesale (the bench's A/B knob).
+ENV_FLEET_OBS = "PUMI_TPU_FLEET_OBS"
+
+
+def _fleet_obs_enabled() -> bool:
+    return os.environ.get(ENV_FLEET_OBS, "").strip().lower() != "off"
 
 
 class FleetJournal:
@@ -167,9 +186,15 @@ class FleetMember:
     guarded by ``.alive``, which is False for such a slot.
     """
 
-    def __init__(self, index: int, scheduler: TallyScheduler | None):
+    def __init__(self, index: int, scheduler: TallyScheduler | None,
+                 registry: MetricsRegistry | None = None):
         self.index = index
         self.scheduler = scheduler
+        #: This member's OWN metrics registry (every scheduler family
+        #: lands here, attributable to the member).  It outlives the
+        #: scheduler — an evicted member's counters stay in the fleet
+        #: rollup, keeping the aggregated counters monotonic.
+        self.registry = registry
         self.alive = scheduler is not None
         #: Supervisor classification: healthy / brownout / wedged /
         #: disk-pressured while alive; "evicted" once drained
@@ -191,7 +216,11 @@ class FleetMember:
 
 class FleetRouter:
     """Crash-safe job routing over ``n_members`` schedulers sharing one
-    mesh, config, AOT bank, metrics registry, tracer, and recorder.
+    mesh, config, AOT bank, tracer, and recorder.  Each member keeps
+    its OWN metrics registry (``FleetMember.registry``); the router's
+    registry holds the fleet/supervisor/SLO families, and the
+    observability plane (obs/aggregate.py) merges the member
+    registries into the ``/fleetz`` rollup + FLEETSTATS.json.
 
     Thread model: the router's scheduling loop (``step``/``run``) and
     the gateway's HTTP handler threads (serving/gateway.py) serialize
@@ -210,6 +239,7 @@ class FleetRouter:
         registry: MetricsRegistry | None = None,
         faults: FaultInjector | None = None,
         absorb_member_kills: bool = False,
+        slos: tuple | None = None,
         _recover: bool = False,
         _evicted: tuple = (),
         **member_kwargs,
@@ -254,7 +284,14 @@ class FleetRouter:
         self._pending: dict[str, JobRequest] = {}
         self._assignments: dict[str, dict] = {}
         self._evicted: dict[int, dict] = {}     # member index -> {cause}
+        #: SLO breach advisories journaled by the supervisor BEFORE it
+        #: quarantines the offender (breach-record-before-quarantine):
+        #: {member index: [{"slo": ..., "burn": ...}, ...]}.
+        self._breaches: dict[int, list] = {}
         self._n_submitted = 0
+        # Alert edges already handed to the profiler's capture hook
+        # (keyed by (slo, since) so a re-fired alert captures again).
+        self._seen_alerts: set = set()
         # Members never bind the scrape port (the ROUTER's exporter
         # owns it, with the fleet endpoints mounted) and never install
         # signal handlers (their write-ahead journals are flushed at
@@ -267,10 +304,15 @@ class FleetRouter:
                 self.members.append(FleetMember(i, None))
                 continue
             mdir = self.journal.member_dir(i)
+            # Every member gets its OWN registry (the aggregator's
+            # contract, obs/aggregate.py): scheduler families are
+            # attributable per member and merge into the fleet rollup
+            # instead of silently interleaving in one shared table.
+            mreg = MetricsRegistry()
             mkw = dict(
                 member_kwargs,
                 bank=self.bank,
-                registry=self.registry,
+                registry=mreg,
                 tracer=self.tracer,
                 recorder=self.recorder,
                 blackbox_dir=self.journal.dir,
@@ -289,7 +331,7 @@ class FleetRouter:
                     sched = TallyScheduler(
                         mesh, config, journal_dir=mdir, **mkw
                     )
-            member = FleetMember(i, sched)
+            member = FleetMember(i, sched, registry=mreg)
             for j in sched.jobs():
                 member.warm.add(j.shape_key)
             # A recovered member's journaled jobs count as placements
@@ -297,15 +339,39 @@ class FleetRouter:
             # ownership, not just this lifetime's dispatches.
             member.placed = len(sched.jobs())
             self.members.append(member)
+        # The observability plane (aggregate + SLO + profile — the
+        # three obs/ layers).  PUMI_TPU_FLEET_OBS=off runs the fleet
+        # bare: no aggregation, no burn-rate gauges, no FLEETSTATS
+        # snapshots (the bench's A/B knob).
+        self.obs_enabled = _fleet_obs_enabled()
+        self.aggregator: FleetAggregator | None = None
+        self.slo: SLOEvaluator | None = None
+        self.profiler: FleetProfiler | None = None
+        if self.obs_enabled:
+            self.aggregator = FleetAggregator(self._obs_registries)
+            self.slo = SLOEvaluator(
+                default_slos() if slos is None else slos,
+                self.registry, self.recorder,
+            )
+            self.profiler = FleetProfiler(
+                self.registry, journal_dir=self.journal.dir,
+                bank=self.bank,
+            )
+        endpoints = {
+            "/jobs": self._jobs_json,
+            "/trace": self.tracer.chrome,
+            "/fleet": self.fleet_json,
+        }
+        if self.aggregator is not None:
+            endpoints["/fleetz"] = self.aggregator.render_prometheus
         self._exporter = maybe_start_exporter(
-            self.registry,
-            endpoints={
-                "/jobs": self._jobs_json,
-                "/trace": self.tracer.chrome,
-                "/fleet": self.fleet_json,
-            },
+            self.registry, endpoints=endpoints,
         )
         self._update_gauges()
+        # First FLEETSTATS snapshot: the last-known fleet picture must
+        # exist from round zero — a router killed before its first
+        # step still leaves one for fleetview to reconstruct.
+        self.obs_tick()
 
     # ------------------------------------------------------------------ #
     # The routing journal
@@ -322,7 +388,25 @@ class FleetRouter:
             "evicted": {
                 str(k): dict(v) for k, v in self._evicted.items()
             },
+            "breaches": {
+                str(k): [dict(b) for b in v]
+                for k, v in self._breaches.items()
+            },
         })
+
+    def record_breach(self, index: int, alert: dict) -> None:
+        """Journal an SLO breach advisory against member ``index``
+        BEFORE the supervisor quarantines it
+        (breach-record-before-quarantine, protolint-checked on
+        ``FleetSupervisor._advise_slo``): the quarantine decision must
+        be explainable from FLEET.json alone — a crash right after the
+        quarantine flag flips still leaves the WHY on disk."""
+        with self.lock:
+            self._breaches.setdefault(int(index), []).append({
+                "slo": str(alert.get("slo")),
+                "burn": dict(alert.get("burn") or {}),
+            })
+            self._flush_fleet()
 
     def record_eviction(self, index: int, cause: str) -> None:
         """Journal the decision to evict member ``index`` BEFORE any
@@ -708,6 +792,64 @@ class FleetRouter:
             return moved
 
     # ------------------------------------------------------------------ #
+    # The observability plane (obs/aggregate.py, obs/slo.py,
+    # obs/profile.py — constructed in __init__, ticked per round)
+    # ------------------------------------------------------------------ #
+    def _obs_registries(self) -> list:
+        """Aggregation sources: every member that EVER had a registry
+        (dead members included — their counters must stay in the fold
+        so the fleet rollup never moves backwards)."""
+        return [
+            (f"m{m.index}", m.registry)
+            for m in self.members if m.registry is not None
+        ]
+
+    def _obs_members(self) -> list:
+        """The SLO/profiler view: (index, label, registry, alive)."""
+        return [
+            (m.index, f"m{m.index}", m.registry, m.alive)
+            for m in self.members
+        ]
+
+    def fleetstats_path(self) -> str:
+        return os.path.join(self.journal.dir, FLEETSTATS_FILE)
+
+    def slo_alerts_by_member(self) -> dict:
+        """Active SLO alerts grouped by attributed member — the
+        supervisor's advisory input (empty with the plane off)."""
+        with self.lock:
+            if self.slo is None:
+                return {}
+            return self.slo.alerts_by_member()
+
+    def obs_tick(self) -> None:
+        """One quantum-cadence pass of the observability plane:
+        evaluate SLO burn rates (alert edges arm the profiler's
+        anomaly capture), sample per-member utilization, and snapshot
+        the merged fleet picture atomically to FLEETSTATS.json — a
+        dead router still leaves a last-known truth source on disk.
+        No-op with PUMI_TPU_FLEET_OBS=off."""
+        with self.lock:
+            if not self.obs_enabled:
+                return
+            members = self._obs_members()
+            alerts = self.slo.evaluate(members)
+            for alert in list(alerts.values()):
+                edge = (alert["slo"], alert["since"])
+                if edge not in self._seen_alerts:
+                    self._seen_alerts.add(edge)
+                    self.profiler.on_alert(alert)
+            self.profiler.sample(members)
+            atomic_write_json(self.fleetstats_path(), {
+                "schema": FLEETSTATS_SCHEMA,
+                "fleet": self.fleet_json(),
+                "slo": self.slo.status(),
+                "profile": self.profiler.status(),
+                "metrics": self.aggregator.merge(),
+                "router_metrics": self.registry.snapshot(),
+            })
+
+    # ------------------------------------------------------------------ #
     # The scheduling loop
     # ------------------------------------------------------------------ #
     def step(self) -> bool:
@@ -738,6 +880,7 @@ class FleetRouter:
                     self._absorb_death(member, reason="injected-kill")
                     pending = True
             self._update_gauges()
+            self.obs_tick()
             return pending
 
     def run(self, max_rounds: int = 100000) -> None:
@@ -811,6 +954,10 @@ class FleetRouter:
                     for k, v in doc.get("assignments", {}).items()
                 }
                 router._evicted = evicted
+                router._breaches = {
+                    int(k): [dict(b) for b in v]
+                    for k, v in doc.get("breaches", {}).items()
+                }
                 router._n_submitted = int(doc.get("n_submitted", 0))
                 router._reconcile()
         except BaseException:
@@ -962,17 +1109,30 @@ class FleetRouter:
                 member=f"m{m.index}",
             )
 
-    def _jobs_json(self) -> dict:
+    def _jobs_json(self, query: dict | None = None) -> dict:
         """Aggregated job table for the exporter's ``/jobs``: every
-        member's rows plus the owning member index."""
+        member's rows plus the owning member index, capped at
+        ``?limit=`` rows (default 500), newest first — same contract
+        as the solo scheduler's table."""
+        from .scheduler import _jobs_limit
+
+        limit = _jobs_limit(query)
         with self.lock:
             rows = []
+            total = 0
             for m in self.members:
                 if not m.alive:
                     continue
-                for row in m.scheduler._jobs_json()["jobs"]:
+                table = m.scheduler._jobs_json({"limit": limit})
+                total += table["total_jobs"]
+                for row in table["jobs"]:
                     rows.append(dict(row, member=m.index))
-            rows.sort(key=lambda r: r["id"])
+            # Newest first across members: the per-member submission
+            # ordinal is the freshness signal (ids tie-break so the
+            # order is total).
+            rows.sort(
+                key=lambda r: (r["index"], r["id"]), reverse=True
+            )
             return {
                 "schema": FLIGHT_SCHEMA,
                 "queue_depth": sum(
@@ -983,7 +1143,9 @@ class FleetRouter:
                     m.scheduler.resident_count
                     for m in self.members if m.alive
                 ),
-                "jobs": rows,
+                "total_jobs": total,
+                "limit": limit,
+                "jobs": rows[:limit],
             }
 
     def fleet_json(self) -> dict:
@@ -1066,6 +1228,11 @@ class FleetRouter:
                 if m.alive:
                     m.scheduler.close()
             self._flush_fleet()
+            # Final fleet picture (and close any open anomaly capture)
+            # before the exporter goes away.
+            if self.profiler is not None:
+                self.profiler.stop_capture()
+            self.obs_tick()
             if self._exporter is not None:
                 self._exporter.stop()
                 self._exporter = None
@@ -1078,6 +1245,8 @@ class FleetRouter:
             for m in self.members:
                 if m.alive:
                     m.scheduler.abandon()
+            if self.profiler is not None:
+                self.profiler.stop_capture()
             if self._exporter is not None:
                 self._exporter.stop()
                 self._exporter = None

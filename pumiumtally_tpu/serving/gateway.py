@@ -12,7 +12,13 @@ daemon threads, dies with the process) in front of a ``FleetRouter``:
     journaled in FLEET.json BEFORE the job is accepted onto any member
     (``FleetRouter.submit``, protolint-verified), so a client that
     times out and retries the POST gets the SAME job id back and never
-    starts a second execution.  Answers ``{"job": id}``.
+    starts a second execution.  An optional ``traceparent`` header
+    (W3C ``00-<32 hex>-<16 hex>-<2 hex>``, or a bare 16-32 hex trace
+    id) makes the job JOIN the caller's distributed trace instead of
+    minting its own root; a malformed header is a 400 — a client that
+    tried to join a trace deserves a refusal, not a silent fork.
+    Answers ``{"job": id, "trace_id": ...}`` (the dedup path returns
+    the ORIGINAL submission's trace, matching the job that runs).
   * ``GET /status/<job>`` — state/outcome/moves/member/trace identity.
   * ``GET /result/<job>`` — the finished flux, bitwise: dtype + shape
     + base64 of the raw little-endian buffer (json floats would be
@@ -21,9 +27,12 @@ daemon threads, dies with the process) in front of a ``FleetRouter``:
   * ``GET /progress/<job>?since=N&timeout=S`` — streams the job's
     flight records as JSONL, one line per record, polling the fleet's
     shared recorder until the job is terminal (or ``timeout`` seconds
-    pass).  Served with HTTP/1.0 connection-close framing — no
-    Content-Length, the closed socket ends the stream — so ``curl``
-    tails live progress with zero client smarts.
+    pass).  Every row carries the job's ``trace_id``, so a tailing
+    client can correlate the stream with the span log (TRACE.jsonl /
+    teleview) without a second lookup.  Served with HTTP/1.0
+    connection-close framing — no Content-Length, the closed socket
+    ends the stream — so ``curl`` tails live progress with zero
+    client smarts.
   * ``POST /cancel`` — body ``{"job": id}``; answers
     ``{"job": id, "cancelled": bool}`` (false: already terminal).
   * ``GET /healthz`` — liveness for load balancers.
@@ -57,6 +66,7 @@ from __future__ import annotations
 import base64
 import json
 import math
+import re
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -69,6 +79,33 @@ ROUTES = (
     "POST /submit", "POST /cancel", "GET /status/<job>",
     "GET /result/<job>", "GET /progress/<job>", "GET /healthz",
 )
+
+# W3C trace-context header (version-traceid-parentid-flags), or the
+# bare trace id our own SpanTracer mints (16 hex) / other tracers'
+# 32-hex ids.  The trace id is all the fleet keeps — span parentage
+# inside the job is ours, the caller only needs the join key.
+_W3C_TRACEPARENT = re.compile(
+    r"00-([0-9a-f]{32})-[0-9a-f]{16}-[0-9a-f]{2}"
+)
+_BARE_TRACE_ID = re.compile(r"[0-9a-f]{16,32}")
+
+
+def parse_traceparent(header: str | None) -> str | None:
+    """The caller's trace id from a ``traceparent`` header, or None
+    when the header is absent/blank (the job mints its own trace).
+    Raises ValueError on a malformed non-empty header."""
+    if header is None or not header.strip():
+        return None
+    text = header.strip().lower()
+    m = _W3C_TRACEPARENT.fullmatch(text)
+    if m is not None:
+        return m.group(1)
+    if _BARE_TRACE_ID.fullmatch(text):
+        return text
+    raise ValueError(
+        f"traceparent {header!r} is neither W3C "
+        "00-<32 hex>-<16 hex>-<2 hex> nor a bare 16-32 hex trace id"
+    )
 
 
 class TallyGateway:
@@ -104,7 +141,10 @@ class TallyGateway:
                 try:
                     path = self.path.split("?", 1)[0]
                     if path == "/submit":
-                        self._answer(gateway._submit(self._body()))
+                        self._answer(gateway._submit(
+                            self._body(),
+                            traceparent=self.headers.get("traceparent"),
+                        ))
                     elif path == "/cancel":
                         self._answer(gateway._cancel(self._body()))
                     else:
@@ -189,6 +229,10 @@ class TallyGateway:
                         (404, {"error": f"unknown job {job_id!r}"})
                     )
                     return
+                try:
+                    trace_id = gateway.router.job(job_id).trace_id
+                except KeyError:  # pragma: no cover - races a drop
+                    trace_id = None
                 self.send_response(200)
                 self.send_header(
                     "Content-Type", "application/jsonl"
@@ -197,8 +241,10 @@ class TallyGateway:
                 deadline = time.monotonic() + timeout
                 while True:
                     for rec in records:
+                        row = dict(rec)
+                        row.setdefault("trace_id", trace_id)
                         self.wfile.write(
-                            (json.dumps(rec, sort_keys=True,
+                            (json.dumps(row, sort_keys=True,
                                         default=str) + "\n").encode()
                         )
                         since = max(since, rec.get("seq", since))
@@ -234,7 +280,11 @@ class TallyGateway:
     # ------------------------------------------------------------------ #
     # Route handlers (return (status, json-able payload))
     # ------------------------------------------------------------------ #
-    def _submit(self, body: bytes):
+    def _submit(self, body: bytes, traceparent: str | None = None):
+        try:
+            caller_trace = parse_traceparent(traceparent)
+        except ValueError as e:
+            return 400, {"error": str(e)}
         try:
             payload = json.loads(body.decode() or "null")
         except ValueError as e:
@@ -258,6 +308,11 @@ class TallyGateway:
             return 400, {
                 "error": f"bad request: {type(e).__name__}: {e}"
             }
+        # The caller's traceparent wins only when the wire request did
+        # not already carry a trace id (a retried submit round-trips
+        # the original identity through the body).
+        if caller_trace is not None and request.trace_id is None:
+            request.trace_id = caller_trace
         # Backpressure answers BEFORE router.submit journals anything:
         # a 503'd request must not burn an idempotency key on a job no
         # member would admit (module docstring).
@@ -276,7 +331,11 @@ class TallyGateway:
             # No alive member to place on (mid-eviction trough): the
             # request is retryable, not wrong.
             return self._too_busy(str(e))
-        return 200, {"job": accepted}
+        try:
+            trace_id = self.router.job(accepted).trace_id
+        except KeyError:  # pragma: no cover - races an instant drop
+            trace_id = caller_trace
+        return 200, {"job": accepted, "trace_id": trace_id}
 
     def _too_busy(self, reason: str):
         """503 + Retry-After + jittered-backoff guidance (module

@@ -143,6 +143,7 @@ def request_to_json(request) -> dict:
             None if src is None else dataclasses.asdict(src)
         ),
         "job_id": request.job_id,
+        "trace_id": getattr(request, "trace_id", None),
     }
 
 
@@ -164,6 +165,7 @@ def request_from_json(d: dict):
             else np.asarray(d["groups"], np.int32)
         ),
         job_id=d.get("job_id"),
+        trace_id=d.get("trace_id"),
     )
 
 

@@ -89,7 +89,8 @@ persists each job's trace_id (schema 2), so a recovered job CONTINUES
 its trace across a server crash; spans stream to
 ``<journal_dir>/TRACE.jsonl``.  Device-time attribution: the
 wall-clock around each blocked dispatch accumulates into
-``pumi_job_device_seconds{job}`` and ``Job.device_seconds``; SLO
+``pumi_job_device_seconds{member=}`` (per-job attribution stays on
+``Job.device_seconds`` and the /jobs rows); SLO
 histograms ``pumi_job_e2e_seconds`` and
 ``pumi_job_time_to_first_quantum_seconds`` time the full job arc and
 the admission latency.  The crash black box dumps the tracer's ring
@@ -147,6 +148,20 @@ QUEUED, RESIDENT, PREEMPTED, DONE = (
     "queued", "resident", "preempted", "done",
 )
 
+# /jobs scrape cap: rows returned by the exporter's job table unless
+# the scrape overrides with ?limit= (newest rows first).
+JOBS_JSON_LIMIT = 500
+
+
+def _jobs_limit(query: dict | None) -> int:
+    """Resolve ``?limit=`` from a parsed query dict; malformed values
+    fall back to the default rather than 500-ing a scrape."""
+    try:
+        limit = int((query or {}).get("limit", JOBS_JSON_LIMIT))
+    except (TypeError, ValueError):
+        return JOBS_JSON_LIMIT
+    return max(0, limit)
+
 
 @dataclasses.dataclass
 class JobRequest:
@@ -162,6 +177,10 @@ class JobRequest:
     weights: np.ndarray | None = None
     groups: np.ndarray | None = None
     job_id: str | None = None
+    #: Caller-supplied trace identity (the gateway's ``traceparent``
+    #: header lands here): the job JOINS this trace instead of minting
+    #: a root, so an external client can follow its job end-to-end.
+    trace_id: str | None = None
 
 
 class Job:
@@ -195,8 +214,9 @@ class Job:
         self.finished_s: float | None = None
         # Distributed-trace identity + device-time attribution
         # (obs/trace.py; persisted in the schema-2 journal so both
-        # survive a server crash).
-        self.trace_id: str = SpanTracer.new_trace()
+        # survive a server crash).  A caller-supplied request trace id
+        # (gateway ``traceparent``) is joined, not re-minted.
+        self.trace_id: str = request.trace_id or SpanTracer.new_trace()
         self.device_seconds = 0.0  # wall around blocked dispatches
         self.first_dispatch_s: float | None = None
 
@@ -436,9 +456,17 @@ class TallyScheduler:
         )
         self._device_seconds = r.counter(
             "pumi_job_device_seconds",
-            "wall seconds spent inside blocked quantum dispatches, "
-            "attributed per job (labeled by job id) — the device-time "
-            "share of each job's end-to-end latency",
+            "wall seconds spent inside blocked quantum dispatches "
+            "(labeled by fleet member — per-JOB attribution lives on "
+            "Job.device_seconds and the /jobs rows; a per-job-id "
+            "label here would grow the family without bound)",
+        )
+        self._quantum_wall_seconds = r.counter(
+            "pumi_quantum_wall_seconds_total",
+            "cumulative wall seconds inside scheduling quanta "
+            "(device dispatch + host overhead + retries + injected "
+            "latency), labeled by fleet member — the fleet profiler's "
+            "dispatch-wait breakdown reads device vs quantum wall",
         )
         self._e2e_seconds = r.histogram(
             "pumi_job_e2e_seconds",
@@ -1385,7 +1413,12 @@ class TallyScheduler:
             # (success, poison return, injected kill unwinding).
             job.device_seconds += disp_s
             if disp_s > 0:
-                self._device_seconds.inc(disp_s, job=job.id)
+                self._device_seconds.inc(
+                    disp_s, member=self._member_label()
+                )
+            self._quantum_wall_seconds.inc(
+                time.perf_counter() - t0, member=self._member_label()
+            )
             if job.first_dispatch_s is None and disp_s > 0:
                 job.first_dispatch_s = time.perf_counter()
                 self._ttfq_seconds.observe(
@@ -1653,17 +1686,27 @@ class TallyScheduler:
     def jobs(self) -> list[Job]:
         return list(self._jobs.values())
 
-    def _jobs_json(self) -> dict:
+    def _jobs_json(self, query: dict | None = None) -> dict:
         """The live job table for the exporter's ``/jobs`` endpoint
         (and teleview): one JSON row per job with its trace identity
-        and device-time attribution."""
+        and device-time attribution.  The table is capped at
+        ``?limit=`` rows (default ``JOBS_JSON_LIMIT``), NEWEST first —
+        a long-lived server accumulates terminal rows without bound
+        and a scrape surface must stay scrape-sized."""
+        limit = _jobs_limit(query)
+        rows = sorted(
+            self._jobs.values(), key=lambda j: j.index, reverse=True
+        )
         return {
             "schema": FLIGHT_SCHEMA,
             "queue_depth": self.queue_depth,
             "resident": len(self._resident),
+            "total_jobs": len(rows),
+            "limit": limit,
             "jobs": [
                 {
                     "id": j.id,
+                    "index": j.index,
                     "state": j.state,
                     "outcome": j.outcome,
                     "error": j.error,
@@ -1676,9 +1719,7 @@ class TallyScheduler:
                     "trace_id": j.trace_id,
                     "device_seconds": round(j.device_seconds, 6),
                 }
-                for j in sorted(
-                    self._jobs.values(), key=lambda j: j.index
-                )
+                for j in rows[:limit]
             ],
         }
 

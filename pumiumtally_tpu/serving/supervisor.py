@@ -19,6 +19,8 @@ the detect-decide-drain loop with NO explicit kill signal anywhere:
              healthy ──(median quantum > slow_factor x
                         fleet median over `window` quanta)──▶ brownout
              healthy ──(journal.degraded)──▶ disk-pressured
+             healthy ──(SLO burn-rate alert attributes this
+                        member — obs/slo.py advisory)──▶ slo-burn
 
            Any unhealthy state QUARANTINES the member first (it keeps
            its jobs and keeps running, but receives no new
@@ -54,8 +56,8 @@ Metrics (on the router's registry, scraped by the router's exporter):
 
   pumi_member_health{member,state}    1 for the member's current state
                                       (healthy/brownout/wedged/
-                                      disk-pressured/evicted), 0 for
-                                      the others
+                                      disk-pressured/slo-burn/
+                                      evicted), 0 for the others
   pumi_evictions_total{cause}         evictions by detected cause
   pumi_supervisor_probe_seconds       wall seconds per tick() sweep
 
@@ -72,9 +74,15 @@ import time
 from ..utils.log import log_info, log_warn
 
 #: Every state ``pumi_member_health`` reports (module docstring state
-#: machine; "evicted" is terminal).
+#: machine; "evicted" is terminal).  "slo-burn" is the observability
+#: plane's advisory state: the member is burning an SLO's error
+#: budget (obs/slo.py multi-window burn-rate alert attributed it) —
+#: quarantined through the same hysteresis as a latency brownout, but
+#: the trigger is the fleet-level objective, not the raw quantum
+#: window.
 HEALTH_STATES = (
-    "healthy", "brownout", "wedged", "disk-pressured", "evicted",
+    "healthy", "brownout", "wedged", "disk-pressured", "slo-burn",
+    "evicted",
 )
 
 
@@ -126,13 +134,13 @@ class FleetSupervisor:
         self._health_gauge = r.gauge(
             "pumi_member_health",
             "1 for the member's current supervisor-classified health "
-            "state (healthy/brownout/wedged/disk-pressured/evicted), "
-            "0 for the others — labeled by member and state",
+            "state (healthy/brownout/wedged/disk-pressured/slo-burn/"
+            "evicted), 0 for the others — labeled by member and state",
         )
         self._evictions_total = r.counter(
             "pumi_evictions_total",
             "members evicted by the fleet supervisor, labeled by the "
-            "detected cause (wedged/brownout/disk-pressured)",
+            "detected cause (wedged/brownout/disk-pressured/slo-burn)",
         )
         self._probe_seconds = r.histogram(
             "pumi_supervisor_probe_seconds",
@@ -153,6 +161,11 @@ class FleetSupervisor:
         t0 = time.perf_counter()
         with self.router.lock:
             members = [m for m in self.router.members if m.alive]
+            # The observability plane's advisory signal: active
+            # burn-rate alerts attributed to a member (obs/slo.py,
+            # evaluated by the router's obs tick).  Empty when the
+            # plane is off.
+            slo_alerts = self.router.slo_alerts_by_member()
             # Latency view: a member is judged only on a FULL window,
             # and only against a fleet median built from >= 2 judged
             # members — one member alone has nothing to be slower than.
@@ -178,6 +191,12 @@ class FleetSupervisor:
                 elif (m.scheduler.journal is not None
                       and m.scheduler.journal.degraded):
                     state = "disk-pressured"
+                elif slo_alerts.get(m.index):
+                    # SLO advisory ranks above the raw latency window:
+                    # the objective IS the contract, and the breach
+                    # record (journaled by _advise_slo before the
+                    # quarantine) must cite the SLO signal.
+                    state = "slo-burn"
                 elif (fleet_median is not None
                       and fleet_median > 0.0
                       and m.index in medians
@@ -186,6 +205,8 @@ class FleetSupervisor:
                     state = "brownout"
                 else:
                     state = "healthy"
+                if state == "slo-burn" and not m.quarantined:
+                    self._advise_slo(m, slo_alerts[m.index][0])
                 self._apply(m, state, credit=beat)
         self._probe_seconds.observe(time.perf_counter() - t0)
 
@@ -224,18 +245,37 @@ class FleetSupervisor:
         track["unhealthy"] += 1
         member.health = state
         if not member.quarantined:
-            member.quarantined = True
-            self.router.recorder.record(
-                "member_quarantined", member=member.index, state=state,
-            )
-            log_warn(
-                f"fleet member {member.index} quarantined ({state}): "
-                "no new placements; eviction after "
-                f"{self.grace_ticks} more unhealthy ticks"
-            )
+            self._quarantine(member, state)
         self._set_health(member)
         if track["unhealthy"] > self.grace_ticks:
             self._evict(member, state)
+
+    def _quarantine(self, member, state: str) -> None:
+        """Flip one member into quarantine (no new placements, jobs
+        keep running) and record the decision with the state that
+        triggered it."""
+        member.quarantined = True
+        self.router.recorder.record(
+            "member_quarantined", member=member.index, state=state,
+        )
+        log_warn(
+            f"fleet member {member.index} quarantined ({state}): "
+            "no new placements; eviction after "
+            f"{self.grace_ticks} more unhealthy ticks"
+        )
+
+    def _advise_slo(self, member, alert: dict) -> None:
+        """Act on one SLO burn-rate attribution: journal the breach
+        advisory to FLEET.json FIRST, then quarantine the offender
+        (breach-record-before-quarantine, PROTOCOLS.json,
+        protolint-checked) — the quarantine must be explainable from
+        the routing journal alone even if the process dies right
+        after the flag flips.  Eviction/restore hysteresis stays with
+        ``_apply``: this is an advisory entry point, not a second
+        state machine."""
+        self.router.record_breach(member.index, alert)
+        member.health = "slo-burn"
+        self._quarantine(member, "slo-burn")
 
     def _evict(self, member, cause: str) -> int:
         """Evict one member: journal the decision, THEN drain its

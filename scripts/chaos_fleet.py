@@ -18,7 +18,12 @@ serving/gateway.py) and asserts the fleet contracts:
     passes ``teleview.py --check`` against the fleet directory alone:
     one causally-ordered trace, with an explicit ``migrated`` /
     ``recovered`` / ``evicted`` link wherever spans cross process
-    lifetimes.
+    lifetimes;
+  * **reconstructible observability** — every scenario ends with
+    ``fleetview.py --check`` over its fleet directory: the
+    observability plane's FLEETSTATS.json snapshot must yield a
+    complete, well-formed fleet picture (member table, SLO burns,
+    renderable merged metrics) no matter how the scenario ended.
 
 Scenarios (run all by default; ``--only NAME`` to pick one,
 ``--list`` to enumerate):
@@ -42,12 +47,15 @@ Scenarios (run all by default; ``--only NAME`` to pick one,
                 journals the eviction, re-places every job from the
                 wedged member's on-disk journal with ``evicted`` trace
                 links, and the fleet drains bitwise;
-  brownout      member 0 runs 25x slow (injected per-quantum latency):
-                the supervisor quarantines it (no new placements) but
-                does NOT evict within the grace period, then restores
-                it to healthy once the latency clears — its jobs never
-                leave it and finish bitwise (false-positive
-                resistance);
+  brownout      member 0 runs 100x slow (injected per-quantum latency):
+                the SLO burn-rate alert fires (a chaos-tightened e2e
+                latency SLO, threshold derived from the reference
+                run), the supervisor quarantines the attributed
+                offender citing the SLO signal (FLEET.json journals
+                the breach BEFORE the quarantine) but does NOT evict,
+                then restores it to healthy once the latency clears
+                and the burn window slides past — its jobs never leave
+                it and finish bitwise (false-positive resistance);
   disk_pressure member 0's disk fills (injected ENOSPC on every
                 durable write): its journal degrades instead of
                 crashing, residents park at the quantum boundary, and
@@ -63,12 +71,14 @@ import os
 import subprocess
 import sys
 import threading
+import time
 import urllib.request
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
 sys.path.insert(1, os.path.join(ROOT, "scripts"))
 
+from fleetview import check_fleetstats, load_dir as load_fleet_view
 from teleview import check_job_trace, job_trace, load_trace_records
 
 import numpy as np
@@ -81,6 +91,12 @@ if not maybe_force_cpu():
     jax.config.update("jax_platforms", "cpu")
 
 from pumiumtally_tpu import TallyConfig, build_box
+from pumiumtally_tpu.obs import SLO
+from pumiumtally_tpu.obs.aggregate import (
+    FLEETSTATS_FILE,
+    FLEETSTATS_SCHEMA,
+)
+from pumiumtally_tpu.obs.registry import DEFAULT_BUCKETS
 from pumiumtally_tpu.resilience import ChaosInjector, ChaosPlan
 from pumiumtally_tpu.serving import (
     FleetRouter,
@@ -145,6 +161,16 @@ def fleet_trace_problems(fleet_dir: str, job_ids) -> list[str]:
     for jid in sorted(job_ids):
         for p in check_job_trace(job_trace(records, jid), jid):
             problems.append(f"{jid}: {p}")
+    return problems
+
+
+def fleet_obs_problems(name: str, fleet_dir: str) -> list[str]:
+    """``fleetview --check`` over one scenario's fleet directory (the
+    reconstructible-observability contract); problems are printed AND
+    returned so every scenario folds them into its verdict."""
+    problems = check_fleetstats(load_fleet_view(fleet_dir))
+    for p in problems:
+        print(f"[chaos-fleet] {name}: fleetview check: {p}", flush=True)
     return problems
 
 
@@ -215,10 +241,11 @@ def check_member_kill(name, mesh, cfg, ref, requests, tmpdir) -> bool:
     finally:
         router.close()
     trace_problems = fleet_trace_problems(fleet_dir, ids)
+    obs_problems = fleet_obs_problems(name, fleet_dir)
     ok = (
         member_died and not lost and not duplicated and terminal
         and got_poisoned == want_poisoned and migrations >= 1
-        and bitwise and not trace_problems
+        and bitwise and not trace_problems and not obs_problems
     )
     for p in trace_problems:
         print(f"[chaos-fleet] {name}: trace check: {p}", flush=True)
@@ -229,6 +256,7 @@ def check_member_kill(name, mesh, cfg, ref, requests, tmpdir) -> bool:
         f"migrations={migrations} "
         f"bitwise({n_compared} survivors)={bitwise} "
         f"traces({len(ids)} jobs)={not trace_problems} "
+        f"fleetview={not obs_problems} "
         f"{'OK' if ok else 'FAIL'}",
         flush=True,
     )
@@ -285,6 +313,16 @@ def check_router_kill(name, ref, tmpdir, n_jobs) -> bool:
         faults="kill_server_at_quantum:2",
     )
     killed = kill_proc.returncode != 0
+    # The KILLED router must leave a last-known FLEETSTATS.json (the
+    # plane snapshots atomically at construction and every step) —
+    # checked before the restart overwrites it.
+    stats_path = os.path.join(fleet_dir, FLEETSTATS_FILE)
+    fleetstats_survived = False
+    if os.path.exists(stats_path):
+        with open(stats_path) as fh:
+            fleetstats_survived = (
+                json.load(fh).get("schema") == FLEETSTATS_SCHEMA
+            )
     res_proc, res_sum = run_serve_fleet(
         fleet_dir, bank, n_jobs, resume=True
     )
@@ -317,22 +355,25 @@ def check_router_kill(name, ref, tmpdir, n_jobs) -> bool:
             break
         n_compared += 1
     trace_problems = fleet_trace_problems(fleet_dir, ids)
+    obs_problems = fleet_obs_problems(name, fleet_dir)
     ok = (
-        killed and not lost and not duplicated and completed
-        and zero_compiles and recovered and bitwise
-        and not trace_problems
+        killed and fleetstats_survived and not lost and not duplicated
+        and completed and zero_compiles and recovered and bitwise
+        and not trace_problems and not obs_problems
     )
     for p in trace_problems:
         print(f"[chaos-fleet] {name}: trace check: {p}", flush=True)
     print(
         f"[chaos-fleet] {name}: kill_server@q2 + --resume | "
-        f"killed={killed} lost={sorted(lost)} "
+        f"killed={killed} fleetstats_survived={fleetstats_survived} "
+        f"lost={sorted(lost)} "
         f"duplicated={duplicated} "
         f"recovered={res_sum.get('recovered')} "
         f"aot_misses={(res_sum['aot'] or {}).get('misses')} "
         f"placements={res_sum.get('placements')} "
         f"bitwise({n_compared} jobs)={bitwise} "
         f"traces({len(ids)} jobs)={not trace_problems} "
+        f"fleetview={not obs_problems} "
         f"{'OK' if ok else 'FAIL'}",
         flush=True,
     )
@@ -410,9 +451,10 @@ def check_retry_storm(name, mesh, cfg, ref, requests, tmpdir) -> bool:
     finally:
         gateway.stop()
         router.close()
+    obs_problems = fleet_obs_problems(name, fleet_dir)
     ok = (
         not errors and one_id_per_key and one_execution and bitwise
-        and journal_proof
+        and journal_proof and not obs_problems
     )
     for e in errors:
         print(f"[chaos-fleet] {name}: POST error: {e}", flush=True)
@@ -422,6 +464,7 @@ def check_retry_storm(name, mesh, cfg, ref, requests, tmpdir) -> bool:
         f"one_id_per_key={one_id_per_key} "
         f"one_execution={one_execution} bitwise={bitwise} "
         f"journal_proof={journal_proof} "
+        f"fleetview={not obs_problems} "
         f"{'OK' if ok else 'FAIL'}",
         flush=True,
     )
@@ -501,10 +544,11 @@ def check_wedged_member(name, mesh, cfg, ref, requests, tmpdir) -> bool:
     finally:
         router.close()
     trace_problems = fleet_trace_problems(fleet_dir, ids)
+    obs_problems = fleet_obs_problems(name, fleet_dir)
     ok = (
         len(victim_jobs) > 0 and evicted and not lost
         and not duplicated and journal_proof and counted and links_ok
-        and bitwise and not trace_problems
+        and bitwise and not trace_problems and not obs_problems
     )
     for p in trace_problems:
         print(f"[chaos-fleet] {name}: trace check: {p}", flush=True)
@@ -515,6 +559,7 @@ def check_wedged_member(name, mesh, cfg, ref, requests, tmpdir) -> bool:
         f"evicted_links({len(victim_jobs)} jobs)={links_ok} "
         f"bitwise({n_compared} jobs)={bitwise} "
         f"traces({len(ids)} jobs)={not trace_problems} "
+        f"fleetview={not obs_problems} "
         f"{'OK' if ok else 'FAIL'}",
         flush=True,
     )
@@ -522,19 +567,41 @@ def check_wedged_member(name, mesh, cfg, ref, requests, tmpdir) -> bool:
 
 
 def check_brownout(name, mesh, cfg, tmpdir) -> bool:
-    """Member 0 runs 25x slow under ``slow_member`` injection: the
-    supervisor quarantines it within the grace period but must NOT
-    evict, and once the injected latency clears it restores the
-    member to healthy with its jobs untouched — every flux bitwise vs
-    a fault-free run of the SAME workload (false-positive
-    resistance).  Runs at ``quantum_moves=1`` (reference included, so
-    the chunking matches bitwise) — jobs then span enough quanta for
-    the latency window to fill, clear, and restore BEFORE the fleet
-    drains; at the shared QUANTUM the tiny workload finishes in 1-2
-    quanta per job and nothing is ever judged."""
+    """Member 0 runs 100x slow under ``slow_member`` injection, and
+    the conviction comes from the OBSERVABILITY PLANE, not the latency
+    probe: a chaos-tightened e2e latency SLO (threshold = one
+    histogram bucket above everything the fault-free reference run
+    observed) burns hot in both windows, the burn-rate alert
+    attributes the victim, and the supervisor quarantines it CITING
+    the SLO signal — FLEET.json journals the breach BEFORE the
+    quarantine takes effect (breach-record-before-quarantine).  It
+    must NOT evict; once the injected latency clears and the burn
+    windows slide past the bad observations, the alert drops and the
+    restore hysteresis lifts the quarantine — the victim's jobs never
+    leave it and finish bitwise vs a fault-free run of the SAME
+    workload (false-positive resistance).  Runs at ``quantum_moves=1``
+    (reference included, so the chunking matches bitwise) — jobs then
+    span enough quanta for the slowdown to dominate their e2e.
+
+    The compile cache is warmed BEFORE the reference run: otherwise
+    the reference e2e is dominated by one-time jit compiles (tens of
+    seconds), the derived threshold lands in the top bucket, and the
+    warm fault run — milliseconds per quantum — can never breach it."""
     requests = synthetic_requests(
         mesh, 6, class_sizes=CLASSES, n_moves=N_MOVES, seed=SEED + 1,
     )
+    warm_router = make_router(
+        mesh, cfg, os.path.join(tmpdir, f"{name}-warm"),
+        os.path.join(tmpdir, "bank"), quantum_moves=1,
+    )
+    try:
+        submit_all(warm_router, synthetic_requests(
+            mesh, len(CLASSES), class_sizes=CLASSES, n_moves=1,
+            seed=SEED + 2,
+        ))
+        warm_router.run()
+    finally:
+        warm_router.close()
     ref_router = make_router(
         mesh, cfg, os.path.join(tmpdir, f"{name}-ref"),
         os.path.join(tmpdir, "bank"), quantum_moves=1,
@@ -543,29 +610,52 @@ def check_brownout(name, mesh, cfg, tmpdir) -> bool:
         ids = submit_all(ref_router, requests)
         ref_router.run()
         ref = {i: np.asarray(ref_router.result(i)) for i in ids}
+        # The reference e2e ceiling: the smallest bucket bound covering
+        # EVERY fault-free observation, plus one bucket of slack for
+        # scheduling noise — 100x-slowed jobs land far above it.
+        worst = 0.0
+        for m in ref_router.members:
+            fam = m.registry.snapshot().get("pumi_job_e2e_seconds")
+            for entry in (fam or {}).get("series", []):
+                v = entry["value"]
+                for ub in sorted(v["buckets"], key=float):
+                    if v["buckets"][ub] >= v["count"]:
+                        worst = max(worst, float(ub))
+                        break
     finally:
         ref_router.close()
+    above = [b for b in DEFAULT_BUCKETS if b > worst]
+    threshold = above[0] if above else worst
+    slo = SLO(
+        name="chaos-e2e", kind="latency",
+        metric="pumi_job_e2e_seconds", threshold_s=threshold,
+        objective=0.9, windows=((1.0, 4.0),),
+    )
     fleet_dir = os.path.join(tmpdir, name)
     router = make_router(
         mesh, cfg, fleet_dir, os.path.join(tmpdir, "bank"),
-        quantum_moves=1,
+        quantum_moves=1, slos=(slo,),
     )
     try:
         ids = submit_all(router, requests)
         victim = 0
         router.members[victim].scheduler.faults = ChaosInjector(
-            ChaosPlan(slow_member=victim, slow_factor=25.0)
+            ChaosPlan(slow_member=victim, slow_factor=100.0)
         )
+        # The probe-side slow_factor is pushed out of reach: only the
+        # SLO advisory may convict here.
         supervisor = FleetSupervisor(
-            router, slow_factor=4.0, window=2, heartbeat_misses=2,
-            grace_ticks=50, restore_ticks=1,
+            router, slow_factor=1000.0, window=2, heartbeat_misses=2,
+            grace_ticks=100000, restore_ticks=1,
         )
         quarantined_seen = False
+        quarantine_health = None
         for _ in range(100000):
             pending = router.step()
             supervisor.tick()
             if router.members[victim].quarantined and not quarantined_seen:
                 quarantined_seen = True
+                quarantine_health = router.members[victim].health
                 # The brownout clears: whatever throttled the member
                 # (thermal, a noisy neighbor) goes away mid-grace.
                 router.members[victim].scheduler.faults = ChaosInjector(
@@ -573,6 +663,19 @@ def check_brownout(name, mesh, cfg, tmpdir) -> bool:
                 )
             if not pending and all(j.terminal for j in router.jobs()):
                 break
+        # Settle: keep evaluating until the burn windows slide past
+        # the bad observations, the alert clears, and the restore
+        # hysteresis lifts the quarantine.
+        deadline = time.monotonic() + 30.0
+        while (
+            (router.members[victim].quarantined
+             or router.members[victim].health != "healthy")
+            and time.monotonic() < deadline
+        ):
+            router.step()
+            supervisor.tick()
+            time.sleep(0.05)
+        slo_convicted = quarantine_health == "slo-burn"
         never_evicted = all(m.alive for m in router.members)
         restored = (
             not router.members[victim].quarantined
@@ -583,22 +686,32 @@ def check_brownout(name, mesh, cfg, tmpdir) -> bool:
         bitwise, n_compared = _bitwise(router, ref, ids)
     finally:
         router.close()
+    with open(os.path.join(fleet_dir, "FLEET.json")) as fh:
+        journaled = json.load(fh).get("breaches") or {}
+    breach_cited = any(
+        b.get("slo") == "chaos-e2e"
+        for b in journaled.get(str(victim), [])
+    )
     trace_problems = fleet_trace_problems(fleet_dir, ids)
+    obs_problems = fleet_obs_problems(name, fleet_dir)
     ok = (
-        quarantined_seen and never_evicted and restored
+        quarantined_seen and slo_convicted and breach_cited
+        and never_evicted and restored
         and migrations == 0 and not lost and not duplicated
-        and bitwise and not trace_problems
+        and bitwise and not trace_problems and not obs_problems
     )
     for p in trace_problems:
         print(f"[chaos-fleet] {name}: trace check: {p}", flush=True)
     print(
-        f"[chaos-fleet] {name}: member{victim} 25x slow, clears in "
-        f"quarantine | quarantined={quarantined_seen} "
+        f"[chaos-fleet] {name}: member{victim} 100x slow, SLO "
+        f"chaos-e2e<= {threshold:g}s | quarantined={quarantined_seen} "
+        f"slo_convicted={slo_convicted} breach_cited={breach_cited} "
         f"never_evicted={never_evicted} restored={restored} "
         f"migrations={migrations} lost={sorted(lost)} "
         f"duplicated={duplicated} "
         f"bitwise({n_compared} jobs)={bitwise} "
         f"traces({len(ids)} jobs)={not trace_problems} "
+        f"fleetview={not obs_problems} "
         f"{'OK' if ok else 'FAIL'}",
         flush=True,
     )
@@ -626,8 +739,12 @@ def check_disk_pressure(name, mesh, cfg, ref, requests, tmpdir) -> bool:
             router, heartbeat_misses=2, grace_ticks=1,
         )
         supervisor.run()
+        # The degraded gauge lives on the VICTIM's registry now (one
+        # registry per member) — it outlives the eviction, so the
+        # postmortem read still works.
         degraded = (
-            router.registry.gauge("pumi_journal_degraded")
+            router.members[victim].registry
+            .gauge("pumi_journal_degraded")
             .value(member=f"m{victim}") == 1.0
         )
         drained = (
@@ -644,9 +761,11 @@ def check_disk_pressure(name, mesh, cfg, ref, requests, tmpdir) -> bool:
     finally:
         router.close()
     trace_problems = fleet_trace_problems(fleet_dir, ids)
+    obs_problems = fleet_obs_problems(name, fleet_dir)
     ok = (
         degraded and drained and journal_proof and not lost
         and not duplicated and bitwise and not trace_problems
+        and not obs_problems
     )
     for p in trace_problems:
         print(f"[chaos-fleet] {name}: trace check: {p}", flush=True)
@@ -657,6 +776,7 @@ def check_disk_pressure(name, mesh, cfg, ref, requests, tmpdir) -> bool:
         f"duplicated={duplicated} "
         f"bitwise({n_compared} jobs)={bitwise} "
         f"traces({len(ids)} jobs)={not trace_problems} "
+        f"fleetview={not obs_problems} "
         f"{'OK' if ok else 'FAIL'}",
         flush=True,
     )
@@ -691,6 +811,9 @@ def main() -> int:
     # env-level fault spec so member injectors default to none.
     os.environ.pop("PUMI_TPU_FAULTS", None)
     os.environ.pop("PUMI_TPU_PROM_PORT", None)
+    # Scenarios assert over the observability plane — make sure an
+    # ambient off-switch (the bench's A/B knob) cannot disable it.
+    os.environ.pop("PUMI_TPU_FLEET_OBS", None)
     mesh, cfg = build()
     requests = synthetic_requests(
         mesh, n_jobs, class_sizes=CLASSES, n_moves=N_MOVES, seed=SEED,

@@ -663,6 +663,10 @@ def test_metrics_lint_no_orphan_fleet_registry(tmp_path):
     modules = [
         os.path.join(pkg, "serving", "fleet.py"),
         os.path.join(pkg, "serving", "supervisor.py"),
+        # The observability plane registers on the router's registry
+        # too — its burn/alert/profile gauges must be scrapeable.
+        os.path.join(pkg, "obs", "slo.py"),
+        os.path.join(pkg, "obs", "profile.py"),
     ]
     declared: dict[str, str] = {}
     for path in modules:
@@ -688,7 +692,12 @@ def test_metrics_lint_no_orphan_fleet_registry(tmp_path):
     )
     try:
         FleetSupervisor(router)
+        # The router's scrape surface is /metrics (its own registry)
+        # plus /fleetz (member registries folded by the aggregator) —
+        # e.g. the profiler reads member-owned quantum/device counters
+        # that only the merged view can reach.
         reachable = set(router.registry.snapshot())
+        reachable |= set(router.aggregator.merge())
     finally:
         router.close()
     orphans = {
@@ -697,8 +706,82 @@ def test_metrics_lint_no_orphan_fleet_registry(tmp_path):
     }
     assert not orphans, (
         f"pumi_* metrics registered on a registry the router's "
-        f"scrape endpoint cannot reach: {orphans}"
+        f"scrape endpoints (/metrics + /fleetz) cannot reach: "
+        f"{orphans}"
     )
+
+
+def test_metrics_lint_no_per_job_labels(tmp_path):
+    """Cardinality hygiene: a per-job-id label on a counter/gauge/
+    histogram makes the family unbounded — every submitted job mints a
+    series that lives for the registry's lifetime, and the fleet
+    aggregation (obs/aggregate.py) folds ALL of it into /fleetz on
+    every scrape.  AST-harvest every label kwarg passed to a metric
+    mutation across the serving / obs / resilience surface and ban
+    job-identity names outright (per-job data belongs in flight
+    records and /jobs, which are capped)."""
+    import ast
+    import os
+
+    banned = {"job", "job_id", "jobid", "trace_id", "idempotency_key"}
+    pkg = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "pumiumtally_tpu",
+    )
+    offenders = []
+    seen_label_kwargs = 0
+    for sub in ("serving", "obs", "resilience"):
+        folder = os.path.join(pkg, sub)
+        for fname in sorted(os.listdir(folder)):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(folder, fname)
+            with open(path) as fh:
+                tree = ast.parse(fh.read(), filename=path)
+            for node in ast.walk(tree):
+                if not (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("inc", "set", "observe")
+                    and node.keywords
+                ):
+                    continue
+                for kw in node.keywords:
+                    if kw.arg is None:
+                        continue
+                    seen_label_kwargs += 1
+                    if kw.arg.lower() in banned:
+                        offenders.append(
+                            f"{sub}/{fname}:{node.lineno} "
+                            f"label {kw.arg!r}"
+                        )
+    # The harvest must see the real labeled surface (outcome=, member=,
+    # source=, ...) or the ban would pass vacuously.
+    assert seen_label_kwargs >= 10, seen_label_kwargs
+    assert not offenders, (
+        f"per-job-identity labels on metric families: {offenders}"
+    )
+
+
+def test_metrics_lint_fleet_merge_has_help(tmp_path):
+    """Every family in the fleet-merged snapshot (/fleetz — member
+    registries folded with the router's own) carries non-empty help
+    text, so the aggregated scrape is as self-describing as the
+    per-member one."""
+    from pumiumtally_tpu.serving import FleetRouter
+
+    mesh = build_box(1.0, 1.0, 1.0, 2, 2, 2)
+    router = FleetRouter(
+        mesh, TallyConfig(tolerance=1e-6),
+        fleet_dir=str(tmp_path / "fleet"), n_members=2, bank=None,
+    )
+    try:
+        merged = router.aggregator.merge()
+    finally:
+        router.close()
+    assert len(merged) >= 10
+    missing = [name for name, m in merged.items() if not m["help"]]
+    assert not missing, f"fleet-merged families without help: {missing}"
 
 
 def test_registry_render_safe_under_concurrent_registration():

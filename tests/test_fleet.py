@@ -371,9 +371,14 @@ def test_migration_bitwise_vs_uninterrupted(tmp_path, mesh):
         assert stats["outcomes"] == {"completed": 2}
         # The hop is observable: the migrated-source recovery counter
         # and the cross-member trace link both fire exactly once.
-        assert router.registry.counter(
-            "pumi_jobs_recovered_total"
-        ).value(source="migrated") == 1
+        # Member registries are per-scheduler now: the adopting member
+        # owns the recovery count, so sum the fleet.
+        assert sum(
+            m.registry.counter(
+                "pumi_jobs_recovered_total"
+            ).value(source="migrated")
+            for m in router.members
+        ) == 1
         trace = [
             json.loads(line)
             for line in open(router.journal.trace_path())

@@ -1,0 +1,554 @@
+"""Fleet observability plane contracts (obs/aggregate.py, obs/slo.py,
+obs/profile.py + the serving wiring).
+
+Contracts pinned here:
+
+  * AGGREGATION — ``FleetAggregator.merge()`` sums counters across
+    member registries per label-set, bucket-merges histograms, and
+    keeps gauges per member under a ``member=`` label; the merge is
+    DETERMINISTIC across member orderings (byte-identical Prometheus
+    text), and cross-member type drift is a loud error.
+  * SLO BURN — ``SLOEvaluator`` fires an alert only when EVERY window
+    of a pair burns above the threshold, attributes the member with
+    the largest bad-count delta, records one ``slo_breach`` flight
+    record per rising edge, and clears the alert once the windows
+    slide past the bad observations.
+  * FLEETSTATS — the router snapshots {schema, fleet, slo, profile,
+    metrics, router_metrics} atomically at construction (round zero)
+    and again at close; ``fleetview --check`` accepts the directory;
+    ``PUMI_TPU_FLEET_OBS=off`` runs the fleet bare (no /fleetz, no
+    snapshot, no advisory).
+  * TRACEPARENT — a W3C (or bare-hex) ``traceparent`` on POST /submit
+    makes the job JOIN the caller's trace; the submit response carries
+    ``trace_id`` (the dedup path returns the ORIGINAL trace);
+    /progress rows carry ``trace_id``; malformed headers are 400s.
+  * EXPORTER — ``/jobs`` caps at ``?limit=`` (default 500, newest
+    first); concurrent ``/metrics`` + ``/fleetz`` scrapes during an
+    active fleet run stay parseable with monotonic counters (the
+    thread-safety contract).
+
+Compile budget: the fast core (-m 'not slow') only submits (enqueue,
+no quanta) or works on bare registries.  Everything draining real
+quanta is marked slow and runs in CI's fleet-obs step.
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import sys
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pumiumtally_tpu import TallyConfig, build_box
+from pumiumtally_tpu.obs import (
+    FLEETSTATS_FILE,
+    FLEETSTATS_SCHEMA,
+    FleetAggregator,
+    FleetProfiler,
+    MetricsRegistry,
+    SLO,
+    SLOEvaluator,
+    default_slos,
+    profile_mode,
+    render_snapshot_prometheus,
+)
+from pumiumtally_tpu.serving import FleetRouter, TallyGateway
+from pumiumtally_tpu.serving.gateway import parse_traceparent
+from pumiumtally_tpu.serving.journal import request_to_json
+from pumiumtally_tpu.serving.saturate import synthetic_requests
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(1, os.path.join(ROOT, "scripts"))
+
+
+@pytest.fixture(autouse=True)
+def _clean_env(monkeypatch):
+    for var in (
+        "PUMI_TPU_MEGASTEP", "PUMI_TPU_KERNEL", "PUMI_TPU_IO_PIPELINE",
+        "PUMI_TPU_TUNING", "PUMI_TPU_AOT_FAULT", "PUMI_TPU_PROM_PORT",
+        "PUMI_TPU_FAULTS", "PUMI_TPU_FLEET_OBS", "PUMI_TPU_PROFILE",
+    ):
+        monkeypatch.delenv(var, raising=False)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return build_box(1.0, 1.0, 1.0, 2, 2, 2)
+
+
+def _cfg():
+    return TallyConfig(tolerance=1e-6)
+
+
+def _router(tmp_path, mesh, n_members=2, **kw):
+    kw.setdefault("quantum_moves", 2)
+    kw.setdefault("max_resident", 2)
+    return FleetRouter(
+        mesh, _cfg(), fleet_dir=str(tmp_path / "fleet"),
+        n_members=n_members, bank=None, **kw,
+    )
+
+
+# --------------------------------------------------------------------- #
+# Aggregation
+# --------------------------------------------------------------------- #
+def _seed_registries():
+    a, b = MetricsRegistry(), MetricsRegistry()
+    for r, n in ((a, 3), (b, 4)):
+        r.counter("pumi_jobs_total", "jobs").inc(n, outcome="completed")
+        r.gauge("pumi_queue_depth", "depth").set(n)
+        h = r.histogram("pumi_job_e2e_seconds", "e2e")
+        h.observe(0.002)
+        h.observe(5.0)
+    a.counter("pumi_jobs_total", "jobs").inc(1, outcome="poisoned")
+    return a, b
+
+
+def test_aggregator_merge_semantics():
+    a, b = _seed_registries()
+    agg = FleetAggregator(lambda: [("m0", a), ("m1", b)])
+    snap = agg.merge()
+    jobs = {
+        tuple(sorted(e["labels"].items())): e["value"]
+        for e in snap["pumi_jobs_total"]["series"]
+    }
+    # Counters: summed per label-set across members.
+    assert jobs[(("outcome", "completed"),)] == 7
+    assert jobs[(("outcome", "poisoned"),)] == 1
+    # Gauges: one series per member, labeled.
+    depth = {
+        e["labels"]["member"]: e["value"]
+        for e in snap["pumi_queue_depth"]["series"]
+    }
+    assert depth == {"m0": 3, "m1": 4}
+    # Histograms: counts and sums fold, buckets stay cumulative.
+    e2e = snap["pumi_job_e2e_seconds"]["series"][0]["value"]
+    assert e2e["count"] == 4
+    assert e2e["sum"] == pytest.approx(2 * (0.002 + 5.0))
+    assert e2e["buckets"]["0.0025"] == 2
+    assert e2e["buckets"]["5.0"] == 4
+
+
+def test_aggregator_deterministic_across_member_orderings():
+    a, b = _seed_registries()
+    sources = [("m0", a), ("m1", b)]
+    merges, texts = [], []
+    for perm in itertools.permutations(sources):
+        agg = FleetAggregator(lambda p=perm: list(p))
+        merges.append(agg.merge())
+        texts.append(agg.render_prometheus())
+    assert merges[0] == merges[1]
+    assert texts[0] == texts[1]
+    # And renderable through the shared snapshot renderer (the
+    # fleetview offline path).
+    assert render_snapshot_prometheus(merges[0]) == texts[0]
+
+
+def test_aggregator_type_drift_is_loud():
+    a, b = MetricsRegistry(), MetricsRegistry()
+    a.counter("pumi_thing", "x").inc()
+    b.gauge("pumi_thing", "x").set(1)
+    agg = FleetAggregator(lambda: [("m0", a), ("m1", b)])
+    with pytest.raises(ValueError, match="pumi_thing"):
+        agg.merge()
+
+
+# --------------------------------------------------------------------- #
+# SLO burn-rate evaluation
+# --------------------------------------------------------------------- #
+class _Recorder:
+    def __init__(self):
+        self.records = []
+
+    def record(self, kind, **fields):
+        self.records.append(dict(fields, kind=kind))
+
+
+def test_slo_alert_fires_attributes_and_clears():
+    slo = SLO(
+        name="e2e", kind="latency", metric="pumi_job_e2e_seconds",
+        threshold_s=1.0, objective=0.9, windows=((2.0, 4.0),),
+    )
+    regs = [MetricsRegistry(), MetricsRegistry()]
+    hists = [
+        r.histogram("pumi_job_e2e_seconds", "e2e") for r in regs
+    ]
+    rec = _Recorder()
+    clock = itertools.count(start=0.0, step=1.0)
+    ev = SLOEvaluator(
+        (slo,), MetricsRegistry(), rec, clock=lambda: next(clock)
+    )
+
+    def members(alive=(True, True)):
+        return [
+            (i, f"m{i}", regs[i], alive[i]) for i in range(2)
+        ]
+
+    # Baseline: good observations only — no alert.
+    hists[0].observe(0.01)
+    hists[1].observe(0.01)
+    for _ in range(5):
+        assert ev.evaluate(members()) == {}
+    # Member 1 turns bad: every window pair heats past burn 1.
+    hists[1].observe(30.0)
+    hists[1].observe(30.0)
+    ev.evaluate(members())
+    alert = ev.alerts["e2e"]
+    assert alert["member"] == 1
+    assert [r["kind"] for r in rec.records] == ["slo_breach"]
+    assert rec.records[0]["slo"] == "e2e"
+    assert rec.records[0]["member"] == 1
+    assert ev.alerts_by_member() == {1: [alert]}
+    # A still-breaching tick updates burns but records NO new edge.
+    ev.evaluate(members())
+    assert len(rec.records) == 1
+    # The windows slide past the bad observations: alert clears.
+    for _ in range(6):
+        ev.evaluate(members())
+    assert ev.alerts == {}
+    assert ev.alerts_by_member() == {}
+
+
+def test_slo_availability_burns_on_dead_member():
+    slo = SLO(
+        name="avail", kind="availability", objective=0.5,
+        windows=((2.0, 3.0),),
+    )
+    clock = itertools.count(start=0.0, step=1.0)
+    ev = SLOEvaluator(
+        (slo,), MetricsRegistry(), clock=lambda: next(clock)
+    )
+    members = [(0, "m0", None, True), (1, "m1", None, False)]
+    for _ in range(4):
+        ev.evaluate(members)
+    # Half the fleet down at objective 0.5 → burn exactly 1.0, which
+    # does NOT exceed the default alert threshold (alert_burn=1.0).
+    assert ev.alerts == {}
+    members = [(0, "m0", None, False), (1, "m1", None, False)]
+    for _ in range(3):
+        ev.evaluate(members)
+    assert "avail" in ev.alerts
+
+
+def test_default_slos_are_wellformed():
+    slos = default_slos()
+    assert len({s.name for s in slos}) == len(slos) == 4
+    with pytest.raises(ValueError, match="kind"):
+        SLO(name="x", kind="nope", objective=0.5)
+    with pytest.raises(ValueError, match="objective"):
+        SLO(name="x", kind="availability", objective=1.5)
+    with pytest.raises(ValueError, match="window"):
+        SLO(name="x", kind="availability", objective=0.5,
+            windows=((5.0, 2.0),))
+
+
+# --------------------------------------------------------------------- #
+# Profiling
+# --------------------------------------------------------------------- #
+def test_profile_mode_resolution(monkeypatch):
+    assert profile_mode() == "off"
+    monkeypatch.setenv("PUMI_TPU_PROFILE", "anomaly")
+    assert profile_mode() == "anomaly"
+    with pytest.raises(ValueError, match="bogus"):
+        profile_mode("bogus")
+
+
+def test_profiler_capture_gated_off_by_default(tmp_path):
+    prof = FleetProfiler(
+        MetricsRegistry(), journal_dir=str(tmp_path),
+    )
+    assert prof.status()["mode"] == "off"
+    assert prof.on_alert({"slo": "e2e", "member": 0}) is False
+    assert prof.status()["captures"] == []
+    assert not os.path.exists(os.path.join(tmp_path, "profiles"))
+
+
+# --------------------------------------------------------------------- #
+# FLEETSTATS + the off switch
+# --------------------------------------------------------------------- #
+def test_fleetstats_written_from_round_zero(tmp_path, mesh):
+    router = _router(tmp_path, mesh)
+    try:
+        path = router.fleetstats_path()
+        assert os.path.basename(path) == FLEETSTATS_FILE
+        with open(path) as fh:
+            doc = json.load(fh)
+        assert doc["schema"] == FLEETSTATS_SCHEMA
+        assert {m["member"] for m in doc["fleet"]["members"]} == {0, 1}
+        assert [s["name"] for s in doc["slo"]["slos"]] == [
+            s.name for s in default_slos()
+        ]
+        from fleetview import check_fleetstats, load_dir
+
+        assert check_fleetstats(load_dir(router.journal.dir)) == []
+    finally:
+        router.close()
+    # close() snapshots one last time; the picture outlives the router.
+    from fleetview import check_fleetstats, load_dir
+
+    assert check_fleetstats(load_dir(router.journal.dir)) == []
+
+
+def test_fleet_obs_off_runs_bare(tmp_path, mesh, monkeypatch):
+    monkeypatch.setenv("PUMI_TPU_FLEET_OBS", "off")
+    monkeypatch.setenv("PUMI_TPU_PROM_PORT", "0")
+    router = _router(tmp_path, mesh)
+    try:
+        assert router.aggregator is None
+        assert router.slo is None
+        assert router.slo_alerts_by_member() == {}
+        assert not os.path.exists(router.fleetstats_path())
+        base = router._exporter.url.replace("/metrics", "")
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(f"{base}/fleetz", timeout=5)
+        assert err.value.code == 404
+    finally:
+        router.close()
+    assert not os.path.exists(router.fleetstats_path())
+
+
+def test_fleetz_mounted_and_taught(tmp_path, mesh, monkeypatch):
+    monkeypatch.setenv("PUMI_TPU_PROM_PORT", "0")
+    router = _router(tmp_path, mesh)
+    try:
+        base = router._exporter.url.replace("/metrics", "")
+        with urllib.request.urlopen(f"{base}/fleetz", timeout=5) as r:
+            text = r.read().decode()
+            ctype = r.headers.get("Content-Type", "")
+        assert "text/plain" in ctype
+        assert "# TYPE pumi_jobs_total counter" in text
+        # /buildz and the 404 body both teach the mounted endpoint.
+        with urllib.request.urlopen(f"{base}/buildz", timeout=5) as r:
+            assert "/fleetz" in json.loads(r.read())["endpoints"]
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(f"{base}/nope", timeout=5)
+        assert "/fleetz" in err.value.read().decode()
+    finally:
+        router.close()
+
+
+# --------------------------------------------------------------------- #
+# Traceparent ingress
+# --------------------------------------------------------------------- #
+W3C = "00-4bf92f3577b34da6a3ce929d0e0e4736-00f067aa0ba902b7-01"
+
+
+def test_parse_traceparent_forms():
+    assert parse_traceparent(None) is None
+    assert parse_traceparent("  ") is None
+    assert parse_traceparent(W3C) == "4bf92f3577b34da6a3ce929d0e0e4736"
+    assert parse_traceparent("DEADBEEFDEADBEEF") == "deadbeefdeadbeef"
+    for bad in ("xyz", "00-short-span-01", "ff" * 20):
+        with pytest.raises(ValueError):
+            parse_traceparent(bad)
+
+
+def _post(url, body, headers=None):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(), method="POST",
+        headers=headers or {},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_traceparent_joins_submit_and_dedup(tmp_path, mesh):
+    router = _router(tmp_path, mesh)
+    gateway = TallyGateway(router)
+    try:
+        req = synthetic_requests(mesh, 1, class_sizes=(24,))[0]
+        body = dict(request_to_json(req), idempotency_key="k1")
+        status, payload = _post(
+            f"{gateway.url}/submit", body, {"traceparent": W3C}
+        )
+        assert status == 200
+        trace = "4bf92f3577b34da6a3ce929d0e0e4736"
+        assert payload["trace_id"] == trace
+        assert router.job(payload["job"]).trace_id == trace
+        # The dedup path answers with the ORIGINAL trace even when the
+        # retry carries a different (or no) traceparent.
+        status2, payload2 = _post(f"{gateway.url}/submit", body)
+        assert status2 == 200
+        assert payload2 == payload
+        # Malformed header: refused before anything is journaled.
+        status3, payload3 = _post(
+            f"{gateway.url}/submit", body, {"traceparent": "zz"}
+        )
+        assert status3 == 400
+        assert "traceparent" in payload3["error"]
+        # No header: the job mints its own root.
+        other = synthetic_requests(
+            mesh, 2, class_sizes=(24,), seed=9,
+        )[1]
+        status4, payload4 = _post(
+            f"{gateway.url}/submit",
+            dict(request_to_json(other), idempotency_key="k2"),
+        )
+        assert status4 == 200
+        assert payload4["trace_id"]
+        assert payload4["trace_id"] != trace
+    finally:
+        gateway.stop()
+        router.close()
+
+
+@pytest.mark.slow
+def test_progress_rows_carry_trace_id(tmp_path, mesh):
+    router = _router(tmp_path, mesh)
+    gateway = TallyGateway(router)
+    try:
+        req = synthetic_requests(
+            mesh, 1, class_sizes=(24,), n_moves=2,
+        )[0]
+        status, payload = _post(
+            f"{gateway.url}/submit",
+            dict(request_to_json(req), idempotency_key="k1"),
+            {"traceparent": W3C},
+        )
+        assert status == 200
+        router.run()
+        with urllib.request.urlopen(
+            f"{gateway.url}/progress/{payload['job']}?timeout=5",
+            timeout=30,
+        ) as resp:
+            rows = [
+                json.loads(line) for line in resp.read().splitlines()
+            ]
+        assert rows
+        assert all(
+            r["trace_id"] == payload["trace_id"] for r in rows
+        )
+    finally:
+        gateway.stop()
+        router.close()
+
+
+# --------------------------------------------------------------------- #
+# /jobs limit
+# --------------------------------------------------------------------- #
+def test_jobs_endpoint_limit(tmp_path, mesh, monkeypatch):
+    monkeypatch.setenv("PUMI_TPU_PROM_PORT", "0")
+    router = _router(tmp_path, mesh)
+    try:
+        for r in synthetic_requests(mesh, 5, class_sizes=(24,)):
+            router.submit(r, idempotency_key=f"key-{r.job_id}")
+        base = router._exporter.url.replace("/metrics", "")
+
+        def jobs(q=""):
+            with urllib.request.urlopen(
+                f"{base}/jobs{q}", timeout=5
+            ) as resp:
+                return json.loads(resp.read())
+        full = jobs()
+        assert full["total_jobs"] == 5
+        assert full["limit"] == 500
+        assert len(full["jobs"]) == 5
+        capped = jobs("?limit=2")
+        assert capped["limit"] == 2
+        assert capped["total_jobs"] == 5
+        assert len(capped["jobs"]) == 2
+        # Newest first: the per-member submission ordinal leads.
+        assert (
+            capped["jobs"][0]["index"] >= capped["jobs"][1]["index"]
+        )
+        assert jobs("?limit=bogus")["limit"] == 500
+    finally:
+        router.close()
+
+
+def test_exporter_query_optin_is_by_param_name():
+    """The exporter hands the parsed query dict only to endpoints
+    declaring a positional parameter literally named ``query`` — an
+    unrelated optional positional (``TallyTracer.chrome(records=None)``)
+    must NOT be mistaken for a query sink, or /trace renders an empty
+    document from the query dict."""
+    from pumiumtally_tpu.obs.exporter import _accepts_query
+
+    assert _accepts_query(lambda query: query)
+    assert _accepts_query(lambda query=None: query)
+    assert not _accepts_query(lambda records=None: records)
+    assert not _accepts_query(lambda: None)
+    assert not _accepts_query(lambda **kw: kw)
+
+
+# --------------------------------------------------------------------- #
+# Exporter thread-safety under an active fleet
+# --------------------------------------------------------------------- #
+@pytest.mark.slow
+def test_concurrent_scrapes_parse_and_stay_monotonic(
+    tmp_path, mesh, monkeypatch
+):
+    monkeypatch.setenv("PUMI_TPU_PROM_PORT", "0")
+    router = _router(tmp_path, mesh)
+    try:
+        for r in synthetic_requests(
+            mesh, 4, class_sizes=(24,), n_moves=4,
+        ):
+            router.submit(r, idempotency_key=f"key-{r.job_id}")
+        base = router._exporter.url.replace("/metrics", "")
+        stop = threading.Event()
+        quanta: list[float] = []
+        errors: list[str] = []
+
+        def scrape(path, sink):
+            from fleetview import check_prom_text
+
+            while not stop.is_set():
+                try:
+                    with urllib.request.urlopen(
+                        f"{base}{path}", timeout=10
+                    ) as resp:
+                        text = resp.read().decode()
+                except OSError as e:  # noqa: PERF203
+                    errors.append(f"{path}: {e}")
+                    return
+                problems = check_prom_text(text, path)
+                if problems:
+                    errors.extend(problems)
+                    return
+                total = 0.0
+                for line in text.splitlines():
+                    if line.startswith("pumi_quanta_total"):
+                        total += float(line.rsplit(" ", 1)[1])
+                sink.append(total)
+
+        threads = [
+            threading.Thread(
+                target=scrape, args=("/fleetz", quanta), daemon=True
+            ),
+            threading.Thread(
+                target=scrape, args=("/metrics", []), daemon=True
+            ),
+        ]
+        for t in threads:
+            t.start()
+        router.run()
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errors, errors
+        assert len(quanta) >= 2
+        # The fleet-level counter never moves backwards mid-scrape.
+        assert all(
+            b >= a for a, b in zip(quanta, quanta[1:])
+        ), quanta
+        assert quanta[-1] > 0
+        # And the post-run picture is reconstructible.
+        from fleetview import check_fleetstats, load_dir
+
+        assert check_fleetstats(load_dir(router.journal.dir)) == []
+        doc = json.load(open(router.fleetstats_path()))
+        util = doc["router_metrics"].get(
+            "pumi_member_device_utilization"
+        )
+        assert util is not None and util["series"]
+    finally:
+        router.close()

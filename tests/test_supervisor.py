@@ -223,7 +223,7 @@ def test_disk_pressure_classified_and_cooperatively_drained(
         router.members[0].scheduler.journal.note_disk_failure(
             "test", OSError(errno.ENOSPC, "No space left on device")
         )
-        assert router.registry.gauge(
+        assert router.members[0].registry.gauge(
             "pumi_journal_degraded"
         ).value(member="m0") == 1.0
         sup.tick()
@@ -534,7 +534,7 @@ def test_disk_pressure_drained_zero_loss_bitwise(tmp_path, mesh):
         )
         sup = FleetSupervisor(router, grace_ticks=1)
         sup.run()
-        assert router.registry.gauge(
+        assert router.members[0].registry.gauge(
             "pumi_journal_degraded"
         ).value(member="m0") == 1.0
         assert not router.members[0].alive
